@@ -1,0 +1,20 @@
+"""Public Stage API.
+
+Ref parity: flink-ml-core/.../ml/api/{Stage,AlgoOperator,Transformer,Model,
+Estimator}.java + builder/{Pipeline,PipelineModel,Graph,GraphBuilder}.java.
+"""
+
+from flink_ml_tpu.api.stage import (  # noqa: F401
+    AlgoOperator,
+    Estimator,
+    Model,
+    Stage,
+    Transformer,
+)
+from flink_ml_tpu.api.pipeline import Pipeline, PipelineModel  # noqa: F401
+from flink_ml_tpu.api.graph import (  # noqa: F401
+    Graph,
+    GraphBuilder,
+    GraphModel,
+    TableId,
+)

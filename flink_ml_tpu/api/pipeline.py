@@ -1,0 +1,92 @@
+"""Pipeline / PipelineModel.
+
+Ref parity: flink-ml-core/.../ml/builder/Pipeline.java:45 (fit:79-107) and
+PipelineModel.java — an ordered list of stages acting as a single Estimator:
+``fit`` trains each Estimator in sequence on the inputs transformed through
+all previous (fitted) stages; the result is a PipelineModel of transformers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+from flink_ml_tpu.api.stage import AlgoOperator, Estimator, Model, Stage
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.utils import io as rw
+
+
+def _save_stages(composite, stages: List[Stage], path: str) -> None:
+    rw.save_metadata(composite, path, extra={"numStages": len(stages)})
+    for i, stage in enumerate(stages):
+        stage.save(rw.stage_path(path, i))
+
+
+def _load_stages(cls, path: str):
+    """Returns a cls instance with nested stages and composite params restored."""
+    meta = rw.load_metadata(path)
+    stages = [rw.load_stage(rw.stage_path(path, i))
+              for i in range(meta["extra"]["numStages"])]
+    composite = cls(stages)
+    composite.params_from_json(meta["paramMap"])
+    return composite
+
+
+class Pipeline(Estimator):
+    """Ordered stages acting as one Estimator (ref: Pipeline.java:45)."""
+
+    def __init__(self, stages: List[Stage] = None):
+        super().__init__()
+        self.stages = list(stages or [])
+
+    def fit(self, *inputs: Table) -> "PipelineModel":
+        # Ref fit:79-107: transform inputs through each fitted/plain stage up
+        # to the last Estimator; collect the transform twin of every stage.
+        last_estimator_idx = -1
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, Estimator):
+                last_estimator_idx = i
+
+        transform_stages: List[AlgoOperator] = []
+        current = inputs
+        for i, stage in enumerate(self.stages):
+            if i <= last_estimator_idx:
+                if isinstance(stage, Estimator):
+                    op = stage.fit(*current)
+                else:
+                    op = stage
+                if i < last_estimator_idx:
+                    current = op.transform(*current)
+            else:
+                op = stage
+            transform_stages.append(op)
+        return PipelineModel(transform_stages)
+
+    def save(self, path: str) -> None:
+        _save_stages(self, self.stages, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Pipeline":
+        return _load_stages(cls, path)
+
+
+class PipelineModel(Model):
+    """Applies stages in order (ref: PipelineModel.java)."""
+
+    def __init__(self, stages: List[AlgoOperator] = None):
+        super().__init__()
+        self.stages = list(stages or [])
+
+    def transform(self, *inputs: Table):
+        current = inputs
+        for stage in self.stages:
+            current = stage.transform(*current)
+        return current
+
+    def save(self, path: str) -> None:
+        _save_stages(self, self.stages, path)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineModel":
+        return _load_stages(cls, path)

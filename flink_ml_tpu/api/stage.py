@@ -1,0 +1,72 @@
+"""Stage / AlgoOperator / Transformer / Model / Estimator.
+
+Ref parity: flink-ml-core/.../ml/api/*.java — the Spark-ML-style hierarchy:
+
+    Stage (savable, has params)
+      └─ AlgoOperator.transform(*tables) -> (table, ...)
+           └─ Transformer (one-in-one-out semantics)
+                └─ Model (.set_model_data / .get_model_data)
+      └─ Estimator.fit(*tables) -> Model
+
+Tables here are host columnar batches (flink_ml_tpu.common.table.Table); the
+compute inside concrete stages is jitted XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.params.param import WithParams
+from flink_ml_tpu.utils import io as rw
+
+
+class Stage(WithParams):
+    """A node with params that can be saved/loaded (ref: api/Stage.java)."""
+
+    def save(self, path: str) -> None:
+        rw.save_metadata(self, path)
+        self._save_extra(path)
+
+    @classmethod
+    def load(cls, path: str):
+        stage, meta = rw.load_stage_params(path)
+        if not isinstance(stage, cls):
+            raise TypeError(f"saved stage {type(stage).__name__} is not a {cls.__name__}")
+        stage._load_extra(path, meta)
+        return stage
+
+    # hooks for subclasses with model data / nested stages
+    def _save_extra(self, path: str) -> None:
+        pass
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        pass
+
+
+class AlgoOperator(Stage):
+    """A Stage computing output tables from input tables (ref: AlgoOperator.java)."""
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        raise NotImplementedError
+
+
+class Transformer(AlgoOperator):
+    """Marker for record-wise transforms (ref: Transformer.java)."""
+
+
+class Model(Transformer):
+    """A Transformer with model data (ref: Model.java)."""
+
+    def set_model_data(self, *model_data: Table):
+        raise NotImplementedError(f"{type(self).__name__} has no model data")
+
+    def get_model_data(self) -> Tuple[Table, ...]:
+        raise NotImplementedError(f"{type(self).__name__} has no model data")
+
+
+class Estimator(Stage):
+    """fit(*tables) -> Model (ref: Estimator.java)."""
+
+    def fit(self, *inputs: Table) -> Model:
+        raise NotImplementedError

@@ -1,0 +1,165 @@
+"""Host-side columnar Table.
+
+The reference's API boundary is the Flink ``Table`` (lazy dataflow). On TPU the
+equivalent boundary is a host-resident columnar batch: numeric columns are
+numpy arrays ready to ship to device; string/object columns stay host-side
+(XLA-hostile data is handled on host by design, see SURVEY.md §7 "Ragged/
+sparse ETL ops"). Bounded tables are materialized; unbounded streams are
+modeled by ``flink_ml_tpu.iteration.streaming.StreamTable`` (an iterator of
+Tables), mirroring the bounded/unbounded split of the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence
+
+import numpy as np
+
+from flink_ml_tpu.linalg.vectors import DenseVector, Vector, stack_vectors
+
+
+def _as_column(values) -> np.ndarray:
+    if isinstance(values, np.ndarray):
+        return values
+    values = list(values)
+    if values and isinstance(values[0], (Vector,)):
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        return arr
+    try:
+        arr = np.asarray(values)
+    except ValueError:
+        # ragged nested sequences stay host-side as object columns
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        return arr
+    if arr.dtype.kind in "OU" or arr.ndim > 1:
+        out = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            out[i] = v
+        return out
+    return arr
+
+
+class Table:
+    """An ordered set of named columns of equal length."""
+
+    def __init__(self, columns: Dict[str, np.ndarray]):
+        self._columns: Dict[str, np.ndarray] = {}
+        n = None
+        for name, col in columns.items():
+            col = _as_column(col)
+            if n is None:
+                n = len(col)
+            elif len(col) != n:
+                raise ValueError(
+                    f"column {name!r} has {len(col)} rows, expected {n}")
+            self._columns[name] = col
+        self._num_rows = n or 0
+
+    # -- constructors --------------------------------------------------------
+    @staticmethod
+    def from_columns(**columns) -> "Table":
+        return Table(columns)
+
+    @staticmethod
+    def from_rows(rows: Iterable[Sequence], names: Sequence[str]) -> "Table":
+        rows = list(rows)
+        cols = {name: [row[i] for row in rows] for i, name in enumerate(names)}
+        return Table(cols)
+
+    @staticmethod
+    def from_data_frame(df) -> "Table":
+        """From a servable DataFrame (flink_ml_tpu.servable)."""
+        return Table({name: df.get(name).values for name in df.column_names})
+
+    # -- schema / access -----------------------------------------------------
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._columns)
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def __len__(self):
+        return self._num_rows
+
+    def __contains__(self, name):
+        return name in self._columns
+
+    def column(self, name: str) -> np.ndarray:
+        try:
+            return self._columns[name]
+        except KeyError:
+            raise KeyError(
+                f"no column {name!r}; available: {self.column_names}")
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self.column(name)
+
+    def vectors(self, name: str, dtype=np.float32) -> np.ndarray:
+        """Column of vectors stacked into one (n, dim) array — the device
+        on-ramp; equivalent of the reference's Table→DataStream map."""
+        col = self.column(name)
+        if col.dtype != object:
+            arr = np.asarray(col, dtype=dtype)
+            return arr[:, None] if arr.ndim == 1 else arr
+        return stack_vectors(col, dtype=dtype)
+
+    def scalars(self, name: str, dtype=np.float32) -> np.ndarray:
+        return np.asarray(self.column(name), dtype=dtype)
+
+    # -- functional ops ------------------------------------------------------
+    def with_column(self, name: str, values) -> "Table":
+        cols = dict(self._columns)
+        cols[name] = values
+        return Table(cols)
+
+    def with_columns(self, **named_values) -> "Table":
+        cols = dict(self._columns)
+        cols.update(named_values)
+        return Table(cols)
+
+    def select(self, *names: str) -> "Table":
+        return Table({n: self.column(n) for n in names})
+
+    def drop(self, *names: str) -> "Table":
+        return Table({n: c for n, c in self._columns.items() if n not in names})
+
+    def rename(self, mapping: Dict[str, str]) -> "Table":
+        return Table({mapping.get(n, n): c for n, c in self._columns.items()})
+
+    def take(self, indices) -> "Table":
+        return Table({n: c[indices] for n, c in self._columns.items()})
+
+    def head(self, n: int) -> "Table":
+        return self.take(np.arange(min(n, self._num_rows)))
+
+    def concat(self, other: "Table") -> "Table":
+        if set(self.column_names) != set(other.column_names):
+            raise ValueError("cannot concat tables with different schemas")
+        return Table({n: np.concatenate([self._columns[n], other.column(n)])
+                      for n in self.column_names})
+
+    # -- row view (collect parity with table.execute().collect()) -----------
+    def rows(self) -> List[tuple]:
+        names = self.column_names
+        return [tuple(self._columns[n][i] for n in names)
+                for i in range(self._num_rows)]
+
+    def to_dict(self) -> Dict[str, list]:
+        return {n: list(c) for n, c in self._columns.items()}
+
+    def __repr__(self):
+        return f"Table({self.column_names}, num_rows={self._num_rows})"
+
+
+def as_dense_vector_column(arr: np.ndarray) -> np.ndarray:
+    """(n, d) float array → object column of DenseVectors (device off-ramp)."""
+    out = np.empty(arr.shape[0], dtype=object)
+    for i in range(arr.shape[0]):
+        out[i] = DenseVector(np.asarray(arr[i], dtype=np.float64))
+    return out

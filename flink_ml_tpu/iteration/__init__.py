@@ -1,0 +1,33 @@
+"""Iteration runtime.
+
+Ref parity: flink-ml-iteration (13k LoC of head/tail operators, epoch
+watermark trackers, feedback channels, draft-graph rewriting, in-loop
+checkpoint barriers). On TPU the whole apparatus collapses (SURVEY.md §7):
+
+- the *feedback edge* is the carry pytree of a compiled round function;
+- *epoch alignment* is implicit — SPMD shards run the round in lockstep;
+- the coordinator's *global termination vote* is a ``psum`` of per-shard
+  counts checked between rounds;
+- *checkpoint-through-the-cycle* is snapshotting (carry, epoch) between
+  rounds — there are no in-flight records to drain;
+- the *data cache* (DataCacheWriter/ListStateWithCache) is the training batch
+  living on device HBM across rounds, sharded over the mesh.
+
+What remains real and is implemented here: the IterationBody protocol, the
+bounded loop driver (fully-on-device ``lax.while_loop`` or a host loop with
+listener callbacks), termination criteria (max-iter / tol / empty-round
+vote), per-round vs all-round state scoping, and checkpoint/resume.
+"""
+
+from flink_ml_tpu.iteration.iteration import (  # noqa: F401
+    IterationConfig,
+    IterationListener,
+    Iterations,
+    iterate_bounded,
+)
+from flink_ml_tpu.iteration.checkpoint import CheckpointManager  # noqa: F401
+from flink_ml_tpu.iteration.streaming import (  # noqa: F401
+    StreamTable,
+    generate_batches,
+    iterate_unbounded,
+)

@@ -1,0 +1,85 @@
+"""Mid-iteration checkpoint/resume.
+
+Ref parity: the reference's deepest subsystem (SURVEY.md §5) — aligned
+checkpoint barriers circulating through the feedback cycle
+(HeadOperatorCheckpointAligner.java:42, checkpoint/Checkpoints.java:43),
+feedback-record logs, and DataCacheSnapshot. On TPU there are no in-flight
+records: a checkpoint is an atomic snapshot of (carry pytree, epoch) taken
+between rounds, so the whole subsystem reduces to serializing a pytree.
+
+Format: one directory per checkpoint, numpy arrays + a treedef manifest.
+Restore rebuilds arrays onto the template carry's shardings, so resume
+works on the same mesh topology (same-parallelism restore — the reference
+has exactly the same restriction, ReplayOperator.java:163).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+
+class CheckpointManager:
+    """Saves/restores (carry, epoch) snapshots under a base directory."""
+
+    def __init__(self, base_dir: str, keep: int = 2):
+        self.base_dir = base_dir
+        self.keep = keep
+        os.makedirs(base_dir, exist_ok=True)
+
+    # -- write ---------------------------------------------------------------
+    def save(self, carry: Any, epoch: int) -> str:
+        leaves, treedef = jax.tree_util.tree_flatten(carry)
+        ckpt_dir = os.path.join(self.base_dir, f"ckpt-{epoch:08d}")
+        tmp_dir = ckpt_dir + ".tmp"
+        os.makedirs(tmp_dir, exist_ok=True)
+        host_leaves = [np.asarray(x) for x in leaves]
+        np.savez(os.path.join(tmp_dir, "leaves.npz"),
+                 **{f"leaf_{i}": x for i, x in enumerate(host_leaves)})
+        with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
+            json.dump({"epoch": epoch, "num_leaves": len(leaves)}, f)
+        # atomic publish: rename makes partially-written checkpoints invisible
+        if os.path.exists(ckpt_dir):
+            shutil.rmtree(ckpt_dir)
+        os.rename(tmp_dir, ckpt_dir)
+        self._gc()
+        return ckpt_dir
+
+    def _gc(self) -> None:
+        ckpts = self.list_checkpoints()
+        for stale in ckpts[:-self.keep]:
+            shutil.rmtree(os.path.join(self.base_dir, stale), ignore_errors=True)
+
+    # -- read ----------------------------------------------------------------
+    def list_checkpoints(self):
+        return sorted(d for d in os.listdir(self.base_dir)
+                      if d.startswith("ckpt-") and not d.endswith(".tmp"))
+
+    def restore(self, template_carry: Any) -> Optional[Tuple[Any, int]]:
+        """Latest checkpoint restored onto the template's structure and
+        shardings; None if no checkpoint exists."""
+        ckpts = self.list_checkpoints()
+        if not ckpts:
+            return None
+        ckpt_dir = os.path.join(self.base_dir, ckpts[-1])
+        with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+            manifest = json.load(f)
+        with np.load(os.path.join(ckpt_dir, "leaves.npz")) as z:
+            host_leaves = [z[f"leaf_{i}"] for i in range(manifest["num_leaves"])]
+        t_leaves, treedef = jax.tree_util.tree_flatten(template_carry)
+        if len(t_leaves) != len(host_leaves):
+            raise ValueError(
+                f"checkpoint has {len(host_leaves)} leaves, template has {len(t_leaves)}")
+        restored = []
+        for host, tmpl in zip(host_leaves, t_leaves):
+            if hasattr(tmpl, "sharding"):
+                restored.append(jax.device_put(host, tmpl.sharding))
+            else:
+                restored.append(host)
+        return jax.tree_util.tree_unflatten(treedef, restored), manifest["epoch"]

@@ -1,0 +1,153 @@
+"""Bounded iteration driver.
+
+Ref parity map:
+- ``Iterations.iterate_bounded_streams_until_termination``
+  (Iterations.java:149) → :func:`iterate_bounded`.
+- ``IterationBody.process`` (IterationBody.java:54) → the ``body`` callable:
+  ``body(carry, epoch) -> carry`` traced once and compiled.
+- ``IterationListener.onEpochWatermarkIncremented / onIterationTerminated``
+  → :class:`IterationListener` callbacks (host mode).
+- Termination (SharedProgressAligner.java:277-292 + TerminateOnMaxIterOrTol)
+  → ``max_iter`` bound plus an optional ``terminate`` predicate on the carry
+  (tol comparison, empty-round vote, ...), evaluated on device.
+- ALL_ROUND vs PER_ROUND operator lifecycles (IterationConfig) → carry state
+  persists across rounds (all-round) vs ``per_round_init`` resetting part of
+  the carry each epoch (per-round).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Carry = Any
+Body = Callable[[Carry, jnp.ndarray], Carry]
+Terminate = Callable[[Carry, jnp.ndarray], jnp.ndarray]  # -> bool scalar
+
+
+@dataclasses.dataclass
+class IterationConfig:
+    """Ref: iteration/IterationConfig.java + our driver knobs."""
+
+    #: "device": one jitted lax.while_loop — zero host round-trips; fastest.
+    #: "host": python loop over a jitted round — enables listeners,
+    #: checkpoints and data-dependent host logic between rounds.
+    mode: str = "device"
+
+    #: host mode: checkpoint every N epochs (0 = never).
+    checkpoint_interval: int = 0
+    checkpoint_manager: Optional[Any] = None
+
+    #: host mode: reset part of the carry each round (PER_ROUND lifecycle).
+    per_round_init: Optional[Callable[[Carry, int], Carry]] = None
+
+
+class IterationListener:
+    """Ref: iteration/IterationListener.java."""
+
+    def on_epoch_watermark_incremented(self, epoch: int, carry: Carry) -> None:
+        pass
+
+    def on_iteration_terminated(self, carry: Carry) -> None:
+        pass
+
+
+def iterate_bounded(initial_carry: Carry,
+                    body: Body,
+                    max_iter: int,
+                    terminate: Optional[Terminate] = None,
+                    config: IterationConfig = None,
+                    listeners: Sequence[IterationListener] = ()) -> Carry:
+    """Run ``body`` for up to ``max_iter`` epochs; stop early when
+    ``terminate(carry, epoch)`` is True. Returns the final carry.
+
+    The carry is an arbitrary pytree and may contain device arrays with any
+    sharding — cached training data sharded over the data axis rides along
+    exactly like the reference's in-loop data cache.
+    """
+    config = config or IterationConfig()
+    if config.mode == "device" and not listeners and config.checkpoint_interval == 0 \
+            and config.per_round_init is None:
+        return _device_loop(initial_carry, body, max_iter, terminate)
+    return _host_loop(initial_carry, body, max_iter, terminate, config, listeners)
+
+
+def _device_loop(initial_carry, body, max_iter, terminate):
+    """Single compiled while_loop: the whole iteration is one XLA program.
+
+    Termination is evaluated *after* each round on the just-completed epoch,
+    matching _host_loop exactly — the two modes must be numerically
+    interchangeable (a listener must never change the result).
+    """
+
+    def cond(state):
+        carry, epoch, stop = state
+        return jnp.logical_and(epoch < max_iter, jnp.logical_not(stop))
+
+    def step(state):
+        carry, epoch, _ = state
+        new_carry = body(carry, epoch)
+        stop = (jnp.asarray(terminate(new_carry, epoch), dtype=bool)
+                if terminate is not None else jnp.asarray(False))
+        return new_carry, epoch + 1, stop
+
+    @jax.jit
+    def run(carry):
+        final_carry, _, _ = jax.lax.while_loop(
+            cond, step, (carry, jnp.int32(0), jnp.asarray(False)))
+        return final_carry
+
+    return run(initial_carry)
+
+
+def _host_loop(initial_carry, body, max_iter, terminate, config, listeners):
+    """Host-driven rounds with listener/checkpoint hooks.
+
+    The jitted round returns (carry, stop) so the only host sync per round is
+    one scalar — the same single-bit exchange as the reference's
+    GloballyAlignedEvent, minus the RPC.
+    """
+
+    @jax.jit
+    def round_fn(carry, epoch):
+        new_carry = body(carry, epoch)
+        stop = (jnp.asarray(terminate(new_carry, epoch), dtype=bool)
+                if terminate is not None else jnp.asarray(False))
+        return new_carry, stop
+
+    carry = initial_carry
+    start_epoch = 0
+    mgr = config.checkpoint_manager
+    if mgr is not None:
+        restored = mgr.restore(carry)
+        if restored is not None:
+            carry, start_epoch = restored
+
+    for epoch in range(start_epoch, max_iter):
+        if config.per_round_init is not None:
+            carry = config.per_round_init(carry, epoch)
+        carry, stop = round_fn(carry, jnp.int32(epoch))
+        for lst in listeners:
+            lst.on_epoch_watermark_incremented(epoch, carry)
+        if mgr is not None and config.checkpoint_interval and \
+                (epoch + 1) % config.checkpoint_interval == 0:
+            mgr.save(carry, epoch + 1)
+        if bool(stop):
+            break
+    for lst in listeners:
+        lst.on_iteration_terminated(carry)
+    return carry
+
+
+class Iterations:
+    """Namespace parity with iteration/Iterations.java."""
+
+    iterate_bounded_streams_until_termination = staticmethod(iterate_bounded)
+
+    @staticmethod
+    def iterate_unbounded_streams(*args, **kwargs):
+        from flink_ml_tpu.iteration.streaming import iterate_unbounded
+        return iterate_unbounded(*args, **kwargs)

@@ -1,0 +1,90 @@
+"""Unbounded (online) runtime.
+
+Ref parity: the pieces of Flink that have no XLA analog and therefore live as
+a small host streaming runtime (SURVEY.md §7 "Hard parts"):
+
+- ``StreamTable`` — an unbounded source: an iterator of host Tables
+  (micro-batches), the equivalent of an unbounded DataStream.
+- ``generate_batches`` — global-batch assembly: re-chunks arbitrary
+  micro-batches into exact ``global_batch_size`` batches, the semantics of
+  ``DataStreamUtils.generateBatchData`` (DataStreamUtils.java:734:
+  countWindowAll(batchSize) → even split → scatter; here the "scatter" is
+  ``shard_batch`` onto the mesh at consume time).
+- ``iterate_unbounded`` — the unbounded iteration loop
+  (Iterations.iterateUnboundedStreams, Iterations.java:123): per batch,
+  update the model carry and emit a versioned model snapshot; model version
+  increments per emission (ref: OnlineLogisticRegression.java
+  CreateLrModelData:235-258).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.common.table import Table
+
+
+class StreamTable:
+    """An unbounded table: iterable of bounded Table chunks."""
+
+    def __init__(self, chunks: Iterable[Table]):
+        self._chunks = chunks
+
+    def __iter__(self) -> Iterator[Table]:
+        return iter(self._chunks)
+
+    @staticmethod
+    def from_table(table: Table, chunk_size: int) -> "StreamTable":
+        """Chop a bounded table into a stream (test/bench fixture; the
+        equivalent of the examples' PeriodicSourceFunction)."""
+        def gen():
+            for start in range(0, table.num_rows, chunk_size):
+                yield table.take(np.arange(start, min(start + chunk_size,
+                                                      table.num_rows)))
+        return StreamTable(gen())
+
+
+def generate_batches(stream: StreamTable, global_batch_size: int,
+                     drop_remainder: bool = True) -> Iterator[Table]:
+    """Re-chunk a stream into exact global batches.
+
+    Ref: DataStreamUtils.generateBatchData (DataStreamUtils.java:734) — the
+    global-batch assembly used by all online trainers. A trailing partial
+    batch is dropped (an unbounded stream never "ends" in the reference;
+    set drop_remainder=False for bounded test fixtures).
+    """
+    buffer: Optional[Table] = None
+    cursor = 0  # consumed prefix of buffer; avoids re-copying the tail per batch
+    for chunk in stream:
+        if buffer is None:
+            buffer, cursor = chunk, 0
+        else:
+            remaining = buffer.take(np.arange(cursor, buffer.num_rows)) \
+                if cursor else buffer
+            buffer, cursor = remaining.concat(chunk), 0
+        while buffer.num_rows - cursor >= global_batch_size:
+            yield buffer.take(np.arange(cursor, cursor + global_batch_size))
+            cursor += global_batch_size
+    if buffer is not None and buffer.num_rows - cursor > 0 and not drop_remainder:
+        yield buffer.take(np.arange(cursor, buffer.num_rows))
+
+
+def iterate_unbounded(initial_model: Any,
+                      batches: Iterable[Any],
+                      step: Callable[[Any, Any], Any],
+                      on_model: Optional[Callable[[Any, int], None]] = None,
+                      initial_version: int = 0) -> Iterator[Tuple[Any, int]]:
+    """Unbounded iteration: fold ``step`` over batches, yielding
+    (model_carry, version) after every batch — the feedback edge of
+    Iterations.iterateUnboundedStreams as a host generator.
+    """
+    model = initial_model
+    version = initial_version
+    for batch in batches:
+        model = step(model, batch)
+        version += 1
+        if on_model is not None:
+            on_model(model, version)
+        yield model, version

@@ -1,0 +1,23 @@
+"""Linear algebra primitives.
+
+Capability parity with flink-ml-servable-core/.../ml/linalg/ (BLAS.java:30-179,
+DenseVector/SparseVector/DenseMatrix, Vectors factory, VectorWithNorm) plus the
+binary wire codec of linalg/typeinfo/*Serializer.java.
+
+Design: host-side ``DenseVector``/``SparseVector`` are thin numpy wrappers used
+at API boundaries (Tables, model data, servables). The compute path never loops
+over these objects — algorithms stack them into batched ``jnp`` arrays and run
+compiled XLA (see flink_ml_tpu.ops): on TPU the BLAS layer *is* XLA.
+"""
+
+from flink_ml_tpu.linalg import blas  # noqa: F401
+from flink_ml_tpu.linalg.vectors import (  # noqa: F401
+    DenseMatrix,
+    DenseVector,
+    SparseVector,
+    Vector,
+    Vectors,
+    VectorWithNorm,
+    stack_vectors,
+)
+from flink_ml_tpu.linalg.distance import DistanceMeasure  # noqa: F401

@@ -1,0 +1,89 @@
+"""Distance measures.
+
+Ref parity: flink-ml-servable-core/.../common/distance/DistanceMeasure.java
+(+ Euclidean/Manhattan/Cosine implementations): ``distance(a, b)`` and
+``find_closest(centroids, point)``.
+
+TPU-first addition: every measure provides a **batched pairwise kernel**
+``pairwise(X, C) -> (n, k)`` on jnp arrays. Euclidean and cosine lower to a
+single (n,d)x(d,k) matmul — this is what puts KMeans/KNN on the MXU instead
+of a per-point scan (the reference's hot loop, KMeans.java:214+).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from flink_ml_tpu.linalg.vectors import Vector, VectorWithNorm
+
+
+class DistanceMeasure:
+    """Pluggable distance; instances are stateless singletons by name."""
+
+    NAME = None
+    _registry = {}
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.NAME:
+            DistanceMeasure._registry[cls.NAME] = cls()
+
+    @staticmethod
+    def get_instance(name: str) -> "DistanceMeasure":
+        try:
+            return DistanceMeasure._registry[name]
+        except KeyError:
+            raise ValueError(f"Unknown distance measure {name!r}; "
+                             f"choose from {sorted(DistanceMeasure._registry)}")
+
+    # -- host scalar path (servable parity) ---------------------------------
+    def distance(self, a, b) -> float:
+        a = a.vector.to_array() if isinstance(a, VectorWithNorm) else (
+            a.to_array() if isinstance(a, Vector) else np.asarray(a))
+        b = b.vector.to_array() if isinstance(b, VectorWithNorm) else (
+            b.to_array() if isinstance(b, Vector) else np.asarray(b))
+        return float(self.pairwise(a[None, :], b[None, :])[0, 0])
+
+    def find_closest(self, centroids, point) -> int:
+        """Index of the closest centroid (ref: DistanceMeasure.findClosest)."""
+        c = np.stack([x.vector.to_array() if isinstance(x, VectorWithNorm)
+                      else (x.to_array() if isinstance(x, Vector) else np.asarray(x))
+                      for x in centroids])
+        p = point.vector.to_array() if isinstance(point, VectorWithNorm) else (
+            point.to_array() if isinstance(point, Vector) else np.asarray(point))
+        return int(np.argmin(np.asarray(self.pairwise(p[None, :], c))[0]))
+
+    # -- batched device path -------------------------------------------------
+    def pairwise(self, x, c):
+        """(n, d), (k, d) → (n, k) distances. jnp-traceable."""
+        raise NotImplementedError
+
+
+class EuclideanDistanceMeasure(DistanceMeasure):
+    NAME = "euclidean"
+
+    def pairwise(self, x, c):
+        # ||x - c||² = ||x||² − 2 x·cᵀ + ||c||² : one MXU matmul + rank-1 adds.
+        x2 = jnp.sum(x * x, axis=-1, keepdims=True)
+        c2 = jnp.sum(c * c, axis=-1)[None, :]
+        cross = x @ c.T
+        sq = jnp.maximum(x2 - 2.0 * cross + c2, 0.0)
+        return jnp.sqrt(sq)
+
+
+class ManhattanDistanceMeasure(DistanceMeasure):
+    NAME = "manhattan"
+
+    def pairwise(self, x, c):
+        return jnp.sum(jnp.abs(x[:, None, :] - c[None, :, :]), axis=-1)
+
+
+class CosineDistanceMeasure(DistanceMeasure):
+    NAME = "cosine"
+
+    def pairwise(self, x, c):
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+        cn = c / jnp.maximum(jnp.linalg.norm(c, axis=-1, keepdims=True), 1e-12)
+        return 1.0 - xn @ cn.T

@@ -1,0 +1,26 @@
+"""Device mesh + collectives.
+
+Ref parity: the reference's distributed substrate — chunked all-reduce
+(flink-ml-core/.../common/datastream/AllReduceImpl.java:54), broadcast
+variables (BroadcastUtils.java:65), and Flink's Netty shuffle transport —
+replaced by a jax.sharding.Mesh with XLA collectives over ICI/DCN.
+"""
+
+from flink_ml_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    create_mesh,
+    default_mesh,
+    local_device_count,
+    set_default_mesh,
+)
+from flink_ml_tpu.parallel.collective import (  # noqa: F401
+    all_gather,
+    all_reduce_max,
+    all_reduce_mean,
+    all_reduce_sum,
+    broadcast_from,
+    shard_batch,
+    replicate,
+    termination_vote,
+)

@@ -1,0 +1,91 @@
+"""Collectives + sharding helpers.
+
+Ref parity (flink-ml-core):
+- ``all_reduce_sum`` ≙ AllReduceImpl.allReduceSum (AllReduceImpl.java:71-102):
+  the reference hand-rolls reduce-scatter + all-gather out of 4 KB chunks and
+  TCP shuffles; here it is a single XLA ``psum`` lowered to an ICI all-reduce.
+- ``broadcast_from`` / ``replicate`` ≙ BroadcastUtils.withBroadcastStream
+  (BroadcastUtils.java:65): broadcast variables become replicated shardings —
+  XLA inserts the all-gather; no caching/blocking operator is needed.
+- ``termination_vote`` ≙ SharedProgressAligner.EpochStatus.isTerminated
+  (SharedProgressAligner.java:277-292): the coordinator's "all subtasks
+  reported, zero records this round" vote becomes a psum of per-shard counts.
+
+The in-axis functions are for use inside ``shard_map``/``pjit`` bodies; the
+host-level helpers (``shard_batch``) place host arrays onto the mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flink_ml_tpu.parallel.mesh import DATA_AXIS
+
+
+# -- in-axis collectives (inside shard_map / with named axes) ---------------
+
+def all_reduce_sum(x, axis_name: str = DATA_AXIS):
+    """Sum across the mesh axis (ref: AllReduceImpl.java:54 allReduceSum)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def all_reduce_mean(x, axis_name: str = DATA_AXIS):
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_reduce_max(x, axis_name: str = DATA_AXIS):
+    return jax.lax.pmax(x, axis_name)
+
+
+def all_gather(x, axis_name: str = DATA_AXIS, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def broadcast_from(x, src: int = 0, axis_name: str = DATA_AXIS):
+    """Broadcast shard ``src``'s value to all shards (ref: .broadcast() edges).
+
+    Implemented as a masked psum so it stays a single ICI collective.
+    """
+    idx = jax.lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis_name)
+
+
+def termination_vote(local_count, axis_name: str = DATA_AXIS):
+    """True iff the global count is zero — the reference coordinator's
+    termination rule (SharedProgressAligner.java:277-292) as one psum."""
+    total = jax.lax.psum(local_count, axis_name)
+    return total == 0
+
+
+# -- host-level placement ----------------------------------------------------
+
+def shard_batch(mesh: Mesh, array, axis_name: str = DATA_AXIS):
+    """Place a host array on the mesh, sharded on dim 0 (the batch dim).
+
+    Equivalent of the reference scattering a global batch over subtasks
+    (DataStreamUtils.generateBatchData / partitionCustom). Pads dim 0 up to a
+    multiple of the axis size with zeros; callers track true counts (padding
+    contributes zero weight to every reduction in this framework).
+    Returns (device_array, original_length).
+    """
+    array = np.asarray(array)
+    n_shards = mesh.shape[axis_name]
+    n = array.shape[0]
+    rem = (-n) % n_shards
+    if rem:
+        pad = np.zeros((rem,) + array.shape[1:], dtype=array.dtype)
+        array = np.concatenate([array, pad], axis=0)
+    spec = P(axis_name, *([None] * (array.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    return jax.device_put(array, sharding), n
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate a pytree across the whole mesh (broadcast-variable parity)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)

@@ -1,0 +1,53 @@
+"""Mesh construction.
+
+The Flink-subtask ≙ TPU-core mapping lives here (SURVEY.md §7 layer 3): the
+reference's "parallelism" knob becomes the size of the ``data`` mesh axis.
+Single-slice meshes ride ICI; multi-slice/multi-host meshes extend over DCN
+via jax.distributed — same code path, the mesh just gets bigger.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"    # data parallelism (the reference's only training parallelism)
+MODEL_AXIS = "model"  # tensor/model parallelism (TPU-native bonus axis)
+
+_default_mesh: Optional[Mesh] = None
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def create_mesh(shape: Sequence[int] = None,
+                axis_names: Sequence[str] = (DATA_AXIS,),
+                devices=None) -> Mesh:
+    """Create a mesh over the given devices (default: all of them).
+
+    ``create_mesh()`` → 1-D data mesh over every device.
+    ``create_mesh((4, 2), ("data", "model"))`` → 2-D mesh.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def default_mesh() -> Mesh:
+    """Process-wide default mesh (lazily: all devices on one data axis)."""
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = create_mesh()
+    return _default_mesh
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _default_mesh
+    _default_mesh = mesh

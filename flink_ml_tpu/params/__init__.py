@@ -1,0 +1,38 @@
+"""Typed hyperparameter system.
+
+Capability parity with the reference's param package
+(flink-ml-servable-core/.../ml/param/Param.java:30, WithParams.java,
+ParamValidators.java): name-keyed typed params with descriptions, defaults,
+validators and a JSON round-trip. This system is load-bearing — save/load
+metadata, the benchmark CLI's JSON configs and the Python-API completeness
+test all key off it.
+
+Design differences from the reference (deliberate, Python-idiomatic):
+- ``Param`` doubles as a descriptor, so ``stage.max_iter`` reads the value
+  and ``stage.set(Stage.MAX_ITER, v)`` / ``stage.set_max_iter(v)`` both work.
+- snake_case attribute names map to the reference's camelCase param names so
+  saved metadata JSON is interoperable in spirit (same keys).
+"""
+
+from flink_ml_tpu.params.param import (  # noqa: F401
+    ArrayArrayParam,
+    ArrayParam,
+    BooleanParam,
+    FloatArrayArrayParam,
+    FloatArrayParam,
+    FloatParam,
+    IntArrayParam,
+    IntParam,
+    LongArrayParam,
+    LongParam,
+    Param,
+    ParamValidator,
+    ParamValidators,
+    StringArrayArrayParam,
+    StringArrayParam,
+    StringParam,
+    VectorParam,
+    WindowsParam,
+    WithParams,
+)
+from flink_ml_tpu.params.shared import *  # noqa: F401,F403

@@ -1,0 +1,104 @@
+"""Stage persistence.
+
+Ref parity: flink-ml-core/.../util/ReadWriteUtils.java — ``saveMetadata:89``
+(JSON with className/timestamp/paramMap), ``savePipeline:121``,
+``loadStage:268`` (reflective static ``load``), ``saveModelData:298`` /
+``loadModelData:317`` (model data files under <path>/data).
+
+Layout on disk (interoperable in spirit with the reference's):
+    <path>/metadata.json          {"className", "timestamp", "paramMap", "extra"}
+    <path>/data/<name>.npz        numeric model arrays
+    <path>/data/<name>.json       non-numeric model data
+    <path>/stages/<i>/...         nested stages (Pipeline/Graph)
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+
+def _class_path(obj_or_cls) -> str:
+    cls = obj_or_cls if isinstance(obj_or_cls, type) else type(obj_or_cls)
+    return f"{cls.__module__}.{cls.__qualname__}"
+
+
+def load_class(path: str):
+    module, _, name = path.rpartition(".")
+    mod = importlib.import_module(module)
+    obj = mod
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def save_metadata(stage, path: str, extra: Dict[str, Any] = None) -> None:
+    """Ref: ReadWriteUtils.saveMetadata:89."""
+    os.makedirs(path, exist_ok=True)
+    meta = {
+        "className": _class_path(stage),
+        "timestamp": int(time.time() * 1000),
+        "paramMap": stage.params_to_json(),
+        "extra": extra or {},
+    }
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+
+
+def load_metadata(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, "metadata.json")) as f:
+        return json.load(f)
+
+
+def load_stage(path: str):
+    """Instantiate the saved class and restore params (ref: loadStage:268).
+
+    Dispatches to the class's own ``load`` if it overrides the default
+    (Pipeline/Model classes restore nested state/model data there).
+    """
+    meta = load_metadata(path)
+    cls = load_class(meta["className"])
+    return cls.load(path)
+
+
+def load_stage_params(path: str):
+    """Instantiate + params only — helper for custom ``load`` overrides."""
+    meta = load_metadata(path)
+    cls = load_class(meta["className"])
+    stage = cls()
+    stage.params_from_json(meta["paramMap"])
+    return stage, meta
+
+
+def save_model_arrays(path: str, name: str, arrays: Dict[str, np.ndarray]) -> None:
+    """Numeric model data under <path>/data (ref: saveModelData:298)."""
+    data_dir = os.path.join(path, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    np.savez(os.path.join(data_dir, name + ".npz"),
+             **{k: np.asarray(v) for k, v in arrays.items()})
+
+
+def load_model_arrays(path: str, name: str) -> Dict[str, np.ndarray]:
+    with np.load(os.path.join(path, "data", name + ".npz"), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def save_model_json(path: str, name: str, data: Any) -> None:
+    data_dir = os.path.join(path, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    with open(os.path.join(data_dir, name + ".json"), "w") as f:
+        json.dump(data, f)
+
+
+def load_model_json(path: str, name: str) -> Any:
+    with open(os.path.join(path, "data", name + ".json")) as f:
+        return json.load(f)
+
+
+def stage_path(path: str, index: int) -> str:
+    return os.path.join(path, "stages", str(index))

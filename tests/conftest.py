@@ -1,0 +1,35 @@
+"""Test env: simulate an 8-device mesh on CPU.
+
+The TPU analog of the reference's MiniCluster test strategy (SURVEY.md §4):
+multi-node is simulated by multi-device parallelism inside one process via
+XLA's host-platform device-count flag. Must run before jax initializes.
+"""
+
+import os
+
+# Force CPU: the environment presets JAX_PLATFORMS=axon (one real TPU chip)
+# and a sitecustomize imports jax before pytest loads this file, so the env
+# var alone is too late — update jax config directly.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def mesh8():
+    from flink_ml_tpu.parallel import create_mesh
+    return create_mesh()

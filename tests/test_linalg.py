@@ -1,0 +1,144 @@
+"""Linalg tests vs numpy oracles (ref: BLASTest.java, vector serializer tests)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.linalg import (
+    DenseMatrix,
+    DenseVector,
+    DistanceMeasure,
+    SparseVector,
+    Vector,
+    Vectors,
+    blas,
+)
+
+
+def test_dense_vector_basics():
+    v = Vectors.dense(1.0, 2.0, 3.0)
+    assert v.size == 3
+    assert v.get(1) == 2.0
+    assert list(v) == [1.0, 2.0, 3.0]
+    assert Vectors.dense([1.0, 2.0, 3.0]) == v
+    w = v.clone()
+    w.set(0, 9.0)
+    assert v.get(0) == 1.0
+
+
+def test_sparse_vector_basics():
+    s = Vectors.sparse(5, [3, 1], [30.0, 10.0])
+    # indices get sorted
+    assert list(s.indices) == [1, 3]
+    assert s.get(1) == 10.0 and s.get(3) == 30.0 and s.get(0) == 0.0
+    np.testing.assert_array_equal(s.to_array(), [0, 10, 0, 30, 0])
+    d = s.to_dense()
+    assert isinstance(d, DenseVector)
+    assert d.to_sparse() == s
+    with pytest.raises(ValueError):
+        Vectors.sparse(2, [5], [1.0])
+
+
+def test_vector_wire_codec():
+    for v in (Vectors.dense(1.5, -2.0), Vectors.sparse(7, [0, 6], [1.0, 2.0])):
+        round_tripped = Vector.from_bytes(v.to_bytes())
+        assert round_tripped == v
+
+
+def test_dense_matrix():
+    m = DenseMatrix(2, 3, [1, 2, 3, 4, 5, 6])
+    assert m.get(1, 2) == 6.0
+    assert m.num_rows == 2 and m.num_cols == 3
+    b = DenseMatrix.from_bytes(m.to_bytes())
+    assert b == m
+
+
+def test_blas_ops(rng):
+    x = DenseVector(rng.normal(size=16))
+    y = DenseVector(rng.normal(size=16))
+    xa, ya = x.to_array().copy(), y.to_array().copy()
+
+    assert blas.asum(x) == pytest.approx(np.abs(xa).sum())
+    assert blas.dot(x, y) == pytest.approx(xa @ ya)
+    assert blas.norm2(x) == pytest.approx(np.linalg.norm(xa))
+    assert blas.norm(x, 1) == pytest.approx(np.abs(xa).sum())
+    assert blas.norm(x, np.inf) == pytest.approx(np.abs(xa).max())
+
+    blas.axpy(2.0, x, y)
+    np.testing.assert_allclose(y.to_array(), ya + 2.0 * xa)
+
+    # axpy with slice length k (ref: BLAS.java:41)
+    y2 = DenseVector(ya.copy())
+    blas.axpy(1.0, x, y2, k=4)
+    np.testing.assert_allclose(y2.to_array()[:4], ya[:4] + xa[:4])
+    np.testing.assert_allclose(y2.to_array()[4:], ya[4:])
+
+    blas.scal(0.5, x)
+    np.testing.assert_allclose(x.to_array(), 0.5 * xa)
+
+
+def test_blas_sparse(rng):
+    s = Vectors.sparse(8, [1, 5], [2.0, 3.0])
+    d = DenseVector(np.arange(8.0))
+    assert blas.dot(s, d) == pytest.approx(2.0 * 1 + 3.0 * 5)
+    assert blas.dot(d, s) == pytest.approx(2.0 * 1 + 3.0 * 5)
+    s2 = Vectors.sparse(8, [5, 7], [10.0, 1.0])
+    assert blas.dot(s, s2) == pytest.approx(30.0)
+
+    y = DenseVector(np.ones(8))
+    blas.axpy(2.0, s, y)
+    np.testing.assert_allclose(y.to_array(),
+                               [1, 5, 1, 1, 1, 7, 1, 1])
+
+    # h_dot in place on dense y
+    y = DenseVector(np.full(8, 2.0))
+    blas.h_dot(s, y)
+    np.testing.assert_allclose(y.to_array(), [0, 4, 0, 0, 0, 6, 0, 0])
+
+
+def test_gemv(rng):
+    m = DenseMatrix(3, 4, rng.normal(size=(3, 4)))
+    x = DenseVector(rng.normal(size=4))
+    y = DenseVector(np.zeros(3))
+    blas.gemv(2.0, m, False, x, y)
+    np.testing.assert_allclose(y.to_array(), 2.0 * (m.to_array() @ x.to_array()))
+    # transposed
+    x3 = DenseVector(rng.normal(size=3))
+    y4 = DenseVector(np.ones(4))
+    blas.gemv(1.0, m, True, x3, y4, beta=0.5)
+    np.testing.assert_allclose(
+        y4.to_array(), m.to_array().T @ x3.to_array() + 0.5)
+
+
+@pytest.mark.parametrize("name", ["euclidean", "manhattan", "cosine"])
+def test_distance_measures(name, rng):
+    dm = DistanceMeasure.get_instance(name)
+    a, b = rng.normal(size=8), rng.normal(size=8)
+    oracle = {
+        "euclidean": np.linalg.norm(a - b),
+        "manhattan": np.abs(a - b).sum(),
+        "cosine": 1 - a @ b / (np.linalg.norm(a) * np.linalg.norm(b)),
+    }[name]
+    assert dm.distance(Vectors.dense(a), Vectors.dense(b)) == pytest.approx(
+        oracle, rel=1e-5)
+
+
+def test_find_closest(rng):
+    dm = DistanceMeasure.get_instance("euclidean")
+    centroids = [Vectors.dense(0.0, 0.0), Vectors.dense(10.0, 10.0)]
+    assert dm.find_closest(centroids, Vectors.dense(1.0, 1.0)) == 0
+    assert dm.find_closest(centroids, Vectors.dense(9.0, 9.0)) == 1
+
+
+def test_pairwise_batched(rng):
+    import jax.numpy as jnp
+    x = rng.normal(size=(5, 3)).astype(np.float32)
+    c = rng.normal(size=(4, 3)).astype(np.float32)
+    dm = DistanceMeasure.get_instance("euclidean")
+    got = np.asarray(dm.pairwise(jnp.asarray(x), jnp.asarray(c)))
+    want = np.linalg.norm(x[:, None, :] - c[None, :, :], axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_unknown_distance():
+    with pytest.raises(ValueError):
+        DistanceMeasure.get_instance("chebyshev")

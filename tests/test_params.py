@@ -1,0 +1,108 @@
+"""Param system tests (ref test model: ParamTests in servable-core + stage
+default-param assertions in every algorithm test)."""
+
+import pytest
+
+from flink_ml_tpu.params import (
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasMaxIter,
+    HasReg,
+    HasSeed,
+    HasTol,
+    IntParam,
+    ParamValidators,
+    StringParam,
+    WithParams,
+)
+
+
+class DummyStage(HasFeaturesCol, HasMaxIter, HasReg, HasTol, HasSeed,
+                 HasGlobalBatchSize):
+    K = IntParam("k", "Number of things.", 2, ParamValidators.gt(0))
+    MODE = StringParam("mode", "A mode.", "auto",
+                       ParamValidators.in_array("auto", "manual"))
+
+
+def test_defaults():
+    s = DummyStage()
+    assert s.get(DummyStage.K) == 2
+    assert s.k == 2
+    assert s.max_iter == 20
+    assert s.features_col == "features"
+    assert s.reg == 0.0
+    assert s.tol == 1e-6
+    assert s.global_batch_size == 32
+    assert s.seed is None
+
+
+def test_set_get_fluent():
+    s = DummyStage().set_k(5).set_max_iter(7).set_features_col("f")
+    assert s.k == 5 and s.max_iter == 7 and s.features_col == "f"
+    # descriptor write
+    s.k = 9
+    assert s.get(DummyStage.K) == 9
+    # getter sugar
+    assert s.get_k() == 9
+
+
+def test_constructor_kwargs():
+    s = DummyStage(k=4, max_iter=3)
+    assert s.k == 4 and s.max_iter == 3
+
+
+def test_validation():
+    s = DummyStage()
+    with pytest.raises(ValueError):
+        s.set_k(0)
+    with pytest.raises(ValueError):
+        s.set_mode("bogus")
+    with pytest.raises(ValueError):
+        s.set_max_iter(-1)
+    with pytest.raises(ValueError):
+        DummyStage(not_a_param=1)
+
+
+def test_coercion():
+    s = DummyStage()
+    s.set_k(3.0)
+    assert s.k == 3 and isinstance(s.k, int)
+
+
+def test_param_map_covers_mro():
+    names = {p.name for p in DummyStage.params()}
+    assert {"k", "mode", "featuresCol", "maxIter", "reg", "tol", "seed",
+            "globalBatchSize"} <= names
+    pm = DummyStage().get_param_map()
+    assert pm["maxIter"] == 20
+
+
+def test_json_round_trip():
+    s = DummyStage().set_k(11).set_mode("manual").set_tol(0.5)
+    blob = s.params_to_json()
+    s2 = DummyStage()
+    s2.params_from_json(blob)
+    assert s2.k == 11 and s2.mode == "manual" and s2.tol == 0.5
+    # unknown params in the blob are ignored (fwd compat)
+    s2.params_from_json({"unknownFutureParam": 1})
+
+
+def test_snake_camel_mapping():
+    s = DummyStage()
+    assert s.get_param("globalBatchSize") is s.get_param("global_batch_size")
+
+
+def test_windows_param_json():
+    from flink_ml_tpu.common.window import CountTumblingWindows, GlobalWindows
+    from flink_ml_tpu.params import HasWindows
+
+    class W(HasWindows):
+        pass
+
+    w = W()
+    assert isinstance(w.windows, GlobalWindows)
+    w.set_windows(CountTumblingWindows.of(16))
+    blob = w.params_to_json()
+    w2 = W()
+    w2.params_from_json(blob)
+    assert w2.windows == CountTumblingWindows.of(16)
