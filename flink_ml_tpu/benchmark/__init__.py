@@ -1,0 +1,24 @@
+"""Benchmark harness.
+
+Ref parity: flink-ml-benchmark — JSON-config-driven CLI (Benchmark.java:41,
+BenchmarkUtils.java:47) + param-driven data generators (datagenerator/**).
+Config files are format-compatible with the reference's
+src/main/resources/*.json (same version/stage/inputData/modelData layout,
+reference Java class names accepted and mapped to our stages).
+"""
+
+from flink_ml_tpu.benchmark.datagen import (  # noqa: F401
+    DenseVectorArrayGenerator,
+    DenseVectorGenerator,
+    DoubleGenerator,
+    LabeledPointWithWeightGenerator,
+    RandomStringArrayGenerator,
+    RandomStringGenerator,
+    resolve_generator,
+)
+from flink_ml_tpu.benchmark.runner import (  # noqa: F401
+    load_config,
+    main,
+    run_benchmark,
+    run_benchmarks,
+)

@@ -1,0 +1,183 @@
+"""Param-driven random data generators.
+
+Ref parity: flink-ml-benchmark/.../datagenerator/common/*.java —
+DenseVectorGenerator, DenseVectorArrayGenerator, LabeledPointWithWeightGenerator
+(featureArity/labelArity semantics, LabeledPointWithWeightGenerator.java:50-75),
+RandomStringGenerator, RandomStringArrayGenerator, DoubleGenerator,
+KMeansModelDataGenerator. Vectorized numpy instead of per-row loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.common.table import Table, as_dense_vector_column
+from flink_ml_tpu.params.param import (
+    ArrayArrayParam,
+    IntParam,
+    ParamValidators,
+    WithParams,
+)
+from flink_ml_tpu.params.shared import HasSeed
+
+_GENERATORS = {}
+
+
+def _register(cls):
+    _GENERATORS[cls.__name__] = cls
+    return cls
+
+
+def resolve_generator(class_name: str):
+    """Accepts our class name or the reference's fully-qualified Java name."""
+    short = class_name.rsplit(".", 1)[-1]
+    try:
+        return _GENERATORS[short]
+    except KeyError:
+        raise ValueError(f"unknown data generator {class_name!r}; "
+                         f"known: {sorted(_GENERATORS)}")
+
+
+class InputTableGenerator(HasSeed):
+    """Base: numValues rows, named columns (ref: InputTableGenerator.java)."""
+
+    COL_NAMES = ArrayArrayParam(
+        "colNames", "Column names of the generated tables.", None)
+    NUM_VALUES = IntParam(
+        "numValues", "Number of data rows to generate.", 10,
+        ParamValidators.gt(0))
+
+    def _rng(self):
+        return np.random.default_rng(self.get_seed_or_default())
+
+    def _col_names(self, table_idx=0):
+        names = self.col_names
+        if names is None:
+            raise ValueError(f"{type(self).__name__} needs colNames")
+        return list(names[table_idx])
+
+    def get_data(self) -> Table:
+        raise NotImplementedError
+
+
+class HasVectorDim(WithParams):
+    VECTOR_DIM = IntParam("vectorDim", "Dimension of generated vectors.", 1,
+                          ParamValidators.gt(0))
+
+
+class HasArraySize(WithParams):
+    ARRAY_SIZE = IntParam("arraySize", "Size of generated arrays.", 1,
+                          ParamValidators.gt(0))
+
+
+class HasNumDistinctValues(WithParams):
+    NUM_DISTINCT_VALUES = IntParam(
+        "numDistinctValues", "Number of distinct values of the data.", 10,
+        ParamValidators.gt(0))
+
+
+@_register
+class DenseVectorGenerator(InputTableGenerator, HasVectorDim):
+    """Uniform [0,1) dense vectors (ref: DenseVectorGenerator.java:34-53)."""
+
+    def get_data(self) -> Table:
+        values = self._rng().random((self.num_values, self.vector_dim),
+                                    dtype=np.float64)
+        (name,) = self._col_names()
+        # raw (n, d) array IS a vector column — no per-row objects
+        return Table.from_columns(**{name: values})
+
+
+@_register
+class DenseVectorArrayGenerator(InputTableGenerator, HasVectorDim,
+                                HasArraySize):
+    def get_data(self) -> Table:
+        rng = self._rng()
+        (name,) = self._col_names()
+        col = np.empty(self.num_values, dtype=object)
+        for i in range(self.num_values):
+            col[i] = [  # array of DenseVectors per row
+                v for v in as_dense_vector_column(
+                    rng.random((self.array_size, self.vector_dim)))]
+        return Table.from_columns(**{name: col})
+
+
+@_register
+class LabeledPointWithWeightGenerator(InputTableGenerator, HasVectorDim):
+    """Ref: LabeledPointWithWeightGenerator.java — featureArity/labelArity:
+    0 → continuous double in [0,1); positive k → integer in [0, k)."""
+
+    FEATURE_ARITY = IntParam(
+        "featureArity", "Arity of each feature (0 = continuous).", 2,
+        ParamValidators.gt_eq(0))
+    LABEL_ARITY = IntParam(
+        "labelArity", "Arity of label (0 = continuous).", 2,
+        ParamValidators.gt_eq(0))
+
+    def get_data(self) -> Table:
+        rng = self._rng()
+        n, d = self.num_values, self.vector_dim
+
+        def values(arity, shape):
+            if arity == 0:
+                return rng.random(shape, dtype=np.float64)
+            return np.floor(rng.random(shape) * arity)
+
+        features = values(self.feature_arity, (n, d))
+        label = values(self.label_arity, (n,))
+        weight = rng.random(n, dtype=np.float64)
+        f_name, l_name, w_name = self._col_names()
+        return Table.from_columns(**{
+            f_name: features, l_name: label, w_name: weight})
+
+
+@_register
+class RandomStringGenerator(InputTableGenerator, HasNumDistinctValues):
+    """Strings drawn from numDistinctValues distinct tokens
+    (ref: RandomStringGenerator.java)."""
+
+    def get_data(self) -> Table:
+        rng = self._rng()
+        cols = {}
+        for name in self._col_names():
+            ints = rng.integers(0, self.num_distinct_values, self.num_values)
+            cols[name] = np.array([str(v) for v in ints], dtype=object)
+        return Table.from_columns(**cols)
+
+
+@_register
+class RandomStringArrayGenerator(InputTableGenerator, HasNumDistinctValues,
+                                 HasArraySize):
+    def get_data(self) -> Table:
+        rng = self._rng()
+        cols = {}
+        for name in self._col_names():
+            col = np.empty(self.num_values, dtype=object)
+            for i in range(self.num_values):
+                col[i] = [str(v) for v in rng.integers(
+                    0, self.num_distinct_values, self.array_size)]
+            cols[name] = col
+        return Table.from_columns(**cols)
+
+
+@_register
+class DoubleGenerator(InputTableGenerator):
+    def get_data(self) -> Table:
+        rng = self._rng()
+        cols = {name: rng.random(self.num_values, dtype=np.float64)
+                for name in self._col_names()}
+        return Table.from_columns(**cols)
+
+
+@_register
+class KMeansModelDataGenerator(HasSeed, HasVectorDim, HasArraySize):
+    """Random KMeans model data; arraySize = number of centroids
+    (ref: datagenerator/clustering/KMeansModelDataGenerator.java)."""
+
+    def get_data(self) -> Table:
+        rng = np.random.default_rng(self.get_seed_or_default())
+        k = self.array_size
+        centroids = rng.random((k, self.vector_dim))
+        return Table.from_columns(
+            centroid=as_dense_vector_column(centroids),
+            weight=np.ones(k))

@@ -1,0 +1,138 @@
+"""Benchmark runner CLI.
+
+Ref parity: Benchmark.java:41/main:129 + BenchmarkUtils.java:47 — parse a
+JSON config (version 1; named benchmarks each holding stage / inputData /
+optional modelData specs with className + paramMap), instantiate via the
+param system, execute, report per-benchmark results
+{totalTimeMs, inputRecordNum, inputThroughput, outputRecordNum,
+outputThroughput} (BenchmarkUtils.java:130-143). Estimators are timed as
+``fit(input).get_model_data()``; AlgoOperators as ``transform(input)`` —
+same as the reference. Reference Java class names are accepted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+from typing import Dict
+
+from flink_ml_tpu.api.stage import AlgoOperator, Estimator, Model, Stage
+from flink_ml_tpu.benchmark.datagen import resolve_generator
+
+_STAGES: Dict[str, type] = {}
+
+
+def _stage_registry() -> Dict[str, type]:
+    """Short class name → Stage class, discovered from the models package
+    (the reflective instantiation of ParamUtils.instantiateWithParams)."""
+    if _STAGES:
+        return _STAGES
+    import flink_ml_tpu.models as models_pkg
+
+    def walk(cls):
+        for sub in cls.__subclasses__():
+            if (not sub.__name__.startswith("_")
+                    and "Base" not in sub.__name__
+                    and ".models." in sub.__module__):
+                _STAGES[sub.__name__] = sub
+            walk(sub)
+
+    walk(Stage)
+    return _STAGES
+
+
+def resolve_stage(class_name: str) -> type:
+    short = class_name.rsplit(".", 1)[-1]
+    registry = _stage_registry()
+    try:
+        return registry[short]
+    except KeyError:
+        raise ValueError(f"unknown stage {class_name!r}; known: "
+                         f"{sorted(registry)}")
+
+
+def load_config(path: str) -> dict:
+    """Reference configs carry // license comments; strip them."""
+    with open(path) as f:
+        text = f.read()
+    text = re.sub(r"^\s*//.*$", "", text, flags=re.M)
+    config = json.loads(text)
+    if config.pop("version", 1) != 1:
+        raise ValueError("unsupported benchmark config version")
+    return config
+
+
+def run_benchmark(name: str, spec: dict) -> dict:
+    stage = resolve_stage(spec["stage"]["className"])()
+    stage.params_from_json(spec["stage"].get("paramMap", {}))
+
+    gen = resolve_generator(spec["inputData"]["className"])()
+    gen.params_from_json(spec["inputData"].get("paramMap", {}))
+
+    model_gen = None
+    if "modelData" in spec:
+        model_gen = resolve_generator(spec["modelData"]["className"])()
+        model_gen.params_from_json(spec["modelData"].get("paramMap", {}))
+
+    # datagen is part of the measured job in the reference; keep it inside
+    start = time.perf_counter()
+    input_table = gen.get_data()
+    if model_gen is not None:
+        stage.set_model_data(model_gen.get_data())
+
+    if isinstance(stage, Estimator):
+        outputs = stage.fit(input_table).get_model_data()
+    elif isinstance(stage, AlgoOperator):
+        outputs = stage.transform(input_table)
+    else:
+        raise ValueError(f"unsupported stage class {type(stage)}")
+    output_num = sum(t.num_rows for t in outputs)
+    total_ms = (time.perf_counter() - start) * 1000.0
+
+    input_num = gen.num_values
+    return {
+        "totalTimeMs": total_ms,
+        "inputRecordNum": input_num,
+        "inputThroughput": input_num * 1000.0 / total_ms,
+        "outputRecordNum": output_num,
+        "outputThroughput": output_num * 1000.0 / total_ms,
+    }
+
+
+def run_benchmarks(config: dict) -> dict:
+    """One failing benchmark doesn't abort the rest (the reference demo
+    config deliberately includes broken entries)."""
+    results = {}
+    for name, spec in config.items():
+        entry = {}
+        try:
+            entry["stage"] = spec["stage"]
+            entry["inputData"] = spec["inputData"]
+            entry["results"] = run_benchmark(name, spec)
+        except Exception as e:  # noqa: BLE001 — report and continue
+            entry["exception"] = f"{type(e).__name__}: {e}"
+        results[name] = entry
+    return results
+
+
+def main(argv=None) -> int:
+    """CLI parity with bin/benchmark-run.sh <config> [--output-file r.json]."""
+    parser = argparse.ArgumentParser(prog="flink-ml-tpu-benchmark")
+    parser.add_argument("config", help="benchmark config JSON file")
+    parser.add_argument("--output-file", default=None)
+    args = parser.parse_args(argv)
+
+    results = run_benchmarks(load_config(args.config))
+    text = json.dumps(results, indent=2)
+    print(text)
+    if args.output_file:
+        with open(args.output_file, "w") as f:
+            f.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,9 @@ from flink_ml_tpu.linalg.vectors import DenseVector, Vector, stack_vectors
 
 
 def _as_column(values) -> np.ndarray:
+    """Normalize a column. Numeric 2-D arrays are kept as-is — a (n, d) array
+    IS a vector column (row i = vector i); this is the fast path that avoids
+    materializing n DenseVector objects for large tables."""
     if isinstance(values, np.ndarray):
         return values
     values = list(values)
@@ -35,6 +38,8 @@ def _as_column(values) -> np.ndarray:
         for i, v in enumerate(values):
             arr[i] = v
         return arr
+    if arr.ndim == 2 and arr.dtype.kind == "f":
+        return arr  # list of equal-length numeric rows → vector column
     if arr.dtype.kind in "OU" or arr.ndim > 1:
         out = np.empty(len(values), dtype=object)
         for i, v in enumerate(values):

@@ -1,0 +1,9 @@
+"""Algorithm library (ref: flink-ml-lib, SURVEY.md §2.4).
+
+Areas mirror the reference package layout: classification, clustering,
+regression, feature, recommendation, evaluation, stats.
+"""
+
+from flink_ml_tpu.models import classification  # noqa: F401
+from flink_ml_tpu.models import clustering  # noqa: F401
+from flink_ml_tpu.models import regression  # noqa: F401

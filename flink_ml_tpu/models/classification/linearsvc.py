@@ -1,0 +1,34 @@
+"""Linear support vector classifier.
+
+Ref parity: flink-ml-lib/.../classification/linearsvc/LinearSVC.java —
+SGD with HingeLoss; predict rule of LinearSVCModel.java: prediction = 1 iff
+dot ≥ threshold, rawPrediction = dot.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.models.common import LinearEstimatorBase, LinearModelBase
+from flink_ml_tpu.ops.losses import HingeLoss
+from flink_ml_tpu.params.param import FloatParam, WithParams
+
+
+class HasThreshold(WithParams):
+    """Ref: LinearSVCModelParams.THRESHOLD (default 0.0)."""
+    THRESHOLD = FloatParam(
+        "threshold",
+        "Threshold in binary classification applied to rawPrediction.", 0.0)
+
+
+class LinearSVCModel(LinearModelBase, HasThreshold):
+    def _predict_columns(self, dots: np.ndarray) -> dict:
+        return {
+            self.prediction_col: (dots >= self.threshold).astype(np.float64),
+            self.raw_prediction_col: dots,
+        }
+
+
+class LinearSVC(LinearEstimatorBase, HasThreshold):
+    loss = HingeLoss()
+    model_class = LinearSVCModel

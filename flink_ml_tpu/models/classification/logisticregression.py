@@ -1,0 +1,35 @@
+"""Logistic regression (binary).
+
+Ref parity: flink-ml-lib/.../classification/logisticregression/
+LogisticRegression.java:48 (fit:60 — weighted samples → SGD with
+BinaryLogisticLoss; model = coefficient vector) and the predict rule of
+LogisticRegressionModelServable.java:106 (prediction = 1 iff dot ≥ 0,
+rawPrediction = [1-p, p] with p = sigmoid(dot)).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.models.common import (
+    LinearEstimatorBase,
+    LinearModelBase,
+    raw_prediction_vectors,
+)
+from flink_ml_tpu.ops.losses import BinaryLogisticLoss
+from flink_ml_tpu.params.shared import HasMultiClass
+
+
+class LogisticRegressionModel(LinearModelBase, HasMultiClass):
+    def _predict_columns(self, dots: np.ndarray) -> dict:
+        prob = 1.0 - 1.0 / (1.0 + np.exp(dots))
+        return {
+            self.prediction_col: (dots >= 0).astype(np.float64),
+            self.raw_prediction_col: raw_prediction_vectors(
+                np.stack([1.0 - prob, prob], axis=1)),
+        }
+
+
+class LogisticRegression(LinearEstimatorBase, HasMultiClass):
+    loss = BinaryLogisticLoss()
+    model_class = LogisticRegressionModel

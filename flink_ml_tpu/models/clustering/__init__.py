@@ -1,0 +1,4 @@
+from flink_ml_tpu.models.clustering.kmeans import (  # noqa: F401
+    KMeans,
+    KMeansModel,
+)

@@ -1,0 +1,177 @@
+"""K-means clustering (Lloyd's algorithm).
+
+Ref parity: flink-ml-lib/.../clustering/kmeans/{KMeans.java:79,
+KMeansModel.java, KMeansModelData.java, KMeansParams.java}:
+
+- init: k random points sampled from the input (selectRandomCentroids,
+  KMeans.java:96,310);
+- per round: assign every point to the nearest centroid, new centroid =
+  mean of assigned points, model weights = assignment counts
+  (CentroidsUpdateAccumulator + ModelDataGenerator, KMeans.java:200-280);
+- termination: maxIter rounds (TerminateOnMaxIter, KMeans.java:150);
+- predict: nearest-centroid index (KMeansModel.java:105).
+
+TPU design: the whole fit is one compiled SPMD program — points stay sharded
+on device across rounds (the ListStateWithCache equivalent), assignment is a
+batched pairwise-distance matmul on the MXU, the per-round cross-task sync
+(the reference's countWindowAll(parallelism).reduce) is a single psum of
+(k,d) sums + (k,) counts. Deviation from the reference: an empty cluster
+keeps its previous centroid instead of producing NaN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from flink_ml_tpu.api.stage import Estimator, Model
+from flink_ml_tpu.common.table import Table, as_dense_vector_column
+from flink_ml_tpu.linalg.distance import DistanceMeasure
+from flink_ml_tpu.linalg.vectors import DenseVector
+from flink_ml_tpu.parallel.collective import shard_batch
+from flink_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam
+from flink_ml_tpu.params.shared import (
+    HasDistanceMeasure,
+    HasFeaturesCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasSeed,
+)
+from flink_ml_tpu.utils import io as rw
+
+
+class KMeansModelParams(HasDistanceMeasure, HasFeaturesCol, HasPredictionCol):
+    K = IntParam("k", "The max number of clusters to create.", 2,
+                 ParamValidators.gt(1))
+
+
+class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
+    INIT_MODE = StringParam(
+        "initMode", "The initialization algorithm.", "random",
+        ParamValidators.in_array("random"))
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _build_assign_program(measure_name: str):
+    measure = DistanceMeasure.get_instance(measure_name)
+
+    @jax.jit
+    def assign(x, c):
+        return jnp.argmin(measure.pairwise(x, c), axis=1)
+
+    return assign
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
+    """One compiled Lloyd's program per (mesh, measure, maxIter); k and
+    shapes are trace-time static, handled by jit's shape cache."""
+    measure = DistanceMeasure.get_instance(measure_name)
+
+    def per_shard(xl, vl, c0):
+        k = c0.shape[0]
+
+        def cond(state):
+            _, _, epoch = state
+            return epoch < max_iter
+
+        def step(state):
+            centroids, _, epoch = state
+            dists = measure.pairwise(xl, centroids)
+            one_hot = jax.nn.one_hot(jnp.argmin(dists, axis=1), k,
+                                     dtype=xl.dtype) * vl[:, None]
+            packed = jnp.concatenate(
+                [one_hot.T @ xl, jnp.sum(one_hot, axis=0)[:, None]], axis=1)
+            packed = jax.lax.psum(packed, DATA_AXIS)
+            sums, counts = packed[:, :-1], packed[:, -1]
+            new_centroids = jnp.where(
+                counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1),
+                centroids)
+            return new_centroids, counts, epoch + 1
+
+        centroids, counts, _ = jax.lax.while_loop(
+            cond, step, (c0, jnp.zeros((k,), xl.dtype), jnp.int32(0)))
+        return centroids, counts
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        out_specs=(P(), P()), check_vma=False))
+
+
+class KMeansModel(Model, KMeansModelParams):
+    def __init__(self, centroids: Optional[np.ndarray] = None,
+                 weights: Optional[np.ndarray] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.centroids = None if centroids is None else np.asarray(centroids)
+        self.weights = None if weights is None else np.asarray(weights)
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.centroids is None:
+            raise ValueError("KMeansModel has no model data")
+        x = table.vectors(self.features_col)
+        assign = _build_assign_program(self.distance_measure)
+        labels = np.asarray(assign(jnp.asarray(x),
+                                   jnp.asarray(self.centroids, jnp.float32)))
+        return (table.with_column(self.prediction_col,
+                                  labels.astype(np.int64)),)
+
+    # -- model data (ref: KMeansModelData = centroids[] + weights) ----------
+    def set_model_data(self, model_data: Table):
+        cents = model_data.vectors("centroid", dtype=np.float64)
+        self.centroids = cents
+        self.weights = (model_data.scalars("weight", np.float64)
+                        if "weight" in model_data
+                        else np.ones(len(cents)))
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        return (Table.from_columns(
+            centroid=as_dense_vector_column(self.centroids),
+            weight=np.asarray(self.weights, np.float64)),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_arrays(path, "model", {
+            "centroids": self.centroids, "weights": self.weights})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        arrays = rw.load_model_arrays(path, "model")
+        self.centroids, self.weights = arrays["centroids"], arrays["weights"]
+
+
+class KMeans(Estimator, KMeansParams):
+    def fit(self, table: Table) -> KMeansModel:
+        x = table.vectors(self.features_col)
+        n, dim = x.shape
+        k = self.k
+
+        # init: k distinct random input points (ref selectRandomCentroids)
+        rng = np.random.default_rng(self.get_seed_or_default())
+        init = x[rng.choice(n, size=min(k, n), replace=False)].astype(np.float32)
+        if len(init) < k:  # fewer points than clusters: repeat cyclically
+            init = np.resize(init, (k, init.shape[1]))
+
+        mesh = default_mesh()
+        xs, _ = shard_batch(mesh, np.asarray(x, np.float32))
+        valid = np.zeros(xs.shape[0], np.float32)
+        valid[:n] = 1.0  # padded rows must not join any cluster
+        vs, _ = shard_batch(mesh, valid)
+
+        fit = _build_lloyd_program(mesh, self.distance_measure, self.max_iter)
+        centroids, counts = fit(xs, vs, jnp.asarray(init))
+
+        model = KMeansModel(centroids=np.asarray(centroids, np.float64),
+                            weights=np.asarray(counts, np.float64))
+        model.params_from_json(
+            {name: v for name, v in self.params_to_json().items()
+             if model._find_param(name) is not None})
+        return model

@@ -1,0 +1,134 @@
+"""Shared model plumbing: Table↔device extraction and linear-model bases.
+
+Ref parity: the per-algorithm boilerplate of flink-ml-lib (XxxParams +
+Xxx + XxxModel + XxxModelData + serializers) collapses here into two base
+classes; concrete algorithms declare a loss and a prediction rule.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from flink_ml_tpu.api.stage import Estimator, Model
+from flink_ml_tpu.common.table import Table, as_dense_vector_column
+from flink_ml_tpu.linalg.vectors import DenseVector
+from flink_ml_tpu.ops.losses import LossFunc
+from flink_ml_tpu.ops.optimizer import SGD, SGDParams
+from flink_ml_tpu.params.shared import (
+    HasElasticNet,
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRawPredictionCol,
+    HasReg,
+    HasTol,
+    HasWeightCol,
+)
+from flink_ml_tpu.utils import io as rw
+
+
+def extract_labeled_points(stage, table: Table
+                           ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Table → (features (n,d), labels (n,), weights (n,)|None) — the
+    reference's Table→LabeledPointWithWeight map (LogisticRegression.java:72-99)."""
+    x = table.vectors(stage.features_col)
+    y = table.scalars(stage.label_col)
+    w = None
+    if stage.weight_col is not None and stage.weight_col in table:
+        w = table.scalars(stage.weight_col)
+    return x, y, w
+
+
+@jax.jit
+def _dots(features, coeffs):
+    return features @ coeffs
+
+
+class LinearModelParams(HasFeaturesCol, HasPredictionCol):
+    pass
+
+
+class LinearTrainParams(LinearModelParams, HasLabelCol, HasWeightCol,
+                        HasMaxIter, HasReg, HasElasticNet, HasLearningRate,
+                        HasGlobalBatchSize, HasTol, HasRawPredictionCol):
+    pass
+
+
+class LinearModelBase(Model, LinearTrainParams):
+    """A fitted linear model: coefficient vector + a prediction rule."""
+
+    def __init__(self, coefficients: Optional[np.ndarray] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.coefficients = (None if coefficients is None
+                             else np.asarray(coefficients, np.float64))
+
+    # -- prediction rule, overridden per algorithm ---------------------------
+    def _predict_columns(self, dots: np.ndarray) -> dict:
+        raise NotImplementedError
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.coefficients is None:
+            raise ValueError(f"{type(self).__name__} has no model data")
+        x = table.vectors(self.features_col)
+        dots = np.asarray(_dots(jnp.asarray(x),
+                                jnp.asarray(self.coefficients, jnp.float32)),
+                          np.float64)
+        return (table.with_columns(**self._predict_columns(dots)),)
+
+    # -- model data as a Table (ref: XxxModelData POJO + table) -------------
+    def set_model_data(self, model_data: Table):
+        col = model_data.column("coefficient")
+        self.coefficients = col[0].to_array() if col.dtype == object \
+            else np.asarray(col[0])
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        return (Table.from_columns(
+            coefficient=[DenseVector(self.coefficients)]),)
+
+    # -- persistence ---------------------------------------------------------
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_arrays(path, "model", {"coefficient": self.coefficients})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        self.coefficients = rw.load_model_arrays(path, "model")["coefficient"]
+
+
+class LinearEstimatorBase(Estimator, LinearTrainParams):
+    """Shared SGD fit path (ref: LogisticRegression.fit:60 → SGD.optimize)."""
+
+    #: subclass hooks
+    loss: LossFunc = None
+    model_class = None
+
+    def fit(self, table: Table):
+        x, y, w = extract_labeled_points(self, table)
+        params = SGDParams(
+            learning_rate=self.learning_rate,
+            global_batch_size=self.global_batch_size,
+            max_iter=self.max_iter, tol=self.tol, reg=self.reg,
+            elastic_net=self.elastic_net)
+        init = np.zeros(x.shape[1], np.float32)
+        coeffs, _ = SGD(params).optimize(self.loss, init, x, y, w)
+        model = self.model_class(coefficients=coeffs)
+        model.params_from_json(
+            {k: v for k, v in self.params_to_json().items()
+             if model._find_param(k) is not None})
+        return model
+
+
+def prediction_output(table: Table, name: str, values: np.ndarray) -> Table:
+    return table.with_column(name, values)
+
+
+def raw_prediction_vectors(pairs: np.ndarray) -> np.ndarray:
+    """(n, k) float array → object column of DenseVectors for rawPrediction."""
+    return as_dense_vector_column(pairs)

@@ -1,0 +1,4 @@
+from flink_ml_tpu.models.regression.linearregression import (  # noqa: F401
+    LinearRegression,
+    LinearRegressionModel,
+)

@@ -1,0 +1,22 @@
+"""Linear regression.
+
+Ref parity: flink-ml-lib/.../regression/linearregression/LinearRegression.java
+— SGD with LeastSquareLoss; prediction = dot.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.models.common import LinearEstimatorBase, LinearModelBase
+from flink_ml_tpu.ops.losses import LeastSquareLoss
+
+
+class LinearRegressionModel(LinearModelBase):
+    def _predict_columns(self, dots: np.ndarray) -> dict:
+        return {self.prediction_col: dots}
+
+
+class LinearRegression(LinearEstimatorBase):
+    loss = LeastSquareLoss()
+    model_class = LinearRegressionModel

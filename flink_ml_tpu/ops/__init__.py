@@ -1,0 +1,14 @@
+"""Shared numeric kernels: losses, regularization, optimizers.
+
+Ref parity: flink-ml-lib/.../common/{lossfunc,optimizer}/ — the ⚙ rows of
+SURVEY.md §2.4 whose inner loops become compiled XLA here.
+"""
+
+from flink_ml_tpu.ops.losses import (  # noqa: F401
+    BinaryLogisticLoss,
+    HingeLoss,
+    LeastSquareLoss,
+    LossFunc,
+)
+from flink_ml_tpu.ops.regularization import regularize  # noqa: F401
+from flink_ml_tpu.ops.optimizer import SGD, SGDParams  # noqa: F401

@@ -1,0 +1,132 @@
+"""Benchmark harness tests (ref: BenchmarkTest.java, DataGeneratorTest.java)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.benchmark import (
+    DenseVectorGenerator,
+    LabeledPointWithWeightGenerator,
+    RandomStringGenerator,
+    resolve_generator,
+)
+from flink_ml_tpu.benchmark.runner import (
+    load_config,
+    main,
+    resolve_stage,
+    run_benchmark,
+    run_benchmarks,
+)
+
+
+def test_generator_determinism():
+    g1 = DenseVectorGenerator(seed=5, col_names=[["features"]],
+                              num_values=20, vector_dim=3)
+    g2 = DenseVectorGenerator(seed=5, col_names=[["features"]],
+                              num_values=20, vector_dim=3)
+    np.testing.assert_array_equal(g1.get_data().vectors("features"),
+                                  g2.get_data().vectors("features"))
+
+
+def test_labeled_point_generator_arities():
+    g = LabeledPointWithWeightGenerator(
+        seed=1, col_names=[["f", "l", "w"]], num_values=100, vector_dim=4,
+        feature_arity=3, label_arity=2)
+    t = g.get_data()
+    f = t.vectors("f")
+    assert set(np.unique(f)) <= {0.0, 1.0, 2.0}
+    assert set(np.unique(t["l"])) <= {0.0, 1.0}
+    assert ((t["w"] >= 0) & (t["w"] < 1)).all()
+
+
+def test_string_generator_distinct():
+    g = RandomStringGenerator(seed=2, col_names=[["s"]], num_values=200,
+                              num_distinct_values=5)
+    t = g.get_data()
+    assert len(set(t["s"])) <= 5
+
+
+def test_resolve_java_class_names():
+    assert resolve_generator(
+        "org.apache.flink.ml.benchmark.datagenerator.common."
+        "DenseVectorGenerator") is DenseVectorGenerator
+    cls = resolve_stage(
+        "org.apache.flink.ml.clustering.kmeans.KMeans")
+    assert cls.__name__ == "KMeans"
+    with pytest.raises(ValueError):
+        resolve_stage("com.example.Bogus")
+
+
+def test_run_benchmark_estimator_and_config(tmp_path):
+    spec = {
+        "stage": {"className": "KMeans", "paramMap": {"k": 2, "maxIter": 3}},
+        "inputData": {"className": "DenseVectorGenerator",
+                      "paramMap": {"seed": 2, "colNames": [["features"]],
+                                   "numValues": 500, "vectorDim": 4}},
+    }
+    res = run_benchmark("km", spec)
+    assert res["inputRecordNum"] == 500
+    assert res["outputRecordNum"] == 2  # model data = k centroids
+    assert res["inputThroughput"] > 0
+
+    # end-to-end CLI with a reference-style config file incl. // comments
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text("// license header\n" + json.dumps(
+        {"version": 1, "bench1": spec}))
+    out_path = tmp_path / "out.json"
+    assert main([str(cfg_path), "--output-file", str(out_path)]) == 0
+    results = json.loads(out_path.read_text())
+    assert "results" in results["bench1"]
+
+
+def test_run_benchmarks_captures_failures():
+    config = {
+        "bad": {"stage": {"className": "Bogus"},
+                "inputData": {"className": "DenseVectorGenerator"}},
+    }
+    results = run_benchmarks(config)
+    assert "exception" in results["bad"]
+
+
+def test_shipped_configs_parse():
+    import glob
+    import os
+    cfg_dir = os.path.join(os.path.dirname(__file__), "..",
+                           "flink_ml_tpu", "benchmark", "configs")
+    files = glob.glob(os.path.join(cfg_dir, "*.json"))
+    assert len(files) >= 4
+    for f in files:
+        config = load_config(f)
+        for spec in config.values():
+            resolve_stage(spec["stage"]["className"])
+            resolve_generator(spec["inputData"]["className"])
+
+
+def test_model_benchmark_with_model_data():
+    spec = {
+        "stage": {"className": "KMeansModel",
+                  "paramMap": {"k": 2, "featuresCol": "features"}},
+        "modelData": {"className": "KMeansModelDataGenerator",
+                      "paramMap": {"seed": 1, "arraySize": 2,
+                                   "vectorDim": 4}},
+        "inputData": {"className": "DenseVectorGenerator",
+                      "paramMap": {"seed": 2, "colNames": [["features"]],
+                                   "numValues": 300, "vectorDim": 4}},
+    }
+    res = run_benchmark("kmm", spec)
+    assert res["outputRecordNum"] == 300
+
+
+def test_graft_entry_single_device():
+    import jax
+
+    from __graft_entry__ import entry
+    fn, args = entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+
+
+def test_graft_entry_dryrun_multichip():
+    from __graft_entry__ import dryrun_multichip
+    dryrun_multichip(8)
