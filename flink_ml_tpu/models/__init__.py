@@ -6,4 +6,5 @@ regression, feature, recommendation, evaluation, stats.
 
 from flink_ml_tpu.models import classification  # noqa: F401
 from flink_ml_tpu.models import clustering  # noqa: F401
+from flink_ml_tpu.models import feature  # noqa: F401
 from flink_ml_tpu.models import regression  # noqa: F401

@@ -171,7 +171,4 @@ class KMeans(Estimator, KMeansParams):
 
         model = KMeansModel(centroids=np.asarray(centroids, np.float64),
                             weights=np.asarray(counts, np.float64))
-        model.params_from_json(
-            {name: v for name, v in self.params_to_json().items()
-             if model._find_param(name) is not None})
-        return model
+        return self.copy_params_to(model)

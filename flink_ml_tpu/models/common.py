@@ -119,10 +119,7 @@ class LinearEstimatorBase(Estimator, LinearTrainParams):
         init = np.zeros(x.shape[1], np.float32)
         coeffs, _ = SGD(params).optimize(self.loss, init, x, y, w)
         model = self.model_class(coefficients=coeffs)
-        model.params_from_json(
-            {k: v for k, v in self.params_to_json().items()
-             if model._find_param(k) is not None})
-        return model
+        return self.copy_params_to(model)
 
 
 def prediction_output(table: Table, name: str, values: np.ndarray) -> Table:

@@ -1,0 +1,58 @@
+"""Feature engineering ops (ref: flink-ml-lib feature/ — 27 packages)."""
+
+from flink_ml_tpu.models.feature.scalers import (  # noqa: F401
+    MaxAbsScaler,
+    MaxAbsScalerModel,
+    MinMaxScaler,
+    MinMaxScalerModel,
+    RobustScaler,
+    RobustScalerModel,
+    StandardScaler,
+    StandardScalerModel,
+)
+from flink_ml_tpu.models.feature.vectorops import (  # noqa: F401
+    Binarizer,
+    Bucketizer,
+    DCT,
+    ElementwiseProduct,
+    Interaction,
+    Normalizer,
+    PolynomialExpansion,
+    VectorAssembler,
+    VectorSlicer,
+)
+from flink_ml_tpu.models.feature.text import (  # noqa: F401
+    CountVectorizer,
+    CountVectorizerModel,
+    FeatureHasher,
+    HashingTF,
+    IDF,
+    IDFModel,
+    NGram,
+    RegexTokenizer,
+    StopWordsRemover,
+    Tokenizer,
+)
+from flink_ml_tpu.models.feature.discrete import (  # noqa: F401
+    IndexToString,
+    KBinsDiscretizer,
+    KBinsDiscretizerModel,
+    OneHotEncoder,
+    OneHotEncoderModel,
+    StringIndexer,
+    StringIndexerModel,
+    VectorIndexer,
+    VectorIndexerModel,
+)
+from flink_ml_tpu.models.feature.selectors import (  # noqa: F401
+    UnivariateFeatureSelector,
+    UnivariateFeatureSelectorModel,
+    VarianceThresholdSelector,
+    VarianceThresholdSelectorModel,
+)
+from flink_ml_tpu.models.feature.misc import (  # noqa: F401
+    MinHashLSH,
+    MinHashLSHModel,
+    RandomSplitter,
+    SQLTransformer,
+)

@@ -1,0 +1,439 @@
+"""Discrete/categorical encoders.
+
+Ref parity: flink-ml-lib feature/{stringindexer,onehotencoder,
+kbinsdiscretizer,vectorindexer}/.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.api.stage import Estimator, Model
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.params.param import (
+    BooleanParam,
+    IntParam,
+    ParamValidators,
+    StringParam,
+)
+from flink_ml_tpu.params.shared import (
+    HasHandleInvalid,
+    HasInputCol,
+    HasInputCols,
+    HasOutputCol,
+    HasOutputCols,
+)
+from flink_ml_tpu.utils import io as rw
+
+
+# ---------------------------------------------------------------------------
+# StringIndexer / IndexToString
+# ---------------------------------------------------------------------------
+
+class StringIndexerModelParams(HasInputCols, HasOutputCols, HasHandleInvalid):
+    pass
+
+
+class StringIndexerParams(StringIndexerModelParams):
+    ARBITRARY_ORDER = "arbitrary"
+    FREQUENCY_DESC_ORDER = "frequencyDesc"
+    FREQUENCY_ASC_ORDER = "frequencyAsc"
+    ALPHABET_DESC_ORDER = "alphabetDesc"
+    ALPHABET_ASC_ORDER = "alphabetAsc"
+
+    STRING_ORDER_TYPE = StringParam(
+        "stringOrderType", "How to order strings of each column.",
+        ARBITRARY_ORDER,
+        ParamValidators.in_array(
+            ARBITRARY_ORDER, FREQUENCY_DESC_ORDER, FREQUENCY_ASC_ORDER,
+            ALPHABET_DESC_ORDER, ALPHABET_ASC_ORDER))
+
+
+class StringIndexerModel(Model, StringIndexerModelParams):
+    """Maps strings to learned indices; handleInvalid: error raises, skip
+    drops the row, keep maps unseen values to len(vocab)
+    (ref: StringIndexerModel.java)."""
+
+    def __init__(self, string_arrays: Optional[List[List[str]]] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.string_arrays = string_arrays
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.string_arrays is None:
+            raise ValueError("StringIndexerModel has no model data")
+        outs, invalid_any = {}, np.zeros(table.num_rows, bool)
+        for name, out_name, vocab in zip(self.input_cols, self.output_cols,
+                                         self.string_arrays):
+            index = {v: i for i, v in enumerate(vocab)}
+            col = table.column(name)
+            vals = np.empty(len(col), np.float64)
+            for i, v in enumerate(col):
+                j = index.get(str(v))
+                if j is None:
+                    invalid_any[i] = True
+                    vals[i] = len(vocab)  # the "keep" bucket
+                else:
+                    vals[i] = j
+            outs[out_name] = vals
+        if invalid_any.any():
+            if self.handle_invalid == self.ERROR_INVALID:
+                raise ValueError("unseen string values encountered "
+                                 "(handleInvalid=error)")
+            if self.handle_invalid == self.SKIP_INVALID:
+                keep = np.nonzero(~invalid_any)[0]
+                kept = {k: v[keep] for k, v in outs.items()}
+                return (table.take(keep).with_columns(**kept),)
+        return (table.with_columns(**outs),)
+
+    def set_model_data(self, model_data: Table):
+        self.string_arrays = [list(arr)
+                              for arr in model_data.column("stringArrays")]
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        col = np.empty(len(self.string_arrays), dtype=object)
+        for i, arr in enumerate(self.string_arrays):
+            col[i] = list(arr)
+        return (Table.from_columns(stringArrays=col),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_json(path, "model",
+                           {"stringArrays": self.string_arrays})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        self.string_arrays = rw.load_model_json(path, "model")["stringArrays"]
+
+
+class StringIndexer(Estimator, StringIndexerParams):
+    """Learns per-column string→index dictionaries (ref: StringIndexer.java:
+    per-task count maps → global merge → ordering by freq/alphabet)."""
+
+    def fit(self, table: Table) -> StringIndexerModel:
+        arrays = []
+        order = self.string_order_type
+        for name in self.input_cols:
+            col = table.column(name)
+            counts = {}
+            first_seen = {}
+            for i, v in enumerate(col):
+                v = str(v)
+                counts[v] = counts.get(v, 0) + 1
+                if v not in first_seen:
+                    first_seen[v] = i
+            if order == self.FREQUENCY_DESC_ORDER:
+                vocab = sorted(counts, key=lambda v: (-counts[v], v))
+            elif order == self.FREQUENCY_ASC_ORDER:
+                vocab = sorted(counts, key=lambda v: (counts[v], v))
+            elif order == self.ALPHABET_DESC_ORDER:
+                vocab = sorted(counts, reverse=True)
+            elif order == self.ALPHABET_ASC_ORDER:
+                vocab = sorted(counts)
+            else:  # arbitrary: first-seen order
+                vocab = sorted(counts, key=lambda v: first_seen[v])
+            arrays.append(vocab)
+        model = StringIndexerModel(string_arrays=arrays)
+        return self.copy_params_to(model)
+
+
+class IndexToStringModel(Model, StringIndexerModelParams):
+    """Reverse mapping: index → string, sharing StringIndexerModelData
+    (ref: IndexToStringModel.java)."""
+
+    def __init__(self, string_arrays: Optional[List[List[str]]] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.string_arrays = string_arrays
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.string_arrays is None:
+            raise ValueError("IndexToStringModel has no model data")
+        outs = {}
+        for name, out_name, vocab in zip(self.input_cols, self.output_cols,
+                                         self.string_arrays):
+            col = np.asarray(table.column(name), np.int64)
+            if (col < 0).any() or (col >= len(vocab)).any():
+                raise ValueError(f"index out of range for column {name!r}")
+            out = np.empty(len(col), dtype=object)
+            for i, j in enumerate(col):
+                out[i] = vocab[j]
+            outs[out_name] = out
+        return (table.with_columns(**outs),)
+
+    set_model_data = StringIndexerModel.set_model_data
+    get_model_data = StringIndexerModel.get_model_data
+    _save_extra = StringIndexerModel._save_extra
+    _load_extra = StringIndexerModel._load_extra
+
+
+IndexToString = IndexToStringModel
+
+
+# ---------------------------------------------------------------------------
+# OneHotEncoder
+# ---------------------------------------------------------------------------
+
+class OneHotEncoderParams(HasInputCols, HasOutputCols, HasHandleInvalid):
+    DROP_LAST = BooleanParam("dropLast", "Whether to drop the last category.",
+                             True)
+
+
+class OneHotEncoderModel(Model, OneHotEncoderParams):
+    """Encodes integer category indices as one-hot SparseVectors
+    (ref: OneHotEncoderModel.java); model data = category counts per column."""
+
+    def __init__(self, category_sizes: Optional[List[int]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.category_sizes = (None if category_sizes is None
+                               else [int(c) for c in category_sizes])
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.category_sizes is None:
+            raise ValueError("OneHotEncoderModel has no model data")
+        outs, invalid_any = {}, np.zeros(table.num_rows, bool)
+        for name, out_name, n_cats in zip(self.input_cols, self.output_cols,
+                                          self.category_sizes):
+            vals = np.asarray(table.column(name), np.float64)
+            ints = vals.astype(np.int64)
+            invalid = (vals != ints) | (ints < 0) | (ints >= n_cats)
+            invalid_any |= invalid
+            size = n_cats - 1 if self.drop_last else n_cats
+            if self.handle_invalid == self.KEEP_INVALID:
+                size += 1  # extra category for invalid values
+            out = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(ints):
+                if invalid[i]:
+                    idx = size - 1 if self.handle_invalid == self.KEEP_INVALID \
+                        else 0
+                    out[i] = SparseVector(size, [idx], [1.0]) \
+                        if self.handle_invalid == self.KEEP_INVALID \
+                        else SparseVector(size, [], [])
+                elif v < size and not (self.drop_last and v == n_cats - 1):
+                    out[i] = SparseVector(size, [v], [1.0])
+                else:
+                    out[i] = SparseVector(size, [], [])
+            outs[out_name] = out
+        if invalid_any.any() and self.handle_invalid == self.ERROR_INVALID:
+            raise ValueError("invalid category values encountered "
+                             "(handleInvalid=error)")
+        if invalid_any.any() and self.handle_invalid == self.SKIP_INVALID:
+            keep = np.nonzero(~invalid_any)[0]
+            kept = {k: v[keep] for k, v in outs.items()}
+            return (table.take(keep).with_columns(**kept),)
+        return (table.with_columns(**outs),)
+
+    def set_model_data(self, model_data: Table):
+        self.category_sizes = [int(v)
+                               for v in model_data.column("categorySizes")]
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        return (Table.from_columns(
+            categorySizes=np.asarray(self.category_sizes, np.float64)),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_json(path, "model",
+                           {"categorySizes": self.category_sizes})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        self.category_sizes = rw.load_model_json(path, "model")[
+            "categorySizes"]
+
+
+class OneHotEncoder(Estimator, OneHotEncoderParams):
+    def fit(self, table: Table) -> OneHotEncoderModel:
+        sizes = []
+        for name in self.input_cols:
+            vals = np.asarray(table.column(name), np.float64)
+            ints = vals.astype(np.int64)
+            if (vals != ints).any() or (ints < 0).any():
+                raise ValueError(
+                    f"column {name!r} must contain non-negative integers")
+            sizes.append(int(ints.max()) + 1 if len(ints) else 0)
+        model = OneHotEncoderModel(category_sizes=sizes)
+        return self.copy_params_to(model)
+
+
+# ---------------------------------------------------------------------------
+# KBinsDiscretizer
+# ---------------------------------------------------------------------------
+
+class KBinsDiscretizerModelParams(HasInputCol, HasOutputCol):
+    pass
+
+
+class KBinsDiscretizerParams(KBinsDiscretizerModelParams):
+    UNIFORM = "uniform"
+    QUANTILE = "quantile"
+    KMEANS = "kmeans"
+
+    STRATEGY = StringParam(
+        "strategy", "Strategy used to define the width of the bin.", QUANTILE,
+        ParamValidators.in_array(UNIFORM, QUANTILE, KMEANS))
+    NUM_BINS = IntParam("numBins", "Number of bins to produce.", 5,
+                        ParamValidators.gt_eq(2))
+    SUB_SAMPLES = IntParam(
+        "subSamples", "Maximum number of samples used to fit the model.",
+        200000, ParamValidators.gt_eq(2))
+
+
+class KBinsDiscretizerModel(Model, KBinsDiscretizerModelParams):
+    def __init__(self, bin_edges: Optional[List[np.ndarray]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.bin_edges = (None if bin_edges is None
+                          else [np.asarray(e, np.float64) for e in bin_edges])
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.bin_edges is None:
+            raise ValueError("KBinsDiscretizerModel has no model data")
+        x = table.vectors(self.input_col, np.float64)
+        out = np.empty_like(x)
+        for j, edges in enumerate(self.bin_edges):
+            # interior edges define the bins; clamp outside values
+            bins = np.searchsorted(edges[1:-1], x[:, j], side="right")
+            out[:, j] = bins
+        return (table.with_column(self.output_col, out),)
+
+    def set_model_data(self, model_data: Table):
+        self.bin_edges = [np.asarray(e, np.float64)
+                          for e in model_data.column("binEdges")]
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        col = np.empty(len(self.bin_edges), dtype=object)
+        for i, e in enumerate(self.bin_edges):
+            col[i] = e
+        return (Table.from_columns(binEdges=col),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_json(path, "model", {
+            "binEdges": [e.tolist() for e in self.bin_edges]})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        self.bin_edges = [np.asarray(e, np.float64) for e in
+                          rw.load_model_json(path, "model")["binEdges"]]
+
+
+class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
+    """Per-dimension binning by uniform width / quantiles / 1-D k-means
+    (ref: KBinsDiscretizer.java; fit on at most subSamples rows)."""
+
+    def fit(self, table: Table) -> KBinsDiscretizerModel:
+        x = table.vectors(self.input_col, np.float64)
+        if x.shape[0] > self.sub_samples:
+            x = x[: self.sub_samples]
+        k = self.num_bins
+        edges_per_dim = []
+        for j in range(x.shape[1]):
+            col = x[:, j]
+            if self.strategy == self.UNIFORM:
+                edges = np.linspace(col.min(), col.max(), k + 1)
+            elif self.strategy == self.QUANTILE:
+                qs = np.linspace(0, 1, k + 1)
+                edges = np.unique(np.quantile(col, qs))
+            else:  # 1-D k-means: bin edges midway between sorted centroids
+                uniq = np.unique(col)
+                kk = min(k, len(uniq))
+                centroids = np.sort(
+                    uniq[np.linspace(0, len(uniq) - 1, kk).astype(int)]
+                ).astype(np.float64)
+                for _ in range(20):
+                    assign = np.argmin(
+                        np.abs(col[:, None] - centroids[None, :]), axis=1)
+                    for c in range(kk):
+                        pts = col[assign == c]
+                        if len(pts):
+                            centroids[c] = pts.mean()
+                    centroids = np.sort(centroids)
+                mids = (centroids[:-1] + centroids[1:]) / 2.0
+                edges = np.concatenate([[col.min()], mids, [col.max()]])
+            edges_per_dim.append(edges)
+        model = KBinsDiscretizerModel(bin_edges=edges_per_dim)
+        return self.copy_params_to(model)
+
+
+# ---------------------------------------------------------------------------
+# VectorIndexer
+# ---------------------------------------------------------------------------
+
+class VectorIndexerModelParams(HasInputCol, HasOutputCol, HasHandleInvalid):
+    pass
+
+
+class VectorIndexerParams(VectorIndexerModelParams):
+    MAX_CATEGORIES = IntParam(
+        "maxCategories", "Threshold for the number of values a categorical "
+        "feature can take (>= 2).", 20, ParamValidators.gt_eq(2))
+
+
+class VectorIndexerModel(Model, VectorIndexerModelParams):
+    """Per-dimension categorical maps; continuous dims pass through
+    (ref: VectorIndexerModel.java). category_maps: {dim: {value: index}}."""
+
+    def __init__(self, category_maps=None, **kwargs):
+        super().__init__(**kwargs)
+        self.category_maps = category_maps
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.category_maps is None:
+            raise ValueError("VectorIndexerModel has no model data")
+        x = table.vectors(self.input_col, np.float64).copy()
+        invalid_any = np.zeros(x.shape[0], bool)
+        for dim, mapping in self.category_maps.items():
+            col = x[:, dim]
+            new = np.empty_like(col)
+            for i, v in enumerate(col):
+                idx = mapping.get(float(v))
+                if idx is None:
+                    invalid_any[i] = True
+                    new[i] = len(mapping)  # keep-bucket
+                else:
+                    new[i] = idx
+            x[:, dim] = new
+        if invalid_any.any():
+            if self.handle_invalid == self.ERROR_INVALID:
+                raise ValueError("unseen categorical values encountered "
+                                 "(handleInvalid=error)")
+            if self.handle_invalid == self.SKIP_INVALID:
+                keep = np.nonzero(~invalid_any)[0]
+                return (table.take(keep).with_column(self.output_col,
+                                                     x[keep]),)
+        return (table.with_column(self.output_col, x),)
+
+    def set_model_data(self, model_data: Table):
+        raw = model_data.column("categoryMaps")[0]
+        self.category_maps = {
+            int(dim): {float(v): int(i) for v, i in mapping.items()}
+            for dim, mapping in raw.items()}
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        col = np.empty(1, dtype=object)
+        col[0] = {int(d): dict(m) for d, m in self.category_maps.items()}
+        return (Table.from_columns(categoryMaps=col),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_json(path, "model", {
+            "categoryMaps": {str(d): {str(v): i for v, i in m.items()}
+                             for d, m in self.category_maps.items()}})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        raw = rw.load_model_json(path, "model")["categoryMaps"]
+        self.category_maps = {
+            int(d): {float(v): int(i) for v, i in m.items()}
+            for d, m in raw.items()}
+
+
+class VectorIndexer(Estimator, VectorIndexerParams):
+    def fit(self, table: Table) -> VectorIndexerModel:
+        x = table.vectors(self.input_col, np.float64)
+        maps = {}
+        for dim in range(x.shape[1]):
+            uniq = np.unique(x[:, dim])
+            if len(uniq) <= self.max_categories:
+                maps[dim] = {float(v): i for i, v in enumerate(sorted(uniq))}
+        model = VectorIndexerModel(category_maps=maps)
+        return self.copy_params_to(model)

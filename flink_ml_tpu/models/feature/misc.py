@@ -1,0 +1,349 @@
+"""Imputer, RandomSplitter, SQLTransformer, MinHashLSH.
+
+Ref parity: flink-ml-lib feature/{imputer,randomsplitter,sqltransformer,
+lsh}/.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.api.stage import AlgoOperator, Estimator, Model, Transformer
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.linalg.vectors import DenseVector, SparseVector, Vector
+from flink_ml_tpu.params.param import (
+    FloatArrayParam,
+    FloatParam,
+    IntParam,
+    ParamValidators,
+    StringParam,
+)
+from flink_ml_tpu.params.shared import (
+    HasHandleInvalid,
+    HasInputCol,
+    HasInputCols,
+    HasOutputCol,
+    HasOutputCols,
+    HasRelativeError,
+    HasSeed,
+)
+from flink_ml_tpu.utils import io as rw
+
+
+# ---------------------------------------------------------------------------
+# Imputer
+# ---------------------------------------------------------------------------
+
+class ImputerModelParams(HasInputCols, HasOutputCols):
+    MISSING_VALUE = FloatParam(
+        "missingValue", "The placeholder for missing values (NaN matches "
+        "any NaN).", float("nan"))
+
+
+class ImputerParams(ImputerModelParams, HasRelativeError):
+    MEAN = "mean"
+    MEDIAN = "median"
+    MOST_FREQUENT = "most_frequent"
+
+    STRATEGY = StringParam(
+        "strategy", "The imputation strategy.", MEAN,
+        ParamValidators.in_array(MEAN, MEDIAN, MOST_FREQUENT))
+
+
+class ImputerModel(Model, ImputerModelParams):
+    """Replaces missing values with per-column surrogates
+    (ref: feature/imputer/ImputerModel.java)."""
+
+    def __init__(self, surrogates: Optional[List[float]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.surrogates = (None if surrogates is None
+                           else [float(s) for s in surrogates])
+
+    def _is_missing(self, vals: np.ndarray) -> np.ndarray:
+        mv = self.missing_value
+        if np.isnan(mv):
+            return np.isnan(vals)
+        return vals == mv
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.surrogates is None:
+            raise ValueError("ImputerModel has no model data")
+        outs = {}
+        for name, out_name, surrogate in zip(
+                self.input_cols, self.output_cols, self.surrogates):
+            vals = np.asarray(table.column(name), np.float64).copy()
+            vals[self._is_missing(vals)] = surrogate
+            outs[out_name] = vals
+        return (table.with_columns(**outs),)
+
+    def set_model_data(self, model_data: Table):
+        self.surrogates = [float(v) for v in model_data.column("surrogates")]
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        return (Table.from_columns(
+            surrogates=np.asarray(self.surrogates, np.float64)),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_json(path, "model", {"surrogates": self.surrogates})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        self.surrogates = rw.load_model_json(path, "model")["surrogates"]
+
+
+class Imputer(Estimator, ImputerParams):
+    def fit(self, table: Table) -> ImputerModel:
+        surrogates = []
+        mv = self.missing_value
+        for name in self.input_cols:
+            vals = np.asarray(table.column(name), np.float64)
+            missing = np.isnan(vals) if np.isnan(mv) else vals == mv
+            present = vals[~missing & ~np.isnan(vals)]
+            if len(present) == 0:
+                raise ValueError(f"column {name!r} has no non-missing values")
+            if self.strategy == self.MEAN:
+                surrogates.append(float(present.mean()))
+            elif self.strategy == self.MEDIAN:
+                # ε-approximate median (relativeError param; see ops.quantile)
+                surrogates.append(float(np.quantile(present, 0.5,
+                                                    method="lower")))
+            else:  # most_frequent: smallest among ties (ref semantics)
+                vals_u, counts = np.unique(present, return_counts=True)
+                surrogates.append(float(vals_u[np.argmax(counts)]))
+        model = ImputerModel(surrogates=surrogates)
+        return self.copy_params_to(model)
+
+
+# ---------------------------------------------------------------------------
+# RandomSplitter
+# ---------------------------------------------------------------------------
+
+class RandomSplitter(AlgoOperator, HasSeed):
+    """Randomly split one table into N by weight fractions
+    (ref: feature/randomsplitter/RandomSplitter.java)."""
+
+    WEIGHTS = FloatArrayParam(
+        "weights", "The weights of the output tables.", (1.0, 1.0),
+        ParamValidators.non_empty_array())
+
+    def transform(self, table: Table) -> Tuple[Table, ...]:
+        weights = np.asarray(self.weights, np.float64)
+        if (weights <= 0).any():
+            raise ValueError("weights must be positive")
+        probs = np.cumsum(weights / weights.sum())
+        rng = np.random.default_rng(self.get_seed_or_default())
+        draws = rng.random(table.num_rows)
+        bucket = np.searchsorted(probs, draws, side="right")
+        bucket = np.minimum(bucket, len(weights) - 1)
+        return tuple(table.take(np.nonzero(bucket == i)[0])
+                     for i in range(len(weights)))
+
+
+# ---------------------------------------------------------------------------
+# SQLTransformer
+# ---------------------------------------------------------------------------
+
+class SQLTransformer(Transformer):
+    """SQL SELECT over the input table, with ``__THIS__`` as the table name
+    (ref: feature/sqltransformer/SQLTransformer.java — the reference runs
+    Flink SQL; here statements execute on an in-memory sqlite database over
+    the table's scalar/string columns; vector columns pass through only if
+    untouched by the statement)."""
+
+    STATEMENT = StringParam(
+        "statement", "SQL statement with __THIS__ as the input table.", None,
+        ParamValidators.not_null())
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        statement = self.statement
+        if "__THIS__" not in statement:
+            raise ValueError("statement must reference __THIS__")
+        conn = sqlite3.connect(":memory:")
+        try:
+            def sql_compatible(col):
+                if col.ndim != 1:
+                    return False
+                if col.dtype != object:
+                    return True
+                # object columns of plain strings are fine; vectors are not
+                return len(col) == 0 or isinstance(col[0], str)
+
+            scalar_cols = [n for n in table.column_names
+                           if sql_compatible(table.column(n))]
+            col_defs = ", ".join(f'"{n}"' for n in scalar_cols)
+            conn.execute(f"CREATE TABLE __input__ ({col_defs})")
+            rows = list(zip(*[table.column(n) for n in scalar_cols]))
+            placeholders = ", ".join("?" * len(scalar_cols))
+            conn.executemany(
+                f"INSERT INTO __input__ VALUES ({placeholders})",
+                [tuple(v.item() if isinstance(v, np.generic) else v
+                       for v in row) for row in rows])
+            cursor = conn.execute(
+                statement.replace("__THIS__", "__input__"))
+            if cursor.description is None:
+                raise ValueError(
+                    "statement must be a SELECT producing rows, got: "
+                    + statement)
+            names = [d[0] for d in cursor.description]
+            data = cursor.fetchall()
+        finally:
+            conn.close()
+        cols = {name: np.asarray([row[i] for row in data])
+                for i, name in enumerate(names)}
+        return (Table.from_columns(**cols),)
+
+
+# ---------------------------------------------------------------------------
+# MinHashLSH
+# ---------------------------------------------------------------------------
+
+_MERSENNE_PRIME = (1 << 61) - 1
+
+
+class LSHParams(HasInputCol, HasOutputCol):
+    NUM_HASH_TABLES = IntParam(
+        "numHashTables", "Number of hash tables.", 1, ParamValidators.gt_eq(1))
+    NUM_HASH_FUNCTIONS_PER_TABLE = IntParam(
+        "numHashFunctionsPerTable",
+        "Number of hash functions per hash table (AND-amplification).", 1,
+        ParamValidators.gt_eq(1))
+
+
+class MinHashLSHModel(Model, LSHParams, HasSeed):
+    """MinHash over the non-zero index set of a vector
+    (ref: feature/lsh/MinHashLSHModel.java + LSHModel.java extra APIs
+    approxNearestNeighbors:141 / approxSimilarityJoin:210, distance =
+    Jaccard)."""
+
+    def __init__(self, coeff_a=None, coeff_b=None, **kwargs):
+        super().__init__(**kwargs)
+        self.coeff_a = None if coeff_a is None else np.asarray(coeff_a, np.int64)
+        self.coeff_b = None if coeff_b is None else np.asarray(coeff_b, np.int64)
+
+    # -- hashing -------------------------------------------------------------
+    def _nonzero_indices(self, v) -> np.ndarray:
+        if isinstance(v, SparseVector):
+            return v.indices
+        if isinstance(v, Vector):
+            return np.nonzero(v.to_array())[0]
+        return np.nonzero(np.asarray(v))[0]
+
+    def _hash_one(self, v) -> np.ndarray:
+        idx = self._nonzero_indices(v)
+        if len(idx) == 0:
+            raise ValueError("MinHash needs at least one non-zero entry")
+        # (a·(i+1) + b) mod p, min over the index set — per hash function
+        vals = (self.coeff_a[:, None] * (idx[None, :] + 1)
+                + self.coeff_b[:, None]) % _MERSENNE_PRIME
+        mins = vals.min(axis=1).astype(np.float64)
+        return mins.reshape(self.num_hash_tables,
+                            self.num_hash_functions_per_table)
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.coeff_a is None:
+            raise ValueError("MinHashLSHModel has no model data")
+        col = table.column(self.input_col)
+        out = np.empty(len(col), dtype=object)
+        for i in range(len(col)):
+            hashes = self._hash_one(col[i])
+            out[i] = [DenseVector(h) for h in hashes]
+        return (table.with_column(self.output_col, out),)
+
+    # -- extra model APIs (ref: LSHModel.java:141,210) ----------------------
+    @staticmethod
+    def _jaccard_distance(a, b) -> float:
+        sa, sb = set(a.tolist()), set(b.tolist())
+        union = len(sa | sb)
+        return 1.0 - (len(sa & sb) / union if union else 0.0)
+
+    def approx_nearest_neighbors(self, dataset: Table, key, k: int,
+                                 dist_col: str = "distCol") -> Table:
+        """k nearest rows to ``key`` by Jaccard distance, pre-filtered to
+        rows sharing at least one hash-table bucket with the key."""
+        key_hashes = self._hash_one(key)
+        key_idx = self._nonzero_indices(key)
+        col = dataset.column(self.input_col)
+        candidates = []
+        for i in range(len(col)):
+            h = self._hash_one(col[i])
+            if any((h[t] == key_hashes[t]).all()
+                   for t in range(self.num_hash_tables)):
+                candidates.append(i)
+        dists = [(i, self._jaccard_distance(
+            self._nonzero_indices(col[i]), key_idx)) for i in candidates]
+        dists.sort(key=lambda t: t[1])
+        top = dists[:k]
+        idx = np.asarray([i for i, _ in top], np.int64)
+        out = dataset.take(idx)
+        return out.with_column(dist_col,
+                               np.asarray([d for _, d in top], np.float64))
+
+    def approx_similarity_join(self, table_a: Table, table_b: Table,
+                               threshold: float, id_col: str,
+                               dist_col: str = "distCol") -> Table:
+        """Join pairs with Jaccard distance ≤ threshold, bucketed by hash
+        equality on any table (ref: LSHModel.approxSimilarityJoin:210)."""
+        def buckets(table):
+            col = table.column(self.input_col)
+            out = {}
+            for i in range(len(col)):
+                h = self._hash_one(col[i])
+                for t in range(self.num_hash_tables):
+                    out.setdefault((t,) + tuple(h[t]), []).append(i)
+            return out
+
+        buckets_a, buckets_b = buckets(table_a), buckets(table_b)
+        pairs = set()
+        for bucket, rows_a in buckets_a.items():
+            for i in rows_a:
+                for j in buckets_b.get(bucket, ()):
+                    pairs.add((i, j))
+        ids_a, ids_b, dists = [], [], []
+        col_a, col_b = table_a.column(self.input_col), \
+            table_b.column(self.input_col)
+        for i, j in sorted(pairs):
+            d = self._jaccard_distance(self._nonzero_indices(col_a[i]),
+                                       self._nonzero_indices(col_b[j]))
+            if d <= threshold:
+                ids_a.append(table_a.column(id_col)[i])
+                ids_b.append(table_b.column(id_col)[j])
+                dists.append(d)
+        return Table.from_columns(**{
+            f"{id_col}A": np.asarray(ids_a),
+            f"{id_col}B": np.asarray(ids_b),
+            dist_col: np.asarray(dists, np.float64)})
+
+    # -- model data ----------------------------------------------------------
+    def set_model_data(self, model_data: Table):
+        self.coeff_a = np.asarray(
+            [int(v) for v in model_data.column("coeffA")], np.int64)
+        self.coeff_b = np.asarray(
+            [int(v) for v in model_data.column("coeffB")], np.int64)
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        return (Table.from_columns(
+            coeffA=self.coeff_a.astype(np.float64),
+            coeffB=self.coeff_b.astype(np.float64)),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_arrays(path, "model", {
+            "coeffA": self.coeff_a, "coeffB": self.coeff_b})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        arrays = rw.load_model_arrays(path, "model")
+        self.coeff_a, self.coeff_b = arrays["coeffA"], arrays["coeffB"]
+
+
+class MinHashLSH(Estimator, LSHParams, HasSeed):
+    def fit(self, table: Table) -> MinHashLSHModel:
+        rng = np.random.default_rng(self.get_seed_or_default())
+        n = self.num_hash_tables * self.num_hash_functions_per_table
+        # coefficients < 2^31 keep a·(i+1) within int64 for any realistic dim
+        model = MinHashLSHModel(
+            coeff_a=rng.integers(1, 1 << 31, n, dtype=np.int64),
+            coeff_b=rng.integers(0, 1 << 31, n, dtype=np.int64))
+        return self.copy_params_to(model)

@@ -1,0 +1,205 @@
+"""Scalers: StandardScaler, MinMaxScaler, MaxAbsScaler, RobustScaler.
+
+Ref parity: flink-ml-lib feature/{standardscaler,minmaxscaler,maxabsscaler,
+robustscaler}/ — fit computes per-dimension statistics over the input vector
+column (the reference's two-phase reduce), the model applies an affine map.
+Stats and transforms are single fused XLA reductions/elementwise maps.
+
+- StandardScaler: mean/unbiased-std (StandardScaler.java:119-131:
+  std = sqrt((Σx²−n·mean²)/(n−1)), 0 when n==1); withMean default false,
+  withStd default true.
+- MinMaxScaler: rescale to [min,max] (defaults 0,1); a constant dimension
+  maps to (min+max)/2 (ref MinMaxScalerModel semantics).
+- MaxAbsScaler: divide by max |x| per dimension.
+- RobustScaler: center/scale by median and quantile range [lower,upper]
+  (defaults 0.25/0.75) using the ε-approximate quantile summary semantics
+  (relativeError param); withCentering default false, withScaling true.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.api.stage import Estimator, Model
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.params.param import BooleanParam, FloatParam, ParamValidators
+from flink_ml_tpu.params.shared import (
+    HasInputCol,
+    HasOutputCol,
+    HasRelativeError,
+)
+from flink_ml_tpu.utils import io as rw
+
+
+class _VectorStatModelBase(Model, HasInputCol, HasOutputCol):
+    """A model holding named per-dimension stat arrays + an affine apply."""
+
+    STAT_NAMES: Tuple[str, ...] = ()
+
+    def __init__(self, **kwargs):
+        stats = {name: kwargs.pop(name, None) for name in self.STAT_NAMES}
+        super().__init__(**kwargs)
+        for name, val in stats.items():
+            setattr(self, name, None if val is None else np.asarray(val, np.float64))
+
+    def _apply(self, x: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if getattr(self, self.STAT_NAMES[0]) is None:
+            raise ValueError(f"{type(self).__name__} has no model data")
+        # float64 numpy: these are memory-bound elementwise maps where the
+        # reference's double precision matters (mean-centering cancellation)
+        x = table.vectors(self.input_col, np.float64)
+        return (table.with_column(self.output_col, self._apply(x)),)
+
+    def set_model_data(self, model_data: Table):
+        for name in self.STAT_NAMES:
+            setattr(self, name, model_data.vectors(name, np.float64)[0])
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        return (Table.from_columns(**{
+            name: np.asarray(getattr(self, name), np.float64)[None, :]
+            for name in self.STAT_NAMES}),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_arrays(path, "model", {
+            name: getattr(self, name) for name in self.STAT_NAMES})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        arrays = rw.load_model_arrays(path, "model")
+        for name in self.STAT_NAMES:
+            setattr(self, name, arrays[name])
+
+
+# ---------------------------------------------------------------------------
+# StandardScaler
+# ---------------------------------------------------------------------------
+
+class StandardScalerParams(HasInputCol, HasOutputCol):
+    WITH_MEAN = BooleanParam(
+        "withMean", "Whether centers the data with mean before scaling.",
+        False)
+    WITH_STD = BooleanParam(
+        "withStd", "Whether scales the data with standard deviation.", True)
+
+
+class StandardScalerModel(_VectorStatModelBase, StandardScalerParams):
+    STAT_NAMES = ("mean", "std")
+
+    def _apply(self, x):
+        if self.with_mean:
+            x = x - self.mean
+        if self.with_std:
+            x = x / np.where(self.std > 0, self.std, 1.0)
+        return x
+
+
+class StandardScaler(Estimator, StandardScalerParams):
+    def fit(self, table: Table) -> StandardScalerModel:
+        x = table.vectors(self.input_col, np.float64)
+        n = x.shape[0]
+        mean = x.mean(axis=0)
+        if n > 1:
+            # ref formula: sqrt((Σx² − n·mean²)/(n−1))
+            std = np.sqrt(np.maximum(
+                ((x * x).sum(axis=0) - n * mean * mean) / (n - 1), 0.0))
+        else:
+            std = np.zeros_like(mean)
+        model = StandardScalerModel(mean=mean, std=std)
+        return self.copy_params_to(model)
+
+
+# ---------------------------------------------------------------------------
+# MinMaxScaler
+# ---------------------------------------------------------------------------
+
+class MinMaxScalerParams(HasInputCol, HasOutputCol):
+    MIN = FloatParam("min", "Lower bound of the output feature range.", 0.0)
+    MAX = FloatParam("max", "Upper bound of the output feature range.", 1.0)
+
+
+class MinMaxScalerModel(_VectorStatModelBase, MinMaxScalerParams):
+    STAT_NAMES = ("data_min", "data_max")
+
+    def _apply(self, x):
+        lo, hi = self.data_min, self.data_max
+        span = hi - lo
+        out_min, out_max = self.min, self.max
+        return np.where(
+            span > 0,
+            (x - lo) / np.where(span > 0, span, 1.0) * (out_max - out_min)
+            + out_min,
+            (out_min + out_max) / 2.0)  # constant dims map to midpoint
+
+
+class MinMaxScaler(Estimator, MinMaxScalerParams):
+    def fit(self, table: Table) -> MinMaxScalerModel:
+        x = table.vectors(self.input_col, np.float64)
+        model = MinMaxScalerModel(data_min=x.min(axis=0),
+                                  data_max=x.max(axis=0))
+        return self.copy_params_to(model)
+
+
+# ---------------------------------------------------------------------------
+# MaxAbsScaler
+# ---------------------------------------------------------------------------
+
+class MaxAbsScalerParams(HasInputCol, HasOutputCol):
+    pass
+
+
+class MaxAbsScalerModel(_VectorStatModelBase, MaxAbsScalerParams):
+    STAT_NAMES = ("max_abs",)
+
+    def _apply(self, x):
+        return x / np.where(self.max_abs > 0, self.max_abs, 1.0)
+
+
+class MaxAbsScaler(Estimator, MaxAbsScalerParams):
+    def fit(self, table: Table) -> MaxAbsScalerModel:
+        x = table.vectors(self.input_col, np.float64)
+        model = MaxAbsScalerModel(max_abs=np.abs(x).max(axis=0))
+        return self.copy_params_to(model)
+
+
+# ---------------------------------------------------------------------------
+# RobustScaler
+# ---------------------------------------------------------------------------
+
+class RobustScalerParams(HasInputCol, HasOutputCol, HasRelativeError):
+    LOWER = FloatParam("lower", "Lower quantile to calculate quantile range.",
+                       0.25, ParamValidators.in_range(0, 1, False, False))
+    UPPER = FloatParam("upper", "Upper quantile to calculate quantile range.",
+                       0.75, ParamValidators.in_range(0, 1, False, False))
+    WITH_CENTERING = BooleanParam(
+        "withCentering", "Whether to center the data with median before "
+        "scaling.", False)
+    WITH_SCALING = BooleanParam(
+        "withScaling", "Whether to scale the data to quantile range.", True)
+
+
+class RobustScalerModel(_VectorStatModelBase, RobustScalerParams):
+    STAT_NAMES = ("medians", "ranges")
+
+    def _apply(self, x):
+        if self.with_centering:
+            x = x - self.medians
+        if self.with_scaling:
+            x = x / np.where(self.ranges > 0, self.ranges, 1.0)
+        return x
+
+
+class RobustScaler(Estimator, RobustScalerParams):
+    def fit(self, table: Table) -> RobustScalerModel:
+        from flink_ml_tpu.ops.quantile import approx_quantiles
+        x = table.vectors(self.input_col, np.float64)
+        qs = approx_quantiles(
+            x, [self.lower, 0.5, self.upper],
+            relative_error=self.relative_error)
+        lo, med, hi = qs[0], qs[1], qs[2]
+        model = RobustScalerModel(medians=med, ranges=hi - lo)
+        return self.copy_params_to(model)

@@ -1,0 +1,191 @@
+"""Feature selectors.
+
+Ref parity: flink-ml-lib feature/{univariatefeatureselector,
+variancethresholdselector}/.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.api.stage import Estimator, Model
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.ops.stats import anova_f_test, chi_square_test, f_value_test
+from flink_ml_tpu.params.param import (
+    FloatParam,
+    ParamValidators,
+    StringParam,
+)
+from flink_ml_tpu.params.shared import (
+    HasFeaturesCol,
+    HasInputCol,
+    HasLabelCol,
+    HasOutputCol,
+)
+from flink_ml_tpu.utils import io as rw
+
+
+class _IndexSelectorModelBase(Model):
+    """A model that slices selected feature indices out of a vector column."""
+
+    def __init__(self, indices: Optional[np.ndarray] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.indices = (None if indices is None
+                        else np.asarray(sorted(int(i) for i in indices),
+                                        np.int64))
+
+    @property
+    def _in_col(self):
+        raise NotImplementedError
+
+    @property
+    def _out_col(self):
+        raise NotImplementedError
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.indices is None:
+            raise ValueError(f"{type(self).__name__} has no model data")
+        x = table.vectors(self._in_col, np.float64)
+        return (table.with_column(self._out_col, x[:, self.indices]),)
+
+    def set_model_data(self, model_data: Table):
+        self.indices = np.asarray(
+            [int(v) for v in model_data.column("indices")], np.int64)
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        return (Table.from_columns(
+            indices=self.indices.astype(np.float64)),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_arrays(path, "model", {"indices": self.indices})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        self.indices = rw.load_model_arrays(path, "model")["indices"]
+
+
+# ---------------------------------------------------------------------------
+# UnivariateFeatureSelector
+# ---------------------------------------------------------------------------
+
+class UnivariateFeatureSelectorModelParams(HasFeaturesCol, HasOutputCol):
+    pass
+
+
+class UnivariateFeatureSelectorParams(UnivariateFeatureSelectorModelParams,
+                                      HasLabelCol):
+    CATEGORICAL = "categorical"
+    CONTINUOUS = "continuous"
+    NUM_TOP_FEATURES = "numTopFeatures"
+    PERCENTILE = "percentile"
+    FPR = "fpr"
+    FDR = "fdr"
+    FWE = "fwe"
+
+    FEATURE_TYPE = StringParam(
+        "featureType", "The feature type.", None,
+        ParamValidators.in_array(CATEGORICAL, CONTINUOUS, None))
+    LABEL_TYPE = StringParam(
+        "labelType", "The label type.", None,
+        ParamValidators.in_array(CATEGORICAL, CONTINUOUS, None))
+    SELECTION_MODE = StringParam(
+        "selectionMode", "The feature selection mode.", NUM_TOP_FEATURES,
+        ParamValidators.in_array(NUM_TOP_FEATURES, PERCENTILE, FPR, FDR, FWE))
+    SELECTION_THRESHOLD = FloatParam(
+        "selectionThreshold",
+        "The upper bound of the features that selector will select. "
+        "Defaults per mode at runtime: numTopFeatures→50, percentile→0.1, "
+        "fpr/fdr/fwe→0.05.", None)
+
+
+class UnivariateFeatureSelectorModel(_IndexSelectorModelBase,
+                                     UnivariateFeatureSelectorModelParams):
+    _in_col = property(lambda self: self.features_col)
+    _out_col = property(lambda self: self.output_col)
+
+
+class UnivariateFeatureSelector(Estimator, UnivariateFeatureSelectorParams):
+    """Select features by univariate test p-values (ref:
+    feature/univariatefeatureselector/UnivariateFeatureSelector.java):
+    chi2 (categorical/categorical), ANOVA (continuous feature? no —
+    continuous features vs categorical label), F-value (continuous/
+    continuous). Modes: numTopFeatures, percentile, fpr, fdr (Benjamini-
+    Hochberg), fwe (Bonferroni)."""
+
+    def fit(self, table: Table) -> UnivariateFeatureSelectorModel:
+        ftype, ltype = self.feature_type, self.label_type
+        if ftype is None or ltype is None:
+            raise ValueError("featureType and labelType must be set")
+        x = table.vectors(self.features_col, np.float64)
+        y = np.asarray(table.column(self.label_col))
+        if ftype == self.CATEGORICAL and ltype == self.CATEGORICAL:
+            _, p_values, _ = chi_square_test(x, y)
+        elif ftype == self.CONTINUOUS and ltype == self.CATEGORICAL:
+            _, p_values, _ = anova_f_test(x, y)
+        elif ftype == self.CONTINUOUS and ltype == self.CONTINUOUS:
+            _, p_values, _ = f_value_test(x, y.astype(np.float64))
+        else:
+            raise ValueError(
+                f"unsupported featureType={ftype!r} labelType={ltype!r}")
+
+        mode = self.selection_mode
+        thr = self.selection_threshold
+        d = x.shape[1]
+        order = np.argsort(p_values, kind="stable")
+        if mode == self.NUM_TOP_FEATURES:
+            k = int(thr) if thr is not None else 50
+            indices = order[:k]
+        elif mode == self.PERCENTILE:
+            frac = thr if thr is not None else 0.1
+            indices = order[: int(d * frac)]
+        elif mode == self.FPR:
+            alpha = thr if thr is not None else 0.05
+            indices = np.nonzero(p_values < alpha)[0]
+        elif mode == self.FDR:
+            alpha = thr if thr is not None else 0.05
+            sorted_p = p_values[order]
+            below = np.nonzero(
+                sorted_p <= alpha * (np.arange(d) + 1) / d)[0]
+            indices = order[: below.max() + 1] if len(below) else \
+                np.asarray([], np.int64)
+        else:  # FWE
+            alpha = thr if thr is not None else 0.05
+            indices = np.nonzero(p_values < alpha / d)[0]
+        model = UnivariateFeatureSelectorModel(indices=indices)
+        return self.copy_params_to(model)
+
+
+# ---------------------------------------------------------------------------
+# VarianceThresholdSelector
+# ---------------------------------------------------------------------------
+
+class VarianceThresholdSelectorModelParams(HasInputCol, HasOutputCol):
+    pass
+
+
+class VarianceThresholdSelectorParams(VarianceThresholdSelectorModelParams):
+    VARIANCE_THRESHOLD = FloatParam(
+        "varianceThreshold",
+        "Features with a variance not greater than this threshold will be "
+        "removed.", 0.0, ParamValidators.gt_eq(0.0))
+
+
+class VarianceThresholdSelectorModel(_IndexSelectorModelBase,
+                                     VarianceThresholdSelectorModelParams):
+    _in_col = property(lambda self: self.input_col)
+    _out_col = property(lambda self: self.output_col)
+
+
+class VarianceThresholdSelector(Estimator, VarianceThresholdSelectorParams):
+    """Keep features whose sample variance exceeds the threshold
+    (ref: feature/variancethresholdselector/)."""
+
+    def fit(self, table: Table) -> VarianceThresholdSelectorModel:
+        x = table.vectors(self.input_col, np.float64)
+        variances = x.var(axis=0, ddof=1) if x.shape[0] > 1 \
+            else np.zeros(x.shape[1])
+        indices = np.nonzero(variances > self.variance_threshold)[0]
+        model = VarianceThresholdSelectorModel(indices=indices)
+        return self.copy_params_to(model)

@@ -1,0 +1,371 @@
+"""Text / bag-of-words ops.
+
+Ref parity: flink-ml-lib feature/{tokenizer,regextokenizer,ngram,
+stopwordsremover,hashingtf,countvectorizer,idf,featurehasher}/.
+
+String data is XLA-hostile by design (SURVEY.md §7): these run host-side on
+object columns; the numeric tails (IDF scaling, TF vectors) hand off to the
+same vector-column fast path as everything else.
+
+Deviations (documented): token hashing uses crc32 rather than the JVM's
+murmur3_32, and the default stop-word list is the standard English list
+rather than a byte-identical copy of the reference's resource file — bucket
+assignments/filtered tokens can differ on individual tokens, the semantics
+(stable hashing / stop-word removal) are identical.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import Tuple
+
+import numpy as np
+
+from flink_ml_tpu.api.stage import Estimator, Model, Transformer
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.linalg.vectors import SparseVector
+from flink_ml_tpu.params.param import (
+    BooleanParam,
+    FloatParam,
+    IntParam,
+    ParamValidators,
+    StringArrayParam,
+    StringParam,
+)
+from flink_ml_tpu.params.shared import (
+    HasCategoricalCols,
+    HasInputCol,
+    HasInputCols,
+    HasNumFeatures,
+    HasOutputCol,
+    HasOutputCols,
+)
+from flink_ml_tpu.utils import io as rw
+
+# the standard English stop-word list (Snowball/NLTK lineage)
+ENGLISH_STOP_WORDS = (
+    "i me my myself we our ours ourselves you your yours yourself yourselves "
+    "he him his himself she her hers herself it its itself they them their "
+    "theirs themselves what which who whom this that these those am is are "
+    "was were be been being have has had having do does did doing a an the "
+    "and but if or because as until while of at by for with about against "
+    "between into through during before after above below to from up down in "
+    "out on off over under again further then once here there when where why "
+    "how all any both each few more most other some such no nor not only own "
+    "same so than too very s t can will just don should now").split()
+
+
+def _hash_index(token: str, num_features: int) -> int:
+    return zlib.crc32(token.encode("utf-8")) % num_features
+
+
+class Tokenizer(Transformer, HasInputCol, HasOutputCol):
+    """Lowercase + whitespace split (ref: feature/tokenizer/Tokenizer.java)."""
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        col = table.column(self.input_col)
+        out = np.empty(len(col), dtype=object)
+        for i, text in enumerate(col):
+            out[i] = str(text).lower().split()
+        return (table.with_column(self.output_col, out),)
+
+
+class RegexTokenizer(Transformer, HasInputCol, HasOutputCol):
+    """Regex split/match tokenization (ref: feature/regextokenizer/):
+    gaps=True → pattern is the delimiter; gaps=False → pattern matches
+    tokens. minTokenLength filters, toLowercase lowercases first."""
+
+    PATTERN = StringParam("pattern", "Regex pattern used for tokenizing.",
+                          "\\s+")
+    GAPS = BooleanParam(
+        "gaps", "Whether the regex splits on gaps (true) or matches tokens "
+        "(false).", True)
+    MIN_TOKEN_LENGTH = IntParam(
+        "minTokenLength", "Minimum token length.", 1,
+        ParamValidators.gt_eq(0))
+    TO_LOWERCASE = BooleanParam(
+        "toLowercase", "Whether to convert all characters to lowercase "
+        "before tokenizing.", True)
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        pattern = re.compile(self.pattern)
+        col = table.column(self.input_col)
+        out = np.empty(len(col), dtype=object)
+        for i, text in enumerate(col):
+            text = str(text)
+            if self.to_lowercase:
+                text = text.lower()
+            tokens = (pattern.split(text) if self.gaps
+                      else pattern.findall(text))
+            out[i] = [t for t in tokens if len(t) >= self.min_token_length]
+        return (table.with_column(self.output_col, out),)
+
+
+class NGram(Transformer, HasInputCol, HasOutputCol):
+    """Space-joined n-grams over a token array (ref: feature/ngram/)."""
+
+    N = IntParam("n", "Number of elements per n-gram (>=1).", 2,
+                 ParamValidators.gt_eq(1))
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        n = self.n
+        col = table.column(self.input_col)
+        out = np.empty(len(col), dtype=object)
+        for i, tokens in enumerate(col):
+            tokens = list(tokens)
+            out[i] = [" ".join(tokens[j:j + n])
+                      for j in range(len(tokens) - n + 1)]
+        return (table.with_column(self.output_col, out),)
+
+
+class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
+    """Filter stop words from token arrays (ref: feature/stopwordsremover/ —
+    stopWords default English; caseSensitive default false; locale for the
+    case-insensitive fold)."""
+
+    STOP_WORDS = StringArrayParam(
+        "stopWords", "The words to be filtered out.",
+        tuple(ENGLISH_STOP_WORDS))
+    CASE_SENSITIVE = BooleanParam(
+        "caseSensitive", "Whether to do a case-sensitive comparison over "
+        "the stop words.", False)
+    LOCALE = StringParam("locale", "Locale of the input for case-insensitive "
+                         "matching.", "en_US")
+
+    @staticmethod
+    def load_default_stop_words(language: str):
+        """Ref API parity: StopWordsRemover.loadDefaultStopWords."""
+        if language != "english":
+            raise ValueError(f"no built-in stop words for {language!r}; "
+                             "set stopWords explicitly")
+        return list(ENGLISH_STOP_WORDS)
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.case_sensitive:
+            stop = set(self.stop_words)
+            keep = lambda t: t not in stop
+        else:
+            stop = {w.lower() for w in self.stop_words}
+            keep = lambda t: t.lower() not in stop
+        outs = {}
+        for name, out_name in zip(self.input_cols, self.output_cols):
+            col = table.column(name)
+            out = np.empty(len(col), dtype=object)
+            for i, tokens in enumerate(col):
+                out[i] = [t for t in tokens if keep(t)]
+            outs[out_name] = out
+        return (table.with_columns(**outs),)
+
+
+class HashingTF(Transformer, HasInputCol, HasOutputCol, HasNumFeatures):
+    """Hash token arrays into fixed-size term-frequency vectors
+    (ref: feature/hashingtf/ — numFeatures default 262144; binary flag)."""
+
+    BINARY = BooleanParam(
+        "binary", "Whether each dimension of the output vector is binary "
+        "(1 when the term occurs) or the term frequency.", False)
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        m = self.num_features
+        col = table.column(self.input_col)
+        out = np.empty(len(col), dtype=object)
+        for i, tokens in enumerate(col):
+            counts = {}
+            for t in tokens:
+                idx = _hash_index(str(t), m)
+                counts[idx] = counts.get(idx, 0) + 1
+            indices = sorted(counts)
+            values = [1.0 if self.binary else float(counts[j])
+                      for j in indices]
+            out[i] = SparseVector(m, indices, values)
+        return (table.with_column(self.output_col, out),)
+
+
+class FeatureHasher(Transformer, HasInputCols, HasOutputCol, HasNumFeatures,
+                    HasCategoricalCols):
+    """Hash mixed numeric/categorical columns into one vector
+    (ref: feature/featurehasher/): numeric column → index hash(colName) with
+    the value; categorical (string/bool or listed in categoricalCols) →
+    index hash("colName=value") with 1.0."""
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        m = self.num_features
+        categorical = set(self.categorical_cols or ())
+        cols = [(name, table.column(name)) for name in self.input_cols]
+        out = np.empty(table.num_rows, dtype=object)
+        for i in range(table.num_rows):
+            entries = {}
+            for name, col in cols:
+                v = col[i]
+                if name in categorical or isinstance(v, (str, bool, np.bool_)):
+                    idx = _hash_index(f"{name}={v}", m)
+                    entries[idx] = entries.get(idx, 0.0) + 1.0
+                else:
+                    idx = _hash_index(name, m)
+                    entries[idx] = entries.get(idx, 0.0) + float(v)
+            indices = sorted(entries)
+            out[i] = SparseVector(m, indices, [entries[j] for j in indices])
+        return (table.with_column(self.output_col, out),)
+
+
+# ---------------------------------------------------------------------------
+# CountVectorizer
+# ---------------------------------------------------------------------------
+
+class CountVectorizerModelParams(HasInputCol, HasOutputCol):
+    MIN_TF = FloatParam(
+        "minTF", "Filter to ignore rare words in a document (count or "
+        "fraction of the document's token count when < 1).", 1.0,
+        ParamValidators.gt_eq(0.0))
+    BINARY = BooleanParam(
+        "binary", "Binary toggle to control the output vector values.", False)
+
+
+class CountVectorizerParams(CountVectorizerModelParams):
+    VOCABULARY_SIZE = IntParam(
+        "vocabularySize", "Max size of the vocabulary.", 1 << 18,
+        ParamValidators.gt(0))
+    MIN_DF = FloatParam(
+        "minDF", "Minimum number (or fraction) of documents a term must "
+        "appear in to be included.", 1.0, ParamValidators.gt_eq(0.0))
+    MAX_DF = FloatParam(
+        "maxDF", "Maximum number (or fraction) of documents a term may "
+        "appear in to be included.", 2 ** 63 - 1, ParamValidators.gt_eq(0.0))
+
+
+class CountVectorizerModel(Model, CountVectorizerModelParams):
+    def __init__(self, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self.vocabulary = None if vocabulary is None else list(vocabulary)
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.vocabulary is None:
+            raise ValueError("CountVectorizerModel has no model data")
+        index = {t: i for i, t in enumerate(self.vocabulary)}
+        size = len(self.vocabulary)
+        col = table.column(self.input_col)
+        out = np.empty(len(col), dtype=object)
+        for i, tokens in enumerate(col):
+            tokens = list(tokens)
+            counts = {}
+            for t in tokens:
+                j = index.get(str(t))
+                if j is not None:
+                    counts[j] = counts.get(j, 0) + 1
+            min_tf = (self.min_tf if self.min_tf >= 1.0
+                      else self.min_tf * len(tokens))
+            counts = {j: c for j, c in counts.items() if c >= min_tf}
+            indices = sorted(counts)
+            values = [1.0 if self.binary else float(counts[j])
+                      for j in indices]
+            out[i] = SparseVector(size, indices, values)
+        return (table.with_column(self.output_col, out),)
+
+    def set_model_data(self, model_data: Table):
+        self.vocabulary = [str(t) for t in model_data.column("vocabulary")]
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        return (Table.from_columns(
+            vocabulary=np.asarray(self.vocabulary, dtype=object)),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_json(path, "model", {"vocabulary": self.vocabulary})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        self.vocabulary = rw.load_model_json(path, "model")["vocabulary"]
+
+
+class CountVectorizer(Estimator, CountVectorizerParams):
+    """Learn a frequency-ordered vocabulary from token arrays
+    (ref: feature/countvectorizer/ — terms ordered by corpus frequency desc,
+    filtered by minDF/maxDF as counts (≥1) or fractions (<1), truncated to
+    vocabularySize)."""
+
+    def fit(self, table: Table) -> CountVectorizerModel:
+        col = table.column(self.input_col)
+        n_docs = len(col)
+        term_count, doc_freq = {}, {}
+        for tokens in col:
+            seen = set()
+            for t in tokens:
+                t = str(t)
+                term_count[t] = term_count.get(t, 0) + 1
+                if t not in seen:
+                    seen.add(t)
+                    doc_freq[t] = doc_freq.get(t, 0) + 1
+        min_df = self.min_df if self.min_df >= 1.0 else self.min_df * n_docs
+        max_df = self.max_df if self.max_df >= 1.0 else self.max_df * n_docs
+        terms = [t for t in term_count
+                 if min_df <= doc_freq[t] <= max_df]
+        terms.sort(key=lambda t: (-term_count[t], t))
+        vocab = terms[: self.vocabulary_size]
+        model = CountVectorizerModel(vocabulary=vocab)
+        return self.copy_params_to(model)
+
+
+# ---------------------------------------------------------------------------
+# IDF
+# ---------------------------------------------------------------------------
+
+class IDFModelParams(HasInputCol, HasOutputCol):
+    pass
+
+
+class IDFParams(IDFModelParams):
+    MIN_DOC_FREQ = IntParam(
+        "minDocFreq", "Minimum number of documents in which a term should "
+        "appear for filtering.", 0, ParamValidators.gt_eq(0))
+
+
+class IDFModel(Model, IDFModelParams):
+    def __init__(self, idf=None, doc_freq=None, num_docs=0, **kwargs):
+        super().__init__(**kwargs)
+        self.idf = None if idf is None else np.asarray(idf, np.float64)
+        self.doc_freq = (None if doc_freq is None
+                         else np.asarray(doc_freq, np.int64))
+        self.num_docs = int(num_docs)
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.idf is None:
+            raise ValueError("IDFModel has no model data")
+        x = table.vectors(self.input_col, np.float64)
+        return (table.with_column(self.output_col, x * self.idf[None, :]),)
+
+    def set_model_data(self, model_data: Table):
+        self.idf = model_data.vectors("idf", np.float64)[0]
+        self.doc_freq = model_data.vectors("docFreq", np.float64)[0].astype(
+            np.int64)
+        self.num_docs = int(model_data.scalars("numDocs")[0])
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        return (Table.from_columns(
+            idf=self.idf[None, :],
+            docFreq=self.doc_freq.astype(np.float64)[None, :],
+            numDocs=np.asarray([self.num_docs], np.float64)),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_arrays(path, "model", {
+            "idf": self.idf, "docFreq": self.doc_freq,
+            "numDocs": np.asarray([self.num_docs])})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        arrays = rw.load_model_arrays(path, "model")
+        self.idf, self.doc_freq = arrays["idf"], arrays["docFreq"]
+        self.num_docs = int(arrays["numDocs"][0])
+
+
+class IDF(Estimator, IDFParams):
+    """Inverse document frequency: idf = log((m+1)/(df+1)); dims with
+    df < minDocFreq get idf 0 (ref: feature/idf/IDF.java)."""
+
+    def fit(self, table: Table) -> IDFModel:
+        x = table.vectors(self.input_col, np.float64)
+        m = x.shape[0]
+        df = (x != 0).sum(axis=0)
+        idf = np.log((m + 1.0) / (df + 1.0))
+        idf = np.where(df >= self.min_doc_freq, idf, 0.0)
+        model = IDFModel(idf=idf, doc_freq=df.astype(np.int64), num_docs=m)
+        return self.copy_params_to(model)

@@ -1,0 +1,225 @@
+"""Stateless vector transformers.
+
+Ref parity: flink-ml-lib feature/{normalizer,elementwiseproduct,
+polynomialexpansion,dct,interaction,vectorassembler,vectorslicer,binarizer,
+bucketizer}/ — record-wise transforms, vectorized over the whole column.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Tuple
+
+import numpy as np
+import scipy.fft
+
+from flink_ml_tpu.api.stage import Transformer
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.params.param import (
+    BooleanParam,
+    FloatArrayArrayParam,
+    FloatArrayParam,
+    FloatParam,
+    IntArrayParam,
+    IntParam,
+    ParamValidators,
+    VectorParam,
+)
+from flink_ml_tpu.params.shared import (
+    HasHandleInvalid,
+    HasInputCol,
+    HasInputCols,
+    HasOutputCol,
+    HasOutputCols,
+)
+
+
+class Normalizer(Transformer, HasInputCol, HasOutputCol):
+    """v → v/‖v‖_p (ref: feature/normalizer/Normalizer.java; p ≥ 1, default 2)."""
+
+    P = FloatParam("p", "The p norm value.", 2.0, ParamValidators.gt_eq(1.0))
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        x = table.vectors(self.input_col, np.float64)
+        if np.isinf(self.p):
+            norms = np.abs(x).max(axis=1)
+        else:
+            norms = (np.abs(x) ** self.p).sum(axis=1) ** (1.0 / self.p)
+        out = x / np.where(norms > 0, norms, 1.0)[:, None]
+        return (table.with_column(self.output_col, out),)
+
+
+class ElementwiseProduct(Transformer, HasInputCol, HasOutputCol):
+    """v → v ∘ scalingVec (ref: feature/elementwiseproduct/)."""
+
+    SCALING_VEC = VectorParam("scalingVec", "The scaling vector.", None)
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.scaling_vec is None:
+            raise ValueError("scalingVec must be set")
+        x = table.vectors(self.input_col, np.float64)
+        s = self.scaling_vec.to_array()
+        return (table.with_column(self.output_col, x * s[None, :]),)
+
+
+class PolynomialExpansion(Transformer, HasInputCol, HasOutputCol):
+    """All monomials of the input features up to ``degree``
+    (ref: feature/polynomialexpansion/; degree ≥ 1, default 2). Monomials are
+    ordered by total degree, then by combination order over feature indices."""
+
+    DEGREE = IntParam("degree", "Degree of the polynomial expansion.", 2,
+                      ParamValidators.gt_eq(1))
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        x = table.vectors(self.input_col, np.float64)
+        d = x.shape[1]
+        cols = []
+        for deg in range(1, self.degree + 1):
+            for combo in itertools.combinations_with_replacement(range(d), deg):
+                prod = np.ones(x.shape[0])
+                for idx in combo:
+                    prod = prod * x[:, idx]
+                cols.append(prod)
+        return (table.with_column(self.output_col, np.stack(cols, axis=1)),)
+
+
+class DCT(Transformer, HasInputCol, HasOutputCol):
+    """Orthonormal DCT-II (or its inverse) per vector (ref: feature/dct/)."""
+
+    INVERSE = BooleanParam(
+        "inverse", "Whether to perform the inverse DCT (true) or forward "
+        "DCT (false).", False)
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        x = table.vectors(self.input_col, np.float64)
+        fn = scipy.fft.idct if self.inverse else scipy.fft.dct
+        out = fn(x, type=2, norm="ortho", axis=1)
+        return (table.with_column(self.output_col, out),)
+
+
+class Interaction(Transformer, HasInputCols, HasOutputCol):
+    """Flattened outer product of the input columns' values
+    (ref: feature/interaction/ — scalar columns count as 1-dim vectors)."""
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        mats = []
+        for name in self.input_cols:
+            col = table.column(name)
+            mats.append(table.vectors(name, np.float64)
+                        if col.dtype == object or col.ndim == 2
+                        else np.asarray(col, np.float64)[:, None])
+        out = mats[0]
+        for m in mats[1:]:
+            out = (out[:, :, None] * m[:, None, :]).reshape(out.shape[0], -1)
+        return (table.with_column(self.output_col, out),)
+
+
+class VectorAssembler(Transformer, HasInputCols, HasOutputCol,
+                      HasHandleInvalid):
+    """Concatenate scalar/vector columns into one vector
+    (ref: feature/vectorassembler/). handleInvalid: error (default) raises on
+    NaN, skip drops the row, keep passes NaN through."""
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        mats = []
+        for name in self.input_cols:
+            col = table.column(name)
+            if col.dtype == object or col.ndim == 2:
+                mats.append(table.vectors(name, np.float64))
+            else:
+                mats.append(np.asarray(col, np.float64)[:, None])
+        out = np.concatenate(mats, axis=1)
+        invalid = np.isnan(out).any(axis=1)
+        if invalid.any():
+            if self.handle_invalid == self.ERROR_INVALID:
+                raise ValueError(
+                    f"Encountered NaN while assembling rows "
+                    f"{np.nonzero(invalid)[0][:5].tolist()}... "
+                    f"(handleInvalid=error)")
+            if self.handle_invalid == self.SKIP_INVALID:
+                keep = ~invalid
+                return (table.take(np.nonzero(keep)[0])
+                        .with_column(self.output_col, out[keep]),)
+        return (table.with_column(self.output_col, out),)
+
+
+class VectorSlicer(Transformer, HasInputCol, HasOutputCol):
+    """Select sub-vector by indices (ref: feature/vectorslicer/)."""
+
+    INDICES = IntArrayParam(
+        "indices", "An array of indices to select features from a vector "
+        "column.", None, ParamValidators.non_empty_array())
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        idx = np.asarray(self.indices, np.int64)
+        if (idx < 0).any():
+            raise ValueError("indices must be non-negative")
+        x = table.vectors(self.input_col, np.float64)
+        return (table.with_column(self.output_col, x[:, idx]),)
+
+
+class Binarizer(Transformer, HasInputCols, HasOutputCols):
+    """Per-column thresholding to {0,1}; value > threshold → 1
+    (ref: feature/binarizer/ — works on scalar and vector columns)."""
+
+    THRESHOLDS = FloatArrayParam(
+        "thresholds", "The thresholds used to binarize continuous features.",
+        None, ParamValidators.non_empty_array())
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.thresholds is None or \
+                len(self.thresholds) != len(self.input_cols):
+            raise ValueError("thresholds must match inputCols length")
+        out = {}
+        for name, out_name, thr in zip(self.input_cols, self.output_cols,
+                                       self.thresholds):
+            col = table.column(name)
+            if col.dtype == object or col.ndim == 2:
+                out[out_name] = (table.vectors(name, np.float64)
+                                 > thr).astype(np.float64)
+            else:
+                out[out_name] = (np.asarray(col, np.float64)
+                                 > thr).astype(np.float64)
+        return (table.with_columns(**out),)
+
+
+class Bucketizer(Transformer, HasInputCols, HasOutputCols, HasHandleInvalid):
+    """Map continuous scalars to bucket indices by split points
+    (ref: feature/bucketizer/ — splitsArray is one strictly-increasing split
+    array per input column; value in [splits[i], splits[i+1]) → bucket i.
+    handleInvalid: keep → extra bucket numBuckets, skip → drop row,
+    error → raise)."""
+
+    SPLITS_ARRAY = FloatArrayArrayParam(
+        "splitsArray", "Array of split points for mapping continuous "
+        "features into buckets.", None, ParamValidators.non_empty_array())
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        splits_array = self.splits_array
+        if splits_array is None or len(splits_array) != len(self.input_cols):
+            raise ValueError("splitsArray must match inputCols length")
+        outs, invalid_any = {}, np.zeros(table.num_rows, bool)
+        for name, out_name, splits in zip(self.input_cols, self.output_cols,
+                                          splits_array):
+            splits = np.asarray(splits, np.float64)
+            if len(splits) < 3 or not (np.diff(splits) > 0).all():
+                raise ValueError(
+                    f"splits for {name!r} must be strictly increasing with "
+                    f"at least 3 points")
+            v = np.asarray(table.column(name), np.float64)
+            bucket = np.searchsorted(splits, v, side="right") - 1
+            # the top boundary belongs to the last bucket
+            bucket = np.where(v == splits[-1], len(splits) - 2, bucket)
+            invalid = (v < splits[0]) | (v > splits[-1]) | np.isnan(v)
+            bucket = np.where(invalid, len(splits) - 1, bucket)
+            invalid_any |= invalid
+            outs[out_name] = bucket.astype(np.float64)
+        if invalid_any.any():
+            if self.handle_invalid == self.ERROR_INVALID:
+                raise ValueError("invalid values encountered in Bucketizer "
+                                 "(handleInvalid=error)")
+            if self.handle_invalid == self.SKIP_INVALID:
+                keep = np.nonzero(~invalid_any)[0]
+                kept = {k: v[keep] for k, v in outs.items()}
+                return (table.take(keep).with_columns(**kept),)
+        return (table.with_columns(**outs),)

@@ -1,0 +1,147 @@
+"""ε-approximate quantiles.
+
+Ref parity: flink-ml-lib/.../common/util/QuantileSummary.java:42 — the
+Greenwald-Khanna summary (insert buffer, compress threshold 10000, merge,
+query) backing the ``relativeError`` param of RobustScaler, Imputer and
+KBinsDiscretizer.
+
+Two tiers:
+- :class:`QuantileSummary` — a faithful GK sketch for streaming/merge use
+  (online pipelines, bounded memory).
+- :func:`approx_quantiles` — the batch path: exact numpy quantiles over the
+  materialized column (an exact answer trivially satisfies any ε bound; the
+  reference only sketches because its input is an unbounded stream).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class _Tuple:
+    value: float
+    g: int       # rank gap to the previous tuple
+    delta: int   # max rank uncertainty
+
+
+class QuantileSummary:
+    """Greenwald-Khanna ε-approximate quantile sketch
+    (ref: QuantileSummary.java — defaultCompressThreshold 10000)."""
+
+    COMPRESS_THRESHOLD = 10000
+
+    def __init__(self, relative_error: float = 0.001,
+                 compress_threshold: int = COMPRESS_THRESHOLD):
+        if not 0 < relative_error <= 1:
+            raise ValueError("relative_error must be in (0, 1]")
+        self.eps = relative_error
+        self.compress_threshold = compress_threshold
+        self._sampled: List[_Tuple] = []
+        self._buffer: List[float] = []
+        self.count = 0
+
+    # -- build ---------------------------------------------------------------
+    def insert(self, value: float) -> None:
+        self._buffer.append(value)
+        if len(self._buffer) >= self.compress_threshold:
+            self._flush()
+
+    def insert_all(self, values) -> None:
+        for v in np.asarray(values, np.float64).ravel():
+            self.insert(float(v))
+
+    def _flush(self) -> None:
+        if not self._buffer:
+            return
+        self._buffer.sort()
+        sampled = self._sampled
+        merged: List[_Tuple] = []
+        threshold = 2 * self.eps * max(self.count + len(self._buffer), 1)
+        si, n_new = 0, len(self._buffer)
+        for bi, value in enumerate(self._buffer):
+            while si < len(sampled) and sampled[si].value <= value:
+                merged.append(sampled[si])
+                si += 1
+            # head/tail inserts get delta 0 so min/max queries stay exact
+            # (ref QuantileSummary.java insertion rule)
+            is_min = not merged
+            is_max = bi == n_new - 1 and si >= len(sampled)
+            if is_min or is_max:
+                delta = 0
+            else:
+                delta = max(int(np.floor(threshold)) - 1, 0)
+            merged.append(_Tuple(value, 1, delta))
+        merged.extend(sampled[si:])
+        self.count += n_new
+        self._buffer = []
+        self._sampled = merged
+        self._compress()
+
+    def _compress(self) -> None:
+        if len(self._sampled) < 2:
+            return
+        threshold = 2 * self.eps * self.count
+        out = [self._sampled[0]]
+        for t in self._sampled[1:-1]:
+            last = out[-1]
+            if last is not self._sampled[0] and \
+                    last.g + t.g + t.delta < threshold:
+                out[-1] = _Tuple(t.value, last.g + t.g, t.delta)
+            else:
+                out.append(t)
+        out.append(self._sampled[-1])
+        self._sampled = out
+
+    def merge(self, other: "QuantileSummary") -> "QuantileSummary":
+        result = QuantileSummary(min(self.eps, other.eps),
+                                 self.compress_threshold)
+        for s in (self, other):
+            s._flush()
+        merged = sorted(self._sampled + other._sampled,
+                        key=lambda t: t.value)
+        result._sampled = merged
+        result.count = self.count + other.count
+        result._compress()
+        return result
+
+    # -- query ---------------------------------------------------------------
+    def query(self, prob: float) -> float:
+        if not 0 <= prob <= 1:
+            raise ValueError("prob must be in [0, 1]")
+        self._flush()
+        if not self._sampled:
+            raise ValueError("query on empty summary")
+        rank = prob * (self.count - 1) + 1
+        # boundary ranks are exact (head/tail tuples carry delta 0)
+        if rank <= 1:
+            return self._sampled[0].value
+        if rank >= self.count:
+            return self._sampled[-1].value
+        margin = self.eps * self.count
+        min_rank = 0
+        for t in self._sampled:
+            min_rank += t.g
+            max_rank = min_rank + t.delta
+            if max_rank - margin <= rank <= min_rank + margin:
+                return t.value
+        return self._sampled[-1].value
+
+    def query_all(self, probs: Sequence[float]) -> np.ndarray:
+        return np.asarray([self.query(p) for p in probs])
+
+
+def approx_quantiles(x: np.ndarray, probs: Sequence[float],
+                     relative_error: float = 0.001) -> np.ndarray:
+    """Per-column quantiles of a (n, d) array → (len(probs), d).
+
+    Batch path: numpy's exact linear-interpolation-free 'lower' quantile
+    matches the GK sketch's behavior of returning an actual data value.
+    """
+    x = np.asarray(x, np.float64)
+    if x.ndim == 1:
+        x = x[:, None]
+    return np.quantile(x, np.asarray(probs), axis=0, method="lower")

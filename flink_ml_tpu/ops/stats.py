@@ -1,0 +1,76 @@
+"""Feature↔label statistical tests.
+
+Ref parity: the numeric cores of flink-ml-lib stats/{chisqtest,anovatest,
+fvaluetest} and the univariate feature selector. Implemented with scipy
+(host-side — these are keyed aggregations over modest cardinalities, not
+MXU work).
+
+Each function takes features (n, d) and labels (n,) and returns
+(statistics (d,), p_values (d,), degrees_of_freedom (d,)).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+from scipy import stats as sstats
+
+Arrays = Tuple[np.ndarray, np.ndarray, np.ndarray]
+
+
+def chi_square_test(features: np.ndarray, labels: np.ndarray) -> Arrays:
+    """Pearson chi-squared independence test per feature column
+    (ref: stats/chisqtest/ChiSqTest.java — categorical feature vs
+    categorical label)."""
+    features = np.asarray(features)
+    labels = np.asarray(labels)
+    stats_, ps, dofs = [], [], []
+    for j in range(features.shape[1]):
+        col = features[:, j]
+        f_vals, f_idx = np.unique(col, return_inverse=True)
+        l_vals, l_idx = np.unique(labels, return_inverse=True)
+        table = np.zeros((len(f_vals), len(l_vals)))
+        np.add.at(table, (f_idx, l_idx), 1.0)
+        chi2, p, dof, _ = sstats.chi2_contingency(table, correction=False)
+        stats_.append(chi2)
+        ps.append(p)
+        dofs.append(dof)
+    return np.asarray(stats_), np.asarray(ps), np.asarray(dofs, np.int64)
+
+
+def anova_f_test(features: np.ndarray, labels: np.ndarray) -> Arrays:
+    """One-way ANOVA F-test per feature (ref: stats/anovatest/ANOVATest.java
+    — continuous feature vs categorical label)."""
+    features = np.asarray(features, np.float64)
+    labels = np.asarray(labels)
+    classes = np.unique(labels)
+    stats_, ps, dofs = [], [], []
+    n = features.shape[0]
+    for j in range(features.shape[1]):
+        groups = [features[labels == c, j] for c in classes]
+        f, p = sstats.f_oneway(*groups)
+        stats_.append(f)
+        ps.append(p)
+        dofs.append(n - len(classes))
+    return np.asarray(stats_), np.asarray(ps), np.asarray(dofs, np.int64)
+
+
+def f_value_test(features: np.ndarray, labels: np.ndarray) -> Arrays:
+    """Univariate linear-regression F-test per feature
+    (ref: stats/fvaluetest/FValueTest.java — continuous vs continuous)."""
+    x = np.asarray(features, np.float64)
+    y = np.asarray(labels, np.float64)
+    n, d = x.shape
+    dof = n - 2
+    xc = x - x.mean(axis=0)
+    yc = y - y.mean()
+    denom = np.sqrt((xc * xc).sum(axis=0) * (yc * yc).sum())
+    corr = np.where(denom > 0, (xc * yc[:, None]).sum(axis=0)
+                    / np.where(denom > 0, denom, 1.0), 0.0)
+    corr = np.clip(corr, -1.0, 1.0)
+    f = np.where(corr ** 2 < 1.0,
+                 corr ** 2 / np.maximum(1.0 - corr ** 2, 1e-300) * dof,
+                 np.inf)
+    p = sstats.f.sf(f, 1, dof)
+    return f, p, np.full(d, dof, np.int64)

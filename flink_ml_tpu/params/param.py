@@ -18,7 +18,9 @@ from typing import Any, Callable, Generic, List, Optional, Sequence, TypeVar
 
 T = TypeVar("T")
 
-_CAMEL_RE = re.compile(r"(?<!^)(?=[A-Z])")
+# word boundary before an uppercase run start, treating acronyms as one word:
+# minDF → min_df, rawPredictionCol → raw_prediction_col
+_CAMEL_RE = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
 
 
 def camel_to_snake(name: str) -> str:
@@ -391,6 +393,15 @@ class WithParams:
                 self.set(param, value)
                 return
         super().__setattr__(name, value)
+
+    def copy_params_to(self, dst: "WithParams") -> "WithParams":
+        """Copy every param the destination also declares (ref:
+        ParamUtils.updateExistingParams — estimator→model propagation)."""
+        for name, value in self.params_to_json().items():
+            param = dst._find_param(name)
+            if param is not None:
+                dst.set(param, param.json_decode(value))
+        return dst
 
     # -- JSON round-trip (ref: ParamUtils + ReadWriteUtils metadata) --------
     def params_to_json(self) -> dict:

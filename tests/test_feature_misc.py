@@ -1,0 +1,133 @@
+"""Imputer / RandomSplitter / SQLTransformer / MinHashLSH / quantile tests."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.feature import (
+    MinHashLSH,
+    MinHashLSHModel,
+    RandomSplitter,
+    SQLTransformer,
+)
+from flink_ml_tpu.models.feature.misc import Imputer, ImputerModel
+from flink_ml_tpu.ops.quantile import QuantileSummary, approx_quantiles
+
+
+def test_imputer_strategies():
+    t = Table.from_columns(
+        a=np.array([1.0, np.nan, 3.0, np.nan]),
+        b=np.array([5.0, 5.0, 7.0, np.nan]))
+    mean_model = Imputer(input_cols=["a", "b"],
+                         output_cols=["ao", "bo"]).fit(t)
+    assert mean_model.surrogates == [2.0, pytest.approx(17 / 3)]
+    out = mean_model.transform(t)[0]
+    np.testing.assert_allclose(out["ao"], [1, 2, 3, 2])
+
+    med = Imputer(input_cols=["a", "b"], output_cols=["ao", "bo"],
+                  strategy="median").fit(t)
+    assert med.surrogates[1] == 5.0
+    freq = Imputer(input_cols=["a", "b"], output_cols=["ao", "bo"],
+                   strategy="most_frequent").fit(t)
+    assert freq.surrogates[1] == 5.0
+
+
+def test_imputer_custom_missing_value():
+    t = Table.from_columns(a=np.array([1.0, -999.0, 3.0]))
+    model = Imputer(input_cols=["a"], output_cols=["ao"],
+                    missing_value=-999.0).fit(t)
+    assert model.surrogates == [2.0]
+    out = model.transform(t)[0]["ao"]
+    np.testing.assert_allclose(out, [1, 2, 3])
+
+
+def test_imputer_save_load(tmp_path):
+    t = Table.from_columns(a=np.array([1.0, np.nan]))
+    model = Imputer(input_cols=["a"], output_cols=["ao"]).fit(t)
+    model.save(str(tmp_path / "im"))
+    reloaded = ImputerModel.load(str(tmp_path / "im"))
+    assert reloaded.surrogates == model.surrogates
+
+
+def test_random_splitter(rng):
+    t = Table.from_columns(x=np.arange(10000.0))
+    a, b = RandomSplitter(weights=[8.0, 2.0], seed=4).transform(t)
+    assert a.num_rows + b.num_rows == 10000
+    assert abs(a.num_rows - 8000) < 200
+    # deterministic given a seed
+    a2, _ = RandomSplitter(weights=[8.0, 2.0], seed=4).transform(t)
+    np.testing.assert_array_equal(a["x"], a2["x"])
+    # three-way
+    parts = RandomSplitter(weights=[1.0, 1.0, 2.0], seed=0).transform(t)
+    assert len(parts) == 3
+
+
+def test_sql_transformer():
+    t = Table.from_columns(v1=np.array([1.0, 2.0]), v2=np.array([10.0, 20.0]))
+    op = SQLTransformer(
+        statement="SELECT *, (v1 + v2) AS v3 FROM __THIS__ WHERE v1 > 1")
+    out = op.transform(t)[0]
+    assert out.column_names == ["v1", "v2", "v3"]
+    np.testing.assert_allclose(out["v3"], [22.0])
+    with pytest.raises(ValueError):
+        SQLTransformer(statement="SELECT 1").transform(t)
+
+
+def test_minhash_lsh(tmp_path):
+    col = np.empty(4, dtype=object)
+    col[0] = Vectors.sparse(10, [0, 1, 2], [1, 1, 1])
+    col[1] = Vectors.sparse(10, [0, 1, 2], [1, 1, 1])   # identical to row 0
+    col[2] = Vectors.sparse(10, [0, 1, 3], [1, 1, 1])   # jaccard 0.5 to row 0
+    col[3] = Vectors.sparse(10, [7, 8, 9], [1, 1, 1])   # disjoint
+    t = Table.from_columns(id=np.arange(4.0), vec=col)
+    model = MinHashLSH(input_col="vec", output_col="hashes",
+                       num_hash_tables=4, seed=11).fit(t)
+    out = model.transform(t)[0]["hashes"]
+    assert len(out[0]) == 4  # one vector per hash table
+    # identical sets → identical hashes
+    assert all((a.to_array() == b.to_array()).all()
+               for a, b in zip(out[0], out[1]))
+
+    nn = model.approx_nearest_neighbors(t, Vectors.sparse(10, [0, 1, 2],
+                                                          [1, 1, 1]), k=2)
+    assert nn.num_rows == 2
+    assert set(nn["id"]) == {0.0, 1.0}
+    np.testing.assert_allclose(nn["distCol"], [0.0, 0.0])
+
+    joined = model.approx_similarity_join(t, t, 0.6, "id")
+    pairs = set(zip(joined["idA"].astype(int), joined["idB"].astype(int)))
+    assert (0, 1) in pairs and (0, 2) in pairs and (0, 3) not in pairs
+
+    model.save(str(tmp_path / "lsh"))
+    reloaded = MinHashLSHModel.load(str(tmp_path / "lsh"))
+    out2 = reloaded.transform(t)[0]["hashes"]
+    assert all((a.to_array() == b.to_array()).all()
+               for a, b in zip(out[0], out2[0]))
+
+
+def test_quantile_summary_gk(rng):
+    data = rng.normal(size=5000)
+    qs = QuantileSummary(relative_error=0.01, compress_threshold=500)
+    qs.insert_all(data)
+    for p in (0.1, 0.5, 0.9):
+        got = qs.query(p)
+        exact = np.quantile(data, p)
+        # rank error within epsilon bound (translate to value via order stats)
+        rank_got = (data <= got).mean()
+        assert abs(rank_got - p) < 0.05
+    # merge two summaries
+    qs2 = QuantileSummary(relative_error=0.01, compress_threshold=500)
+    qs2.insert_all(rng.normal(size=5000) + 10)
+    merged = qs.merge(qs2)
+    assert merged.count == 10000
+    med = merged.query(0.5)
+    assert 1.0 < med < 11.0
+
+
+def test_approx_quantiles_matrix(rng):
+    x = rng.normal(size=(1000, 3))
+    q = approx_quantiles(x, [0.25, 0.5, 0.75])
+    assert q.shape == (3, 3)
+    np.testing.assert_allclose(
+        q[1], np.quantile(x, 0.5, axis=0, method="lower"))

@@ -1,0 +1,143 @@
+"""Scaler + selector tests vs sklearn/numpy oracles (ref: feature/*Test.java)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.models.feature import (
+    MaxAbsScaler,
+    MinMaxScaler,
+    RobustScaler,
+    StandardScaler,
+    StandardScalerModel,
+    UnivariateFeatureSelector,
+    VarianceThresholdSelector,
+)
+
+
+@pytest.fixture
+def xtable(rng):
+    x = rng.normal(size=(50, 4)) * np.array([1.0, 5.0, 0.1, 10.0]) + \
+        np.array([0.0, 3.0, -1.0, 100.0])
+    return Table.from_columns(input=x), x
+
+
+def test_standard_scaler(xtable):
+    table, x = xtable
+    model = StandardScaler().fit(table)
+    np.testing.assert_allclose(model.mean, x.mean(axis=0), rtol=1e-12)
+    np.testing.assert_allclose(model.std, x.std(axis=0, ddof=1), rtol=1e-12)
+    # default: withStd only
+    out = model.transform(table)[0]["output"]
+    np.testing.assert_allclose(out, x / x.std(axis=0, ddof=1), rtol=1e-6)
+    # withMean too
+    model.set_with_mean(True)
+    out = model.transform(table)[0]["output"]
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-9)
+    np.testing.assert_allclose(out.std(axis=0, ddof=1), 1.0, rtol=1e-6)
+
+
+def test_standard_scaler_save_load(xtable, tmp_path):
+    table, _ = xtable
+    model = StandardScaler().set_with_mean(True).fit(table)
+    model.save(str(tmp_path / "ss"))
+    reloaded = StandardScalerModel.load(str(tmp_path / "ss"))
+    assert reloaded.with_mean is True
+    np.testing.assert_array_equal(reloaded.mean, model.mean)
+    np.testing.assert_allclose(reloaded.transform(table)[0]["output"],
+                               model.transform(table)[0]["output"])
+
+
+def test_standard_scaler_model_data_round_trip(xtable):
+    table, _ = xtable
+    model = StandardScaler().fit(table)
+    (md,) = model.get_model_data()
+    fresh = StandardScalerModel().set_model_data(md)
+    np.testing.assert_allclose(fresh.mean, model.mean)
+    np.testing.assert_allclose(fresh.std, model.std)
+
+
+def test_min_max_scaler(xtable):
+    table, x = xtable
+    model = MinMaxScaler().fit(table)
+    out = model.transform(table)[0]["output"]
+    np.testing.assert_allclose(out.min(axis=0), 0.0, atol=1e-12)
+    np.testing.assert_allclose(out.max(axis=0), 1.0, atol=1e-12)
+    # custom range
+    model2 = MinMaxScaler(min=-1.0, max=1.0).fit(table)
+    out2 = model2.transform(table)[0]["output"]
+    np.testing.assert_allclose(out2.min(axis=0), -1.0, atol=1e-12)
+    np.testing.assert_allclose(out2.max(axis=0), 1.0, atol=1e-12)
+
+
+def test_min_max_scaler_constant_dim():
+    x = np.array([[1.0, 5.0], [1.0, 7.0], [1.0, 6.0]])
+    model = MinMaxScaler().fit(Table.from_columns(input=x))
+    out = model.transform(Table.from_columns(input=x))[0]["output"]
+    np.testing.assert_allclose(out[:, 0], 0.5)  # constant → midpoint
+
+
+def test_max_abs_scaler(xtable):
+    table, x = xtable
+    model = MaxAbsScaler().fit(table)
+    out = model.transform(table)[0]["output"]
+    np.testing.assert_allclose(out, x / np.abs(x).max(axis=0), rtol=1e-12)
+    assert np.abs(out).max() <= 1.0 + 1e-12
+
+
+def test_robust_scaler(rng):
+    from sklearn.preprocessing import RobustScaler as SkRobust
+    x = rng.normal(size=(200, 3)) * [1, 10, 100]
+    table = Table.from_columns(input=x)
+    model = RobustScaler().set_with_centering(True).fit(table)
+    out = model.transform(table)[0]["output"]
+    sk = SkRobust().fit_transform(x)
+    # quantile method differs slightly ('lower' vs interpolation)
+    np.testing.assert_allclose(out, sk, atol=0.15)
+
+
+def test_variance_threshold_selector(rng):
+    x = np.column_stack([
+        rng.normal(size=100) * 10,      # high variance → kept
+        np.full(100, 3.0),              # zero variance → removed
+        rng.normal(size=100) * 0.01,    # tiny variance
+    ])
+    table = Table.from_columns(input=x)
+    model = VarianceThresholdSelector().fit(table)
+    assert list(model.indices) == [0, 2]
+    out = model.transform(table)[0]["output"]
+    assert out.shape == (100, 2)
+    model2 = VarianceThresholdSelector(variance_threshold=1.0).fit(table)
+    assert list(model2.indices) == [0]
+
+
+def test_univariate_selector_anova(rng):
+    # feature 0 strongly separates classes; features 1-3 are noise
+    y = rng.integers(0, 2, 300).astype(float)
+    x = rng.normal(size=(300, 4))
+    x[:, 0] += y * 5
+    table = Table.from_columns(features=x, label=y)
+    model = UnivariateFeatureSelector(
+        feature_type="continuous", label_type="categorical",
+        selection_mode="numTopFeatures", selection_threshold=1).fit(table)
+    assert list(model.indices) == [0]
+    out = model.transform(table)[0]["output"]
+    np.testing.assert_allclose(out[:, 0], x[:, 0])
+
+
+def test_univariate_selector_fpr_modes(rng):
+    from sklearn.feature_selection import f_regression
+    y = rng.normal(size=200)
+    x = rng.normal(size=(200, 5))
+    x[:, 2] = y * 2 + rng.normal(size=200) * 0.1
+    table = Table.from_columns(features=x, label=y)
+    model = UnivariateFeatureSelector(
+        feature_type="continuous", label_type="continuous",
+        selection_mode="fpr", selection_threshold=1e-4).fit(table)
+    assert 2 in list(model.indices)
+    # our f-values match sklearn's
+    from flink_ml_tpu.ops.stats import f_value_test
+    f_ours, p_ours, _ = f_value_test(x, y)
+    f_sk, p_sk = f_regression(x, y)
+    np.testing.assert_allclose(f_ours, f_sk, rtol=1e-8)
+    np.testing.assert_allclose(p_ours, p_sk, rtol=1e-8, atol=1e-12)

@@ -1,0 +1,189 @@
+"""Text/discrete feature op tests (ref: feature/*Test.java)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.models.feature import (
+    CountVectorizer,
+    CountVectorizerModel,
+    FeatureHasher,
+    HashingTF,
+    IDF,
+    IndexToString,
+    KBinsDiscretizer,
+    NGram,
+    OneHotEncoder,
+    RegexTokenizer,
+    StopWordsRemover,
+    StringIndexer,
+    StringIndexerModel,
+    Tokenizer,
+    VectorIndexer,
+)
+
+
+def test_tokenizer():
+    t = Table.from_columns(input=np.array(["Hello World", "Foo BAR baz"],
+                                          dtype=object))
+    out = Tokenizer().transform(t)[0]["output"]
+    assert list(out[0]) == ["hello", "world"]
+    assert list(out[1]) == ["foo", "bar", "baz"]
+
+
+def test_regex_tokenizer():
+    t = Table.from_columns(input=np.array(["a,b,,c", "X;;Y"], dtype=object))
+    out = RegexTokenizer(pattern="[,;]", min_token_length=1).transform(
+        t)[0]["output"]
+    assert list(out[0]) == ["a", "b", "c"]
+    assert list(out[1]) == ["x", "y"]
+    # gaps=False matches tokens instead
+    out2 = RegexTokenizer(pattern="[a-z]+", gaps=False).transform(
+        t)[0]["output"]
+    assert list(out2[1]) == ["x", "y"]
+
+
+def test_ngram():
+    t = Table.from_columns(input=np.array([["a", "b", "c", "d"], ["x"]],
+                                          dtype=object))
+    out = NGram().transform(t)[0]["output"]
+    assert list(out[0]) == ["a b", "b c", "c d"]
+    assert list(out[1]) == []
+
+
+def test_stop_words_remover():
+    t = Table.from_columns(tokens=np.array(
+        [["the", "Quick", "fox"], ["a", "test", "OF", "words"]], dtype=object))
+    out = StopWordsRemover(input_cols=["tokens"],
+                           output_cols=["filtered"]).transform(t)[0]
+    assert list(out["filtered"][0]) == ["Quick", "fox"]
+    assert list(out["filtered"][1]) == ["test", "words"]
+    # case sensitive keeps uppercase stop words
+    out2 = StopWordsRemover(input_cols=["tokens"], output_cols=["filtered"],
+                            case_sensitive=True).transform(t)[0]
+    assert "OF" in list(out2["filtered"][1])
+    assert StopWordsRemover.load_default_stop_words("english")
+
+
+def test_hashing_tf():
+    t = Table.from_columns(input=np.array([["a", "b", "a"]], dtype=object))
+    out = HashingTF(num_features=16).transform(t)[0]["output"]
+    v = out[0]
+    assert v.size == 16
+    assert sorted(v.values.tolist()) == [1.0, 2.0]
+    binary = HashingTF(num_features=16, binary=True).transform(
+        t)[0]["output"][0]
+    assert sorted(binary.values.tolist()) == [1.0, 1.0]
+
+
+def test_feature_hasher():
+    t = Table.from_columns(
+        num=np.array([3.5]),
+        cat=np.array(["red"], dtype=object))
+    out = FeatureHasher(input_cols=["num", "cat"],
+                        num_features=32).transform(t)[0]["output"]
+    v = out[0]
+    assert set(v.values.tolist()) == {3.5, 1.0}
+
+
+def test_count_vectorizer(tmp_path):
+    t = Table.from_columns(docs=np.array(
+        [["a", "b", "a"], ["b", "c"], ["b"]], dtype=object))
+    model = CountVectorizer(input_col="docs", output_col="vec").fit(t)
+    assert model.vocabulary[0] == "b"  # most frequent first
+    out = model.transform(t)[0]["vec"]
+    b_idx = model.vocabulary.index("b")
+    a_idx = model.vocabulary.index("a")
+    assert out[0].get(a_idx) == 2.0 and out[0].get(b_idx) == 1.0
+    # minDF filters rare terms
+    model2 = CountVectorizer(input_col="docs", output_col="vec",
+                             min_df=2.0).fit(t)
+    assert "c" not in model2.vocabulary
+    model.save(str(tmp_path / "cv"))
+    reloaded = CountVectorizerModel.load(str(tmp_path / "cv"))
+    assert reloaded.vocabulary == model.vocabulary
+
+
+def test_idf():
+    x = np.array([[1.0, 0.0], [1.0, 1.0], [1.0, 0.0], [1.0, 0.0]])
+    t = Table.from_columns(input=x)
+    model = IDF().fit(t)
+    m = 4
+    np.testing.assert_allclose(
+        model.idf, [np.log((m + 1) / (4 + 1)), np.log((m + 1) / (1 + 1))])
+    out = model.transform(t)[0]["output"]
+    np.testing.assert_allclose(out, x * model.idf)
+    # minDocFreq zeroes rare dims
+    model2 = IDF(min_doc_freq=2).fit(t)
+    assert model2.idf[1] == 0.0
+
+
+def test_string_indexer(tmp_path):
+    t = Table.from_columns(
+        c1=np.array(["b", "a", "b", "c"], dtype=object))
+    model = StringIndexer(input_cols=["c1"], output_cols=["o1"],
+                          string_order_type="frequencyDesc").fit(t)
+    assert model.string_arrays[0][0] == "b"
+    out = model.transform(t)[0]["o1"]
+    assert out[0] == 0.0
+    # alphabetAsc
+    m2 = StringIndexer(input_cols=["c1"], output_cols=["o1"],
+                       string_order_type="alphabetAsc").fit(t)
+    assert m2.string_arrays[0] == ["a", "b", "c"]
+    # save/load
+    model.save(str(tmp_path / "si"))
+    reloaded = StringIndexerModel.load(str(tmp_path / "si"))
+    assert reloaded.string_arrays == model.string_arrays
+    # unseen value handling
+    t2 = Table.from_columns(c1=np.array(["zzz"], dtype=object))
+    with pytest.raises(ValueError):
+        model.transform(t2)
+    model.set_handle_invalid("keep")
+    assert model.transform(t2)[0]["o1"][0] == 3.0
+    model.set_handle_invalid("skip")
+    assert model.transform(t2)[0].num_rows == 0
+
+
+def test_index_to_string():
+    si_model = StringIndexer(input_cols=["c"], output_cols=["i"],
+                             string_order_type="alphabetAsc").fit(
+        Table.from_columns(c=np.array(["x", "y"], dtype=object)))
+    its = IndexToString(input_cols=["i"], output_cols=["s"])
+    its.set_model_data(*si_model.get_model_data())
+    out = its.transform(Table.from_columns(i=np.array([1, 0])))[0]["s"]
+    assert list(out) == ["y", "x"]
+
+
+def test_one_hot_encoder():
+    t = Table.from_columns(c=np.array([0.0, 1.0, 2.0]))
+    model = OneHotEncoder(input_cols=["c"], output_cols=["v"]).fit(t)
+    out = model.transform(t)[0]["v"]
+    # dropLast: 3 categories → size 2
+    assert out[0].size == 2 and out[0].get(0) == 1.0
+    assert len(out[2].indices) == 0  # last category → all zeros
+    model.set_drop_last(False)
+    out2 = model.transform(t)[0]["v"]
+    assert out2[2].size == 3 and out2[2].get(2) == 1.0
+
+
+def test_kbins_discretizer(rng):
+    x = rng.normal(size=(300, 2)) * [1, 5]
+    t = Table.from_columns(input=x)
+    for strategy in ("uniform", "quantile", "kmeans"):
+        model = KBinsDiscretizer(strategy=strategy, num_bins=4).fit(t)
+        out = model.transform(t)[0]["output"]
+        assert out.min() >= 0 and out.max() <= 3
+        if strategy == "quantile":
+            # roughly balanced buckets
+            counts = np.bincount(out[:, 0].astype(int), minlength=4)
+            assert counts.min() > 40
+
+
+def test_vector_indexer():
+    x = np.array([[1.0, -1.0], [2.0, 0.5], [1.0, 3.7], [2.0, 8.2]])
+    t = Table.from_columns(input=x)
+    model = VectorIndexer(max_categories=3).fit(t)
+    assert 0 in model.category_maps and 1 not in model.category_maps
+    out = model.transform(t)[0]["output"]
+    np.testing.assert_allclose(out[:, 0], [0, 1, 0, 1])  # indexed
+    np.testing.assert_allclose(out[:, 1], x[:, 1])       # passthrough

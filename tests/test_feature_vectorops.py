@@ -1,0 +1,125 @@
+"""Stateless vector/scalar transformer tests (ref: feature/*Test.java)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.feature import (
+    Binarizer,
+    Bucketizer,
+    DCT,
+    ElementwiseProduct,
+    Interaction,
+    Normalizer,
+    PolynomialExpansion,
+    VectorAssembler,
+    VectorSlicer,
+)
+
+
+def test_normalizer(rng):
+    x = rng.normal(size=(20, 4))
+    t = Table.from_columns(input=x)
+    out = Normalizer().transform(t)[0]["output"]
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-12)
+    out1 = Normalizer(p=1.0).transform(t)[0]["output"]
+    np.testing.assert_allclose(np.abs(out1).sum(axis=1), 1.0, rtol=1e-12)
+    outi = Normalizer(p=float("inf")).transform(t)[0]["output"]
+    np.testing.assert_allclose(np.abs(outi).max(axis=1), 1.0, rtol=1e-12)
+
+
+def test_elementwise_product():
+    t = Table.from_columns(input=np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]))
+    op = ElementwiseProduct(scaling_vec=Vectors.dense(2.0, 0.0, -1.0))
+    out = op.transform(t)[0]["output"]
+    np.testing.assert_allclose(out, [[2, 0, -3], [8, 0, -6]])
+
+
+def test_polynomial_expansion():
+    t = Table.from_columns(input=np.array([[2.0, 3.0]]))
+    out = PolynomialExpansion(degree=2).transform(t)[0]["output"]
+    # degree 1: x0, x1; degree 2: x0², x0x1, x1²
+    np.testing.assert_allclose(out, [[2, 3, 4, 6, 9]])
+    out3 = PolynomialExpansion(degree=3).transform(t)[0]["output"]
+    assert out3.shape[1] == 9  # C(2+3,3)-1
+
+
+def test_dct_round_trip(rng):
+    import scipy.fft
+    x = rng.normal(size=(10, 8))
+    t = Table.from_columns(input=x)
+    fwd = DCT().transform(t)[0]["output"]
+    np.testing.assert_allclose(fwd, scipy.fft.dct(x, norm="ortho", axis=1),
+                               rtol=1e-10)
+    back = DCT(inverse=True).transform(
+        Table.from_columns(input=fwd))[0]["output"]
+    np.testing.assert_allclose(back, x, atol=1e-10)
+
+
+def test_interaction():
+    t = Table.from_columns(
+        a=np.array([2.0, 3.0]),
+        b=np.array([[1.0, 10.0], [2.0, 20.0]]))
+    out = Interaction(input_cols=["a", "b"]).transform(t)[0]["output"]
+    np.testing.assert_allclose(out, [[2, 20], [6, 60]])
+
+
+def test_vector_assembler():
+    t = Table.from_columns(
+        s=np.array([1.0, 2.0]),
+        v=np.array([[10.0, 20.0], [30.0, 40.0]]))
+    out = VectorAssembler(input_cols=["s", "v"]).transform(t)[0]["output"]
+    np.testing.assert_allclose(out, [[1, 10, 20], [2, 30, 40]])
+
+
+def test_vector_assembler_handle_invalid():
+    t = Table.from_columns(s=np.array([1.0, np.nan]),
+                           v=np.array([[1.0], [2.0]]))
+    with pytest.raises(ValueError):
+        VectorAssembler(input_cols=["s", "v"]).transform(t)
+    out = VectorAssembler(input_cols=["s", "v"],
+                          handle_invalid="skip").transform(t)[0]
+    assert out.num_rows == 1
+    out_keep = VectorAssembler(input_cols=["s", "v"],
+                               handle_invalid="keep").transform(t)[0]
+    assert out_keep.num_rows == 2
+
+
+def test_vector_slicer():
+    t = Table.from_columns(input=np.array([[1.0, 2.0, 3.0, 4.0]]))
+    out = VectorSlicer(indices=[3, 1]).transform(t)[0]["output"]
+    np.testing.assert_allclose(out, [[4.0, 2.0]])
+    with pytest.raises(ValueError):
+        VectorSlicer(indices=[-1]).transform(t)
+
+
+def test_binarizer_scalar_and_vector():
+    t = Table.from_columns(
+        s=np.array([0.5, 2.0]),
+        v=np.array([[0.1, 5.0], [3.0, 0.2]]))
+    out = Binarizer(input_cols=["s", "v"], output_cols=["so", "vo"],
+                    thresholds=[1.0, 1.0]).transform(t)[0]
+    np.testing.assert_allclose(out["so"], [0.0, 1.0])
+    np.testing.assert_allclose(out["vo"], [[0, 1], [1, 0]])
+
+
+def test_bucketizer():
+    t = Table.from_columns(x=np.array([-1.0, 0.5, 1.5, 99.0]))
+    op = Bucketizer(input_cols=["x"], output_cols=["b"],
+                    splits_array=[[0.0, 1.0, 2.0]], handle_invalid="keep")
+    out = op.transform(t)[0]["b"]
+    # -1 invalid → keep-bucket 2; 0.5 → 0; 1.5 → 1; 99 invalid → 2
+    np.testing.assert_allclose(out, [2, 0, 1, 2])
+    with pytest.raises(ValueError):
+        Bucketizer(input_cols=["x"], output_cols=["b"],
+                   splits_array=[[0.0, 1.0, 2.0]]).transform(t)
+    skipped = Bucketizer(input_cols=["x"], output_cols=["b"],
+                         splits_array=[[0.0, 1.0, 2.0]],
+                         handle_invalid="skip").transform(t)[0]
+    assert skipped.num_rows == 2
+    # top boundary belongs to the last bucket
+    t2 = Table.from_columns(x=np.array([2.0]))
+    out2 = Bucketizer(input_cols=["x"], output_cols=["b"],
+                      splits_array=[[0.0, 1.0, 2.0]]).transform(t2)[0]["b"]
+    np.testing.assert_allclose(out2, [1])
