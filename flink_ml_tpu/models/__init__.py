@@ -4,7 +4,12 @@ Areas mirror the reference package layout: classification, clustering,
 regression, feature, recommendation, evaluation, stats.
 """
 
-from flink_ml_tpu.models import classification  # noqa: F401
+# clustering first: models.online depends on clustering.kmeans, and both
+# classification and clustering re-export from models.online
 from flink_ml_tpu.models import clustering  # noqa: F401
+from flink_ml_tpu.models import classification  # noqa: F401
+from flink_ml_tpu.models import evaluation  # noqa: F401
 from flink_ml_tpu.models import feature  # noqa: F401
+from flink_ml_tpu.models import recommendation  # noqa: F401
 from flink_ml_tpu.models import regression  # noqa: F401
+from flink_ml_tpu.models import stats  # noqa: F401

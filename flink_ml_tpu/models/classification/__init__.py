@@ -6,3 +6,12 @@ from flink_ml_tpu.models.classification.linearsvc import (  # noqa: F401
     LinearSVC,
     LinearSVCModel,
 )
+from flink_ml_tpu.models.classification.knn import Knn, KnnModel  # noqa: F401
+from flink_ml_tpu.models.classification.naivebayes import (  # noqa: F401
+    NaiveBayes,
+    NaiveBayesModel,
+)
+from flink_ml_tpu.models.online import (  # noqa: F401,E402
+    OnlineLogisticRegression,
+    OnlineLogisticRegressionModel,
+)

@@ -1,0 +1,138 @@
+"""Multinomial naive Bayes over categorical feature values.
+
+Ref parity: flink-ml-lib classification/naivebayes/{NaiveBayes.java:59,
+NaiveBayesModel.java, NaiveBayesModelData.java}:
+
+- features are vectors whose per-dimension *values* are categories;
+- theta[l][j][v] = log(count(l,j,v)+smoothing) − log(docCount_l +
+  smoothing·|categories_j|) (GenerateModelFunction);
+- pi[l] = log(docCount_l·d + smoothing) − log(n·d + L·smoothing);
+- predict: argmax_l pi[l] + Σ_j theta[l][j][x_j]
+  (NaiveBayesModel.calculateProb).
+
+Deviation (documented): an unseen feature value at predict time scores the
+smoothed floor log(smoothing) − log(docCount_l + smoothing·|categories_j|)
+instead of the reference's NullPointerException.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.api.stage import Estimator, Model
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.params.param import FloatParam, ParamValidators, StringParam
+from flink_ml_tpu.params.shared import (
+    HasFeaturesCol,
+    HasPredictionCol,
+)
+from flink_ml_tpu.params.shared import HasLabelCol, HasWeightCol
+from flink_ml_tpu.utils import io as rw
+
+
+class NaiveBayesModelParams(HasFeaturesCol, HasPredictionCol):
+    MODEL_TYPE = StringParam(
+        "modelType", "The model type.", "multinomial",
+        ParamValidators.in_array("multinomial"))
+
+
+class NaiveBayesParams(NaiveBayesModelParams, HasLabelCol, HasWeightCol):
+    SMOOTHING = FloatParam("smoothing", "The smoothing parameter.", 1.0,
+                           ParamValidators.gt_eq(0.0))
+
+
+class NaiveBayesModel(Model, NaiveBayesModelParams):
+    def __init__(self, theta=None, pi=None, labels=None, floors=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.theta = theta      # [label][feature] dict value→logprob
+        self.pi = None if pi is None else np.asarray(pi, np.float64)
+        self.labels = None if labels is None else np.asarray(labels,
+                                                             np.float64)
+        self.floors = (None if floors is None
+                       else np.asarray(floors, np.float64))  # (L, d)
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.theta is None:
+            raise ValueError("NaiveBayesModel has no model data")
+        x = table.vectors(self.features_col, np.float64)
+        n, d = x.shape
+        num_labels = len(self.labels)
+        probs = np.tile(self.pi, (n, 1))
+        for li in range(num_labels):
+            for j in range(d):
+                mapping = self.theta[li][j]
+                floor = self.floors[li][j]
+                probs[:, li] += np.asarray(
+                    [mapping.get(v, floor) for v in x[:, j]])
+        pred = self.labels[np.argmax(probs, axis=1)]
+        return (table.with_column(self.prediction_col, pred),)
+
+    def set_model_data(self, model_data: Table):
+        row = model_data.column("theta")[0]
+        self.theta = row
+        self.pi = model_data.vectors("piArray", np.float64)[0]
+        self.labels = model_data.vectors("labels", np.float64)[0]
+        self.floors = np.asarray(model_data.column("floors")[0], np.float64)
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        theta_col = np.empty(1, dtype=object)
+        theta_col[0] = self.theta
+        floors_col = np.empty(1, dtype=object)
+        floors_col[0] = self.floors
+        return (Table.from_columns(
+            theta=theta_col, piArray=self.pi[None, :],
+            labels=self.labels[None, :], floors=floors_col),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_json(path, "model", {
+            "theta": [[{str(v): lp for v, lp in m.items()} for m in row]
+                      for row in self.theta],
+            "pi": self.pi.tolist(), "labels": self.labels.tolist(),
+            "floors": self.floors.tolist()})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        data = rw.load_model_json(path, "model")
+        self.theta = [[{float(v): lp for v, lp in m.items()} for m in row]
+                      for row in data["theta"]]
+        self.pi = np.asarray(data["pi"])
+        self.labels = np.asarray(data["labels"])
+        self.floors = np.asarray(data["floors"])
+
+
+class NaiveBayes(Estimator, NaiveBayesParams):
+    def fit(self, table: Table) -> NaiveBayesModel:
+        x = table.vectors(self.features_col, np.float64)
+        y = table.scalars(self.label_col, np.float64)
+        smoothing = self.smoothing
+        n, d = x.shape
+        labels = np.unique(y)
+        num_labels = len(labels)
+
+        # per-(label, feature): value → doc count; per-feature category sets
+        categories = [set(np.unique(x[:, j]).tolist()) for j in range(d)]
+        doc_counts = np.asarray([(y == label).sum() for label in labels],
+                                np.float64)
+        theta, floors = [], np.zeros((num_labels, d))
+        for li, label in enumerate(labels):
+            rows = x[y == label]
+            per_feature = []
+            for j in range(d):
+                vals, counts = np.unique(rows[:, j], return_counts=True)
+                counts_map = dict(zip(vals.tolist(), counts.tolist()))
+                denom = np.log(doc_counts[li] + smoothing * len(categories[j]))
+                per_feature.append({
+                    v: np.log(counts_map.get(v, 0.0) + smoothing) - denom
+                    for v in categories[j]})
+                floors[li, j] = (np.log(smoothing) - denom if smoothing > 0
+                                 else -np.inf)
+            theta.append(per_feature)
+
+        pi_log = np.log(n * d + num_labels * smoothing)
+        pi = np.log(doc_counts * d + smoothing) - pi_log
+        model = NaiveBayesModel(theta=theta, pi=pi, labels=labels,
+                                floors=floors)
+        return self.copy_params_to(model)

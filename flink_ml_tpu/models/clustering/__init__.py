@@ -2,3 +2,7 @@ from flink_ml_tpu.models.clustering.kmeans import (  # noqa: F401
     KMeans,
     KMeansModel,
 )
+from flink_ml_tpu.models.clustering.agglomerative import (  # noqa: F401
+    AgglomerativeClustering,
+)
+from flink_ml_tpu.models.online import OnlineKMeans  # noqa: F401,E402

@@ -1,0 +1,3 @@
+from flink_ml_tpu.models.evaluation.binaryclassification import (  # noqa: F401
+    BinaryClassificationEvaluator,
+)

@@ -56,3 +56,7 @@ from flink_ml_tpu.models.feature.misc import (  # noqa: F401
     RandomSplitter,
     SQLTransformer,
 )
+from flink_ml_tpu.models.online import (  # noqa: F401,E402
+    OnlineStandardScaler,
+    OnlineStandardScalerModel,
+)

@@ -330,7 +330,8 @@ class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
         for j in range(x.shape[1]):
             col = x[:, j]
             if self.strategy == self.UNIFORM:
-                edges = np.linspace(col.min(), col.max(), k + 1)
+                # dedupe equal edges so a constant column maps to bin 0
+                edges = np.unique(np.linspace(col.min(), col.max(), k + 1))
             elif self.strategy == self.QUANTILE:
                 qs = np.linspace(0, 1, k + 1)
                 edges = np.unique(np.quantile(col, qs))

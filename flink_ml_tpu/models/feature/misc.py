@@ -149,9 +149,10 @@ class RandomSplitter(AlgoOperator, HasSeed):
 class SQLTransformer(Transformer):
     """SQL SELECT over the input table, with ``__THIS__`` as the table name
     (ref: feature/sqltransformer/SQLTransformer.java — the reference runs
-    Flink SQL; here statements execute on an in-memory sqlite database over
-    the table's scalar/string columns; vector columns pass through only if
-    untouched by the statement)."""
+    Flink SQL). Statements execute on an in-memory sqlite database over the
+    table's scalar and string columns; vector/array columns are NOT visible
+    to SQL and are dropped from the output (SQL may reorder/filter rows, so
+    they cannot be re-attached)."""
 
     STATEMENT = StringParam(
         "statement", "SQL statement with __THIS__ as the input table.", None,
@@ -173,6 +174,11 @@ class SQLTransformer(Transformer):
 
             scalar_cols = [n for n in table.column_names
                            if sql_compatible(table.column(n))]
+            if not scalar_cols:
+                raise ValueError(
+                    "SQLTransformer needs at least one scalar or string "
+                    "column; vector columns are not visible to SQL. "
+                    f"Input columns: {table.column_names}")
             col_defs = ", ".join(f'"{n}"' for n in scalar_cols)
             conn.execute(f"CREATE TABLE __input__ ({col_defs})")
             rows = list(zip(*[table.column(n) for n in scalar_cols]))

@@ -141,13 +141,21 @@ class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
                              "set stopWords explicitly")
         return list(ENGLISH_STOP_WORDS)
 
+    @staticmethod
+    def _fold(token: str, locale: str) -> str:
+        # locale-aware case fold: Turkic locales map I→ı / İ→i
+        if locale and locale.split("_")[0] in ("tr", "az"):
+            token = token.replace("İ", "i").replace("I", "ı")
+        return token.lower()
+
     def transform(self, table: Table) -> Tuple[Table]:
         if self.case_sensitive:
             stop = set(self.stop_words)
             keep = lambda t: t not in stop
         else:
-            stop = {w.lower() for w in self.stop_words}
-            keep = lambda t: t.lower() not in stop
+            locale = self.locale
+            stop = {self._fold(w, locale) for w in self.stop_words}
+            keep = lambda t: self._fold(t, locale) not in stop
         outs = {}
         for name, out_name in zip(self.input_cols, self.output_cols):
             col = table.column(name)

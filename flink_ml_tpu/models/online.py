@@ -1,0 +1,372 @@
+"""Online (unbounded-stream) algorithms.
+
+Ref parity:
+- OnlineLogisticRegression (classification/logisticregression/
+  OnlineLogisticRegression.java:75): FTRL-proximal per global batch —
+  per-coordinate gradient g_i = Σ (σ(x·w)−y)·x_i normalized by the
+  per-coordinate sample count (the reference's dense-vector branch, which
+  ignores the weight column; CalculateLocalGradient:364-388, UpdateModel:
+  295-319): σ=(√(n+g²)−√n)/α; z+=g−σw; n+=g²; w_i = 0 if |z_i|≤l1 else
+  (sign(z)l1−z)/((β+√n)/α+l2), l1=elasticNet·reg, l2=(1−elasticNet)·reg;
+  model version increments per emitted model (CreateLrModelData:235-258).
+- OnlineKMeans (clustering/kmeans/OnlineKMeans.java:76): mini-batch
+  k-means — weights *= decayFactor (per task: /parallelism; host runtime is
+  the 1-task case), for non-empty clusters weight += count, λ=count/weight,
+  centroid = (1−λ)·centroid + λ·mean(points) (ModelDataLocalUpdater:
+  295-324).
+- OnlineStandardScaler (feature/standardscaler/OnlineStandardScaler.java):
+  per window, cumulative mean/std over all data seen, emitted as versioned
+  model data; the model stamps predictions with modelVersionCol
+  (OnlineStandardScalerModel.java:202-210 metric gauges ≙ version/timestamp
+  tracking here).
+
+The unbounded runtime is flink_ml_tpu.iteration.streaming: fit() consumes a
+StreamTable (or a bounded Table chopped into global batches) and the fitted
+model records every versioned snapshot — the host-side equivalent of the
+reference's unbounded model-data stream.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from flink_ml_tpu.api.stage import Estimator, Model
+from flink_ml_tpu.common.table import Table, as_dense_vector_column
+from flink_ml_tpu.iteration.streaming import StreamTable, generate_batches
+from flink_ml_tpu.linalg.distance import DistanceMeasure
+from flink_ml_tpu.models.clustering.kmeans import KMeansModel, KMeansModelParams
+from flink_ml_tpu.params.param import FloatParam, ParamValidators
+from flink_ml_tpu.params.shared import (
+    HasBatchStrategy,
+    HasDecayFactor,
+    HasElasticNet,
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasInputCol,
+    HasLabelCol,
+    HasMaxAllowedModelDelayMs,
+    HasModelVersionCol,
+    HasOutputCol,
+    HasPredictionCol,
+    HasRawPredictionCol,
+    HasReg,
+    HasSeed,
+    HasWeightCol,
+    HasWindows,
+)
+from flink_ml_tpu.utils import io as rw
+
+
+def _as_stream(data: Union[Table, StreamTable], batch_size: int):
+    if isinstance(data, Table):
+        data = StreamTable.from_table(data, batch_size)
+    return generate_batches(data, batch_size)
+
+
+# ---------------------------------------------------------------------------
+# OnlineLogisticRegression (FTRL)
+# ---------------------------------------------------------------------------
+
+class OnlineLogisticRegressionModelParams(HasFeaturesCol, HasPredictionCol,
+                                          HasRawPredictionCol,
+                                          HasModelVersionCol,
+                                          HasMaxAllowedModelDelayMs):
+    pass
+
+
+class OnlineLogisticRegressionParams(OnlineLogisticRegressionModelParams,
+                                     HasLabelCol, HasWeightCol,
+                                     HasBatchStrategy, HasGlobalBatchSize,
+                                     HasReg, HasElasticNet):
+    ALPHA = FloatParam("alpha", "The alpha parameter of ftrl.", 0.1,
+                       ParamValidators.gt(0.0))
+    BETA = FloatParam("beta", "The beta parameter of ftrl.", 0.1,
+                      ParamValidators.gt(0.0))
+
+
+class OnlineLogisticRegressionModel(Model,
+                                    OnlineLogisticRegressionModelParams):
+    def __init__(self, coefficients: Optional[np.ndarray] = None,
+                 model_version: int = 0, **kwargs):
+        super().__init__(**kwargs)
+        self.coefficients = (None if coefficients is None
+                             else np.asarray(coefficients, np.float64))
+        self.model_version = int(model_version)
+        #: all versioned snapshots recorded during fit: [(version, coeffs)]
+        self.history: List[Tuple[int, np.ndarray]] = []
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.coefficients is None:
+            raise ValueError(
+                "OnlineLogisticRegressionModel has no model data")
+        x = table.vectors(self.features_col, np.float64)
+        dots = x @ self.coefficients
+        prob = 1.0 / (1.0 + np.exp(-dots))
+        return (table.with_columns(**{
+            self.prediction_col: (dots >= 0).astype(np.float64),
+            self.raw_prediction_col: as_dense_vector_column(
+                np.stack([1 - prob, prob], axis=1)),
+            self.model_version_col: np.full(len(dots), self.model_version,
+                                            np.int64)}),)
+
+    def transform_stream(self, stream: StreamTable):
+        """Unbounded predict: each chunk is scored with the latest model
+        version available at that point (the reference's model-broadcast
+        join); yields output Tables."""
+        versions = iter(self.history or [(self.model_version,
+                                          self.coefficients)])
+        for chunk in stream:
+            advanced = next(versions, None)
+            if advanced is not None:
+                self.model_version, self.coefficients = advanced
+            yield self.transform(chunk)[0]
+
+    def set_model_data(self, model_data: Table):
+        col = model_data.column("coefficient")
+        self.coefficients = (col[0].to_array() if col.dtype == object
+                             else np.asarray(col[0]))
+        if "modelVersion" in model_data:
+            self.model_version = int(model_data.column("modelVersion")[0])
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        return (Table.from_columns(
+            coefficient=as_dense_vector_column(self.coefficients[None, :]),
+            modelVersion=np.asarray([self.model_version], np.int64)),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_arrays(path, "model", {
+            "coefficient": self.coefficients,
+            "modelVersion": np.asarray([self.model_version])})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        arrays = rw.load_model_arrays(path, "model")
+        self.coefficients = arrays["coefficient"]
+        self.model_version = int(arrays["modelVersion"][0])
+
+
+class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._initial_model_data: Optional[Table] = None
+
+    def set_initial_model_data(self, model_data: Table):
+        """Ref: OnlineLogisticRegression.setInitialModelData:440."""
+        self._initial_model_data = model_data
+        return self
+
+    def fit(self, data: Union[Table, StreamTable]
+            ) -> OnlineLogisticRegressionModel:
+        if self._initial_model_data is None:
+            raise ValueError("initial model data must be set before fit "
+                             "(setInitialModelData)")
+        col = self._initial_model_data.column("coefficient")
+        coeffs = np.array(col[0].to_array() if col.dtype == object
+                          else col[0], np.float64)
+        version = (int(self._initial_model_data.column("modelVersion")[0])
+                   if "modelVersion" in self._initial_model_data else 0)
+
+        alpha, beta = self.alpha, self.beta
+        l1 = self.elastic_net * self.reg
+        l2 = (1.0 - self.elastic_net) * self.reg
+        z = np.zeros_like(coeffs)
+        n = np.zeros_like(coeffs)
+
+        model = OnlineLogisticRegressionModel()
+        self.copy_params_to(model)
+        history: List[Tuple[int, np.ndarray]] = []
+
+        for batch in _as_stream(data, self.global_batch_size):
+            x = batch.vectors(self.features_col, np.float64)
+            y = batch.scalars(self.label_col, np.float64)
+            p = 1.0 / (1.0 + np.exp(-(x @ coeffs)))
+            # dense-path reference semantics: unweighted per-coordinate
+            # gradient, weight sum counts every sample at every coordinate
+            grad = ((p - y)[:, None] * x).sum(axis=0)
+            weight_sum = np.full_like(grad, len(y), np.float64)
+            g = np.where(weight_sum != 0, grad / np.where(weight_sum != 0,
+                                                          weight_sum, 1), 0)
+            sigma = (np.sqrt(n + g * g) - np.sqrt(n)) / alpha
+            z += g - sigma * coeffs
+            n += g * g
+            coeffs = np.where(
+                np.abs(z) <= l1, 0.0,
+                (np.sign(z) * l1 - z) / ((beta + np.sqrt(n)) / alpha + l2))
+            version += 1
+            history.append((version, coeffs.copy()))
+
+        model.coefficients = coeffs
+        model.model_version = version
+        model.history = history
+        return model
+
+
+# ---------------------------------------------------------------------------
+# OnlineKMeans
+# ---------------------------------------------------------------------------
+
+class OnlineKMeansParams(KMeansModelParams, HasBatchStrategy,
+                         HasGlobalBatchSize, HasDecayFactor, HasSeed):
+    pass
+
+
+class OnlineKMeans(Estimator, OnlineKMeansParams):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._initial_model_data: Optional[Table] = None
+
+    def set_initial_model_data(self, model_data: Table):
+        """Ref: OnlineKMeans.setInitialModelData:345."""
+        self._initial_model_data = model_data
+        return self
+
+    def fit(self, data: Union[Table, StreamTable]) -> KMeansModel:
+        if self._initial_model_data is None:
+            raise ValueError("initial model data must be set before fit "
+                             "(setInitialModelData)")
+        seed_model = KMeansModel().set_model_data(self._initial_model_data)
+        centroids = np.array(seed_model.centroids, np.float64)
+        weights = np.array(seed_model.weights, np.float64)
+        k = centroids.shape[0]
+        measure = DistanceMeasure.get_instance(self.distance_measure)
+        decay = self.decay_factor
+
+        for batch in _as_stream(data, self.global_batch_size):
+            x = batch.vectors(self.features_col, np.float64)
+            dists = np.asarray(measure.pairwise(x, centroids))
+            assign = np.argmin(dists, axis=1)
+            counts = np.bincount(assign, minlength=k).astype(np.float64)
+            sums = np.zeros_like(centroids)
+            np.add.at(sums, assign, x)
+
+            weights = weights * decay  # 1-task case of decay/parallelism
+            for i in range(k):
+                if counts[i] == 0:
+                    continue
+                weights[i] += counts[i]
+                lam = counts[i] / weights[i]
+                centroids[i] = (1 - lam) * centroids[i] \
+                    + (lam / counts[i]) * sums[i]
+
+        model = KMeansModel(centroids=centroids, weights=weights)
+        return self.copy_params_to(model)
+
+
+# ---------------------------------------------------------------------------
+# OnlineStandardScaler
+# ---------------------------------------------------------------------------
+
+class OnlineStandardScalerModelParams(HasInputCol, HasOutputCol,
+                                      HasModelVersionCol,
+                                      HasMaxAllowedModelDelayMs):
+    pass
+
+
+class OnlineStandardScalerParams(OnlineStandardScalerModelParams, HasWindows):
+    from flink_ml_tpu.params.param import BooleanParam as _B
+    WITH_MEAN = _B("withMean",
+                   "Whether centers the data with mean before scaling.",
+                   False)
+    WITH_STD = _B("withStd",
+                  "Whether scales the data with standard deviation.", True)
+
+
+class OnlineStandardScalerModel(Model, OnlineStandardScalerModelParams):
+    def __init__(self, mean=None, std=None, model_version: int = 0,
+                 timestamp: int = 0, with_mean=False, with_std=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.mean = None if mean is None else np.asarray(mean, np.float64)
+        self.std = None if std is None else np.asarray(std, np.float64)
+        self.model_version = int(model_version)
+        self.timestamp = int(timestamp)
+        self._with_mean, self._with_std = with_mean, with_std
+        self.history: List[Tuple[int, np.ndarray, np.ndarray]] = []
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.mean is None:
+            raise ValueError("OnlineStandardScalerModel has no model data")
+        x = table.vectors(self.input_col, np.float64)
+        if self._with_mean:
+            x = x - self.mean
+        if self._with_std:
+            x = x / np.where(self.std > 0, self.std, 1.0)
+        out = {self.output_col: x}
+        if self.model_version_col is not None:
+            out[self.model_version_col] = np.full(
+                len(x), self.model_version, np.int64)
+        return (table.with_columns(**out),)
+
+    def set_model_data(self, model_data: Table):
+        self.mean = model_data.vectors("mean", np.float64)[0]
+        self.std = model_data.vectors("std", np.float64)[0]
+        if "modelVersion" in model_data:
+            self.model_version = int(model_data.column("modelVersion")[0])
+        if "timestamp" in model_data:
+            self.timestamp = int(model_data.column("timestamp")[0])
+        return self
+
+    def get_model_data(self) -> Tuple[Table]:
+        return (Table.from_columns(
+            mean=self.mean[None, :], std=self.std[None, :],
+            modelVersion=np.asarray([self.model_version], np.int64),
+            timestamp=np.asarray([self.timestamp], np.int64)),)
+
+    def _save_extra(self, path: str) -> None:
+        rw.save_model_arrays(path, "model", {
+            "mean": self.mean, "std": self.std,
+            "version": np.asarray([self.model_version]),
+            "timestamp": np.asarray([self.timestamp]),
+            "flags": np.asarray([self._with_mean, self._with_std])})
+
+    def _load_extra(self, path: str, meta: dict) -> None:
+        arrays = rw.load_model_arrays(path, "model")
+        self.mean, self.std = arrays["mean"], arrays["std"]
+        self.model_version = int(arrays["version"][0])
+        self.timestamp = int(arrays["timestamp"][0])
+        self._with_mean, self._with_std = (bool(v) for v in arrays["flags"])
+
+
+class OnlineStandardScaler(Estimator, OnlineStandardScalerParams):
+    def fit(self, data: Union[Table, StreamTable],
+            batch_size: int = 1000) -> OnlineStandardScalerModel:
+        from flink_ml_tpu.common.window import CountTumblingWindows
+        windows = self.windows
+        if isinstance(windows, CountTumblingWindows):
+            batch_size = windows.size
+        if isinstance(data, Table):
+            data = StreamTable.from_table(data, batch_size)
+
+        total = sq_total = None
+        count = 0
+        version = 0
+        history = []
+        mean = std = None
+        for chunk in data:
+            x = chunk.vectors(self.input_col, np.float64)
+            if total is None:
+                total = np.zeros(x.shape[1])
+                sq_total = np.zeros(x.shape[1])
+            total += x.sum(axis=0)
+            sq_total += (x * x).sum(axis=0)
+            count += x.shape[0]
+            mean = total / count
+            if count > 1:
+                std = np.sqrt(np.maximum(
+                    (sq_total - count * mean * mean) / (count - 1), 0.0))
+            else:
+                std = np.zeros_like(mean)
+            history.append((version, mean.copy(), std.copy()))
+            version += 1
+        if mean is None:
+            raise ValueError("empty input stream")
+        model = OnlineStandardScalerModel(
+            mean=mean, std=std, model_version=version - 1,
+            timestamp=int(time.time() * 1000),
+            with_mean=self.with_mean, with_std=self.with_std)
+        self.copy_params_to(model)
+        model.history = history
+        return model

@@ -1,0 +1,1 @@
+from flink_ml_tpu.models.recommendation.swing import Swing  # noqa: F401

@@ -1,0 +1,113 @@
+"""Swing item-item recommendation.
+
+Ref parity: flink-ml-lib recommendation/swing/Swing.java:60 — item
+similarity from (Long user, Long item) purchase pairs:
+
+    w(i,j) = Σ_{u,v ∈ U_i∩U_j} 1/(α₁+|I_u|)^β · 1/(α₁+|I_v|)^β · 1/(α₂+|I_u∩I_v|)
+
+Users outside [minUserBehavior, maxUserBehavior] purchases are dropped; per
+item at most maxUserNumPerItem users are considered; output rows are
+(itemCol, outputCol) where outputCol = top-k "item,score" pairs joined by
+';' (ComputingSimilarItems).
+
+Host-side by design: the computation is set-intersection over ragged id
+lists (XLA-hostile); the reference's keyed-shuffle stages become dict
+groupings.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from flink_ml_tpu.api.stage import AlgoOperator
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.params.param import (
+    FloatParam,
+    IntParam,
+    ParamValidators,
+    StringParam,
+)
+from flink_ml_tpu.params.shared import HasOutputCol
+
+
+class Swing(AlgoOperator, HasOutputCol):
+    USER_COL = StringParam("userCol", "User column name.", "user",
+                           ParamValidators.not_null())
+    ITEM_COL = StringParam("itemCol", "Item column name.", "item",
+                           ParamValidators.not_null())
+    MAX_USER_NUM_PER_ITEM = IntParam(
+        "maxUserNumPerItem",
+        "The max number of users(purchasers) for each item.", 1000,
+        ParamValidators.gt(0))
+    K = IntParam("k", "The max number of similar items to output for each "
+                 "item.", 100, ParamValidators.gt(0))
+    MIN_USER_BEHAVIOR = IntParam(
+        "minUserBehavior", "The min number of items that a user purchases.",
+        10, ParamValidators.gt(0))
+    MAX_USER_BEHAVIOR = IntParam(
+        "maxUserBehavior", "The max number of items that a user purchases.",
+        1000, ParamValidators.gt(0))
+    ALPHA1 = IntParam("alpha1", "Smooth factor for number of users that "
+                      "have purchased one item.", 15,
+                      ParamValidators.gt_eq(0))
+    ALPHA2 = IntParam("alpha2", "Smooth factor for number of users that "
+                      "have purchased the two target items.", 0,
+                      ParamValidators.gt_eq(0))
+    BETA = FloatParam("beta", "Decay factor for number of users that have "
+                      "purchased one item.", 0.3, ParamValidators.gt_eq(0))
+
+    def transform(self, table: Table) -> Tuple[Table]:
+        if self.max_user_behavior < self.min_user_behavior:
+            raise ValueError(
+                f"The maxUserBehavior must be greater than or equal to "
+                f"minUserBehavior. The current setting: maxUserBehavior="
+                f"{self.max_user_behavior}, minUserBehavior="
+                f"{self.min_user_behavior}.")
+        users = np.asarray(table.column(self.user_col), np.int64)
+        items = np.asarray(table.column(self.item_col), np.int64)
+
+        # user → purchased item set (dedup), filtered by behavior bounds
+        user_items: dict = {}
+        for u, i in zip(users.tolist(), items.tolist()):
+            user_items.setdefault(u, set()).add(i)
+        user_items = {u: np.asarray(sorted(s), np.int64)
+                      for u, s in user_items.items()
+                      if self.min_user_behavior <= len(s)
+                      <= self.max_user_behavior}
+
+        # item → its purchasers (insertion order, capped)
+        item_users: dict = {}
+        for u in user_items:
+            for i in user_items[u].tolist():
+                lst = item_users.setdefault(i, [])
+                if len(lst) < self.max_user_num_per_item:
+                    lst.append(u)
+
+        alpha1, alpha2, beta = self.alpha1, self.alpha2, self.beta
+        weights = {u: 1.0 / (alpha1 + len(s)) ** beta
+                   for u, s in user_items.items()}
+
+        out_items, out_recs = [], []
+        for item, purchasers in item_users.items():
+            scores: dict = {}
+            for a in range(len(purchasers)):
+                for b in range(a + 1, len(purchasers)):
+                    u, v = purchasers[a], purchasers[b]
+                    inter = np.intersect1d(user_items[u], user_items[v],
+                                           assume_unique=True)
+                    if len(inter) == 0:
+                        continue
+                    sim = weights[u] * weights[v] / (alpha2 + len(inter))
+                    for j in inter.tolist():
+                        if j != item:
+                            scores[j] = scores.get(j, 0.0) + sim
+            if not scores:
+                continue
+            top = sorted(scores.items(), key=lambda t: -t[1])[: self.k]
+            out_items.append(item)
+            out_recs.append(";".join(f"{j},{s}" for j, s in top))
+        return (Table.from_columns(**{
+            self.item_col: np.asarray(out_items, np.int64),
+            self.output_col: np.asarray(out_recs, dtype=object)}),)

@@ -1,0 +1,5 @@
+from flink_ml_tpu.models.stats.tests import (  # noqa: F401
+    ANOVATest,
+    ChiSqTest,
+    FValueTest,
+)

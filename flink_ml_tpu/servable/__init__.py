@@ -1,0 +1,24 @@
+"""Engine-free online inference (the "servable" path).
+
+Ref parity: flink-ml-servable-core/.../servable/api/ (DataFrame.java:33,
+Row.java, TransformerServable.java, ModelServable.java, DataTypes.java),
+servable/builder/PipelineModelServable.java and flink-ml-servable-lib's
+LogisticRegressionModelServable.java:62.
+
+The serving path has no dependency on the training runtime: a servable
+loads model data from files/streams and transforms in-memory DataFrames.
+The same jitted/vectorized predict math as the full Models is reused.
+"""
+
+from flink_ml_tpu.servable.api import (  # noqa: F401
+    BasicType,
+    DataFrame,
+    DataTypes,
+    ModelServable,
+    Row,
+    TransformerServable,
+)
+from flink_ml_tpu.servable.builder import PipelineModelServable  # noqa: F401
+from flink_ml_tpu.servable.lr import (  # noqa: F401
+    LogisticRegressionModelServable,
+)

@@ -1,0 +1,167 @@
+"""Servable API: DataFrame / Row / TransformerServable / ModelServable.
+
+Ref parity: servable/api/DataFrame.java:33 (addColumn:100, collect:119),
+Row.java, TransformerServable.java, ModelServable.java,
+servable/types/DataTypes.java.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+
+class BasicType(enum.Enum):
+    """Ref: servable/types/BasicType.java."""
+    BOOLEAN = "boolean"
+    BYTE = "byte"
+    SHORT = "short"
+    INT = "int"
+    LONG = "long"
+    FLOAT = "float"
+    DOUBLE = "double"
+    STRING = "string"
+
+
+class DataType:
+    def __init__(self, basic: BasicType, shape: str = "scalar"):
+        self.basic = basic
+        self.shape = shape  # scalar | vector | matrix
+
+    def __repr__(self):
+        return f"DataType({self.basic.value}, {self.shape})"
+
+    def __eq__(self, other):
+        return (isinstance(other, DataType) and self.basic == other.basic
+                and self.shape == other.shape)
+
+
+class DataTypes:
+    """Ref: servable/types/DataTypes.java factory constants."""
+    BOOLEAN = DataType(BasicType.BOOLEAN)
+    INT = DataType(BasicType.INT)
+    LONG = DataType(BasicType.LONG)
+    FLOAT = DataType(BasicType.FLOAT)
+    DOUBLE = DataType(BasicType.DOUBLE)
+    STRING = DataType(BasicType.STRING)
+
+    @staticmethod
+    def vector(basic: BasicType = BasicType.DOUBLE) -> DataType:
+        return DataType(basic, "vector")
+
+    @staticmethod
+    def matrix(basic: BasicType = BasicType.DOUBLE) -> DataType:
+        return DataType(basic, "matrix")
+
+
+class Row:
+    """Ref: servable/api/Row.java — positional values with add/get/set."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def get(self, index: int):
+        return self.values[index]
+
+    def get_as(self, index: int, _type=None):
+        return self.values[index]
+
+    def set(self, index: int, value) -> "Row":
+        self.values[index] = value
+        return self
+
+    def add(self, value) -> "Row":
+        self.values.append(value)
+        return self
+
+    def size(self) -> int:
+        return len(self.values)
+
+    def __eq__(self, other):
+        return isinstance(other, Row) and self.values == other.values
+
+    def __repr__(self):
+        return f"Row({self.values})"
+
+
+class _Column:
+    def __init__(self, name, dtype, values):
+        self.name = name
+        self.dtype = dtype
+        self.values = values
+
+
+class DataFrame:
+    """Ref: servable/api/DataFrame.java:33 — in-memory rows + schema."""
+
+    def __init__(self, column_names: List[str],
+                 data_types: List[DataType], rows: List[Row]):
+        if len(column_names) != len(data_types):
+            raise ValueError("columnNames and dataTypes must align")
+        for row in rows:
+            if row.size() != len(column_names):
+                raise ValueError("row arity does not match schema")
+        self._names = list(column_names)
+        self._types = list(data_types)
+        self._rows = list(rows)
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._names)
+
+    @property
+    def data_types(self) -> List[DataType]:
+        return list(self._types)
+
+    def get_index(self, name: str) -> int:
+        try:
+            return self._names.index(name)
+        except ValueError:
+            raise ValueError(f"no column {name!r}; available {self._names}")
+
+    def get_data_type(self, name: str) -> DataType:
+        return self._types[self.get_index(name)]
+
+    def add_column(self, name: str, dtype: DataType,
+                   values: Sequence[Any]) -> "DataFrame":
+        """Ref: DataFrame.addColumn:100 — appends a column in place."""
+        if len(values) != len(self._rows):
+            raise ValueError("column length must equal number of rows")
+        self._names.append(name)
+        self._types.append(dtype)
+        for row, v in zip(self._rows, values):
+            row.add(v)
+        return self
+
+    def get(self, name: str) -> "_Column":
+        idx = self.get_index(name)
+        return _Column(name, self._types[idx],
+                       [row.get(idx) for row in self._rows])
+
+    def collect(self) -> List[Row]:
+        """Ref: DataFrame.collect:119."""
+        return list(self._rows)
+
+    def num_rows(self) -> int:
+        return len(self._rows)
+
+
+class TransformerServable:
+    """Ref: servable/api/TransformerServable.java."""
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        raise NotImplementedError
+
+
+class ModelServable(TransformerServable):
+    """Ref: servable/api/ModelServable.java — loads model data from
+    streams/files; ``load(path)`` restores params + model data."""
+
+    def set_model_data(self, *streams) -> "ModelServable":
+        raise NotImplementedError
+
+    @classmethod
+    def load(cls, path: str) -> "ModelServable":
+        raise NotImplementedError

@@ -1,0 +1,86 @@
+"""Logistic regression servable.
+
+Ref parity: flink-ml-servable-lib/.../classification/logisticregression/
+LogisticRegressionModelServable.java:62 — transform adds prediction +
+rawPrediction columns (:106: prediction = 1 iff dot ≥ 0, raw = [1-p, p]);
+model data loads from a byte stream (LogisticRegressionModelData
+encode/decode) or from a saved model directory.
+"""
+
+from __future__ import annotations
+
+import io as _io
+from typing import Tuple
+
+import numpy as np
+
+from flink_ml_tpu.linalg.vectors import DenseVector, Vector
+from flink_ml_tpu.params.shared import (
+    HasFeaturesCol,
+    HasPredictionCol,
+    HasRawPredictionCol,
+)
+from flink_ml_tpu.servable.api import (
+    DataFrame,
+    DataTypes,
+    ModelServable,
+)
+from flink_ml_tpu.utils import io as rw
+
+
+class LogisticRegressionModelData:
+    """Ref: LogisticRegressionModelData with encode/decode."""
+
+    def __init__(self, coefficient: np.ndarray, model_version: int = 0):
+        self.coefficient = np.asarray(coefficient, np.float64)
+        self.model_version = int(model_version)
+
+    def encode(self) -> bytes:
+        vec = DenseVector(self.coefficient).to_bytes()
+        return self.model_version.to_bytes(8, "little") + vec
+
+    @staticmethod
+    def decode(data: bytes) -> "LogisticRegressionModelData":
+        version = int.from_bytes(data[:8], "little")
+        vec = Vector.from_bytes(data[8:])
+        return LogisticRegressionModelData(vec.to_array(), version)
+
+
+class LogisticRegressionModelServable(ModelServable, HasFeaturesCol,
+                                      HasPredictionCol, HasRawPredictionCol):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.model_data: LogisticRegressionModelData = None
+
+    def set_model_data(self, *streams) -> "LogisticRegressionModelServable":
+        (stream,) = streams
+        data = stream.read() if hasattr(stream, "read") else bytes(stream)
+        self.model_data = LogisticRegressionModelData.decode(data)
+        return self
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        if self.model_data is None:
+            raise ValueError("servable has no model data")
+        features = df.get(self.features_col).values
+        x = np.stack([f.to_array() if isinstance(f, Vector)
+                      else np.asarray(f, np.float64) for f in features])
+        dots = x @ self.model_data.coefficient
+        prob = 1.0 - 1.0 / (1.0 + np.exp(dots))
+        predictions = (dots >= 0).astype(np.float64)
+        raw = [DenseVector([1 - p, p]) for p in prob]
+        df.add_column(self.prediction_col, DataTypes.DOUBLE,
+                      predictions.tolist())
+        df.add_column(self.raw_prediction_col, DataTypes.vector(), raw)
+        return df
+
+    @classmethod
+    def load(cls, path: str) -> "LogisticRegressionModelServable":
+        meta = rw.load_metadata(path)
+        servable = cls()
+        servable.params_from_json(meta["paramMap"])
+        arrays = rw.load_model_arrays(path, "model")
+        version = int(arrays.get("modelVersion", [0])[0]) \
+            if "modelVersion" in arrays else 0
+        servable.model_data = LogisticRegressionModelData(
+            arrays["coefficient"], version)
+        return servable

@@ -1,0 +1,137 @@
+"""Online algorithm tests (ref: OnlineLogisticRegressionTest.java,
+OnlineKMeansTest.java, OnlineStandardScalerTest.java — unbounded streams
+with model-version checks)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.table import Table, as_dense_vector_column
+from flink_ml_tpu.iteration.streaming import StreamTable
+from flink_ml_tpu.models.classification import (
+    OnlineLogisticRegression,
+    OnlineLogisticRegressionModel,
+)
+from flink_ml_tpu.models.clustering import KMeansModel, OnlineKMeans
+from flink_ml_tpu.models.feature import (
+    OnlineStandardScaler,
+    OnlineStandardScalerModel,
+)
+
+
+def make_lr_stream(rng, n=2000, d=4):
+    w_true = rng.normal(size=d)
+    x = rng.normal(size=(n, d))
+    y = (x @ w_true > 0).astype(np.float64)
+    return Table.from_columns(features=x, label=y), w_true
+
+
+def init_model_table(d):
+    return Table.from_columns(
+        coefficient=as_dense_vector_column(np.zeros((1, d))),
+        modelVersion=np.asarray([0], np.int64))
+
+
+def test_online_lr_requires_initial_model(rng):
+    t, _ = make_lr_stream(rng, n=64)
+    with pytest.raises(ValueError):
+        OnlineLogisticRegression().fit(t)
+
+
+def test_online_lr_learns_and_versions(rng):
+    t, w_true = make_lr_stream(rng, n=4000)
+    est = (OnlineLogisticRegression(global_batch_size=500, alpha=0.5,
+                                    beta=1.0)
+           .set_initial_model_data(init_model_table(4)))
+    model = est.fit(t)
+    # versions increment once per global batch
+    assert model.model_version == 4000 // 500
+    assert [v for v, _ in model.history] == list(range(1, 9))
+    out = model.transform(t)[0]
+    acc = np.mean(out["prediction"] == t["label"])
+    assert acc > 0.9, f"accuracy {acc}"
+    # version column stamped on predictions
+    assert (out["version"] == model.model_version).all()
+
+
+def test_online_lr_regularization_sparsifies(rng):
+    t, _ = make_lr_stream(rng, n=2000)
+    est = (OnlineLogisticRegression(global_batch_size=200, reg=2.0,
+                                    elastic_net=1.0)
+           .set_initial_model_data(init_model_table(4)))
+    model = est.fit(t)
+    assert np.count_nonzero(model.coefficients) < 4  # l1 zeroes weak dims
+
+
+def test_online_lr_transform_stream_uses_versions(rng):
+    t, _ = make_lr_stream(rng, n=900)
+    est = (OnlineLogisticRegression(global_batch_size=300)
+           .set_initial_model_data(init_model_table(4)))
+    model = est.fit(t)
+    outs = list(model.transform_stream(StreamTable.from_table(t, 300)))
+    assert [o["version"][0] for o in outs] == [1, 2, 3]
+
+
+def test_online_lr_save_load(rng, tmp_path):
+    t, _ = make_lr_stream(rng, n=500)
+    model = (OnlineLogisticRegression(global_batch_size=100)
+             .set_initial_model_data(init_model_table(4))).fit(t)
+    model.save(str(tmp_path / "olr"))
+    reloaded = OnlineLogisticRegressionModel.load(str(tmp_path / "olr"))
+    np.testing.assert_array_equal(reloaded.coefficients, model.coefficients)
+    assert reloaded.model_version == model.model_version
+
+
+def test_online_kmeans_tracks_drift(rng):
+    # initial centroids near origin; stream shifted by +10 → centroids move
+    init = KMeansModel(centroids=np.array([[0.0, 0.0], [1.0, 1.0]]),
+                       weights=np.array([1.0, 1.0]))
+    x = rng.normal(size=(1000, 2)) + np.array([10.0, 10.0])
+    est = (OnlineKMeans(global_batch_size=100, decay_factor=0.5, k=2)
+           .set_initial_model_data(init.get_model_data()[0]))
+    model = est.fit(Table.from_columns(features=x))
+    # the capturing centroid converges to the stream's mean; the empty one
+    # keeps its position with decayed weight (reference semantics)
+    closest = np.linalg.norm(model.centroids - np.array([10, 10]),
+                             axis=1).min()
+    assert closest < 0.5
+    assert model.weights.max() > 100 and model.weights.min() < 1
+    pred = model.transform(Table.from_columns(features=x))[0]["prediction"]
+    assert pred.shape == (1000,)
+
+
+def test_online_kmeans_decay_zero_forgets_history():
+    init = KMeansModel(centroids=np.array([[100.0], [-100.0]]),
+                       weights=np.array([1e9, 1e9]))
+    x = np.concatenate([np.full((50, 1), 5.0), np.full((50, 1), -5.0)])
+    est = (OnlineKMeans(global_batch_size=100, decay_factor=0.0, k=2)
+           .set_initial_model_data(init.get_model_data()[0]))
+    model = est.fit(Table.from_columns(features=x))
+    # decay 0: old weights vanish; centroids jump to batch means
+    np.testing.assert_allclose(sorted(model.centroids.ravel()), [-5.0, 5.0])
+
+
+def test_online_standard_scaler(rng):
+    from flink_ml_tpu.common.window import CountTumblingWindows
+    x = rng.normal(size=(1000, 3)) * [1, 5, 10] + [0, 2, -4]
+    t = Table.from_columns(input=x)
+    est = OnlineStandardScaler(
+        windows=CountTumblingWindows.of(250), with_mean=True)
+    model = est.fit(t)
+    assert model.model_version == 3  # 4 windows → versions 0..3
+    assert len(model.history) == 4
+    # cumulative stats equal full-batch stats at the end
+    np.testing.assert_allclose(model.mean, x.mean(axis=0), rtol=1e-9)
+    np.testing.assert_allclose(model.std, x.std(axis=0, ddof=1), rtol=1e-9)
+    out = model.transform(t)[0]
+    assert (out["version"] == 3).all()
+    np.testing.assert_allclose(out["output"].std(axis=0, ddof=1), 1.0,
+                               rtol=1e-6)
+
+
+def test_online_standard_scaler_save_load(rng, tmp_path):
+    x = rng.normal(size=(100, 2))
+    model = OnlineStandardScaler().fit(Table.from_columns(input=x))
+    model.save(str(tmp_path / "oss"))
+    reloaded = OnlineStandardScalerModel.load(str(tmp_path / "oss"))
+    np.testing.assert_array_equal(reloaded.mean, model.mean)
+    assert reloaded.model_version == model.model_version

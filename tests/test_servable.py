@@ -1,0 +1,93 @@
+"""Servable path tests (ref: PipelineModelServableTest.java,
+LogisticRegressionModelServable parity assertions in LogisticRegressionTest)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.table import Table, as_dense_vector_column
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.classification import LogisticRegression
+from flink_ml_tpu.servable import (
+    DataFrame,
+    DataTypes,
+    LogisticRegressionModelServable,
+    PipelineModelServable,
+    Row,
+)
+from flink_ml_tpu.servable.lr import LogisticRegressionModelData
+
+
+def make_df(x):
+    rows = [Row([Vectors.dense(v)]) for v in x]
+    return DataFrame(["features"], [DataTypes.vector()], rows)
+
+
+def test_dataframe_api():
+    df = make_df(np.eye(2))
+    assert df.column_names == ["features"]
+    df.add_column("id", DataTypes.INT, [1, 2])
+    assert df.get("id").values == [1, 2]
+    assert df.collect()[0].size() == 2
+    with pytest.raises(ValueError):
+        df.add_column("bad", DataTypes.INT, [1])
+    with pytest.raises(ValueError):
+        df.get_index("missing")
+
+
+def test_lr_model_data_codec():
+    md = LogisticRegressionModelData(np.array([1.5, -2.0]), model_version=7)
+    decoded = LogisticRegressionModelData.decode(md.encode())
+    np.testing.assert_array_equal(decoded.coefficient, md.coefficient)
+    assert decoded.model_version == 7
+
+
+def test_lr_servable_matches_model(rng, tmp_path):
+    x = rng.normal(size=(50, 3)).astype(np.float64)
+    y = (x @ np.array([1.0, -1.0, 0.5]) > 0).astype(np.float64)
+    table = Table.from_columns(features=as_dense_vector_column(x), label=y)
+    model = LogisticRegression(max_iter=20, global_batch_size=50).fit(table)
+    model.save(str(tmp_path / "lr"))
+
+    servable = LogisticRegressionModelServable.load(str(tmp_path / "lr"))
+    out_df = servable.transform(make_df(x))
+    servable_pred = out_df.get("prediction").values
+    model_pred = model.transform(table)[0]["prediction"]
+    np.testing.assert_array_equal(servable_pred, model_pred)
+    raw = out_df.get("rawPrediction").values[0].to_array()
+    assert raw.sum() == pytest.approx(1.0)
+
+
+def test_lr_servable_set_model_data_stream():
+    md = LogisticRegressionModelData(np.array([2.0, 0.0]))
+    servable = LogisticRegressionModelServable()
+    servable.set_model_data(io.BytesIO(md.encode()))
+    out = servable.transform(make_df(np.array([[1.0, 0.0], [-1.0, 0.0]])))
+    assert out.get("prediction").values == [1.0, 0.0]
+
+
+def test_pipeline_model_servable(rng, tmp_path):
+    from flink_ml_tpu.api import Pipeline
+    x = rng.normal(size=(60, 3)).astype(np.float64)
+    y = (x @ np.array([1.0, 2.0, -1.0]) > 0).astype(np.float64)
+    table = Table.from_columns(features=as_dense_vector_column(x), label=y)
+    pm = Pipeline([LogisticRegression(max_iter=10,
+                                      global_batch_size=60)]).fit(table)
+    pm.save(str(tmp_path / "pipe"))
+
+    servable = PipelineModelServable.load(str(tmp_path / "pipe"))
+    out = servable.transform(make_df(x))
+    np.testing.assert_array_equal(out.get("prediction").values,
+                                  pm.transform(table)[0]["prediction"])
+
+
+def test_pipeline_servable_unsupported_stage(tmp_path, rng):
+    from flink_ml_tpu.api import Pipeline
+    from flink_ml_tpu.models.clustering import KMeans
+    x = rng.normal(size=(30, 2)).astype(np.float32)
+    table = Table.from_columns(features=x)
+    pm = Pipeline([KMeans(k=2, seed=0)]).fit(table)
+    pm.save(str(tmp_path / "pk"))
+    with pytest.raises(ValueError, match="no servable"):
+        PipelineModelServable.load(str(tmp_path / "pk"))
