@@ -1,0 +1,29 @@
+"""Train then evaluate with AUC/KS (ref: BinaryClassificationEvaluatorExample)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.classification import LogisticRegression
+from flink_ml_tpu.models.evaluation import BinaryClassificationEvaluator
+
+
+def main():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(1000, 5)).astype(np.float32)
+    y = (x @ rng.normal(size=5) > 0).astype(np.float64)
+    table = Table.from_columns(features=x, label=y)
+    scored = LogisticRegression(max_iter=30, global_batch_size=1000).fit(
+        table).transform(table)[0]
+    metrics = BinaryClassificationEvaluator(
+        metrics_names=["areaUnderROC", "areaUnderPR", "ks"]).transform(
+        scored)[0]
+    print({name: round(metrics[name][0], 4)
+           for name in metrics.column_names})
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Text + discrete feature engineering (ref: 33 feature examples)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+from flink_ml_tpu.api import Pipeline
+from flink_ml_tpu.models.feature import (HashingTF, IDF, StopWordsRemover,
+                                         StringIndexer, Tokenizer)
+
+
+def main():
+    docs = np.array(["the quick brown fox", "lazy dogs and quick cats",
+                     "brown cats sleep"], dtype=object)
+    color = np.array(["red", "blue", "red"], dtype=object)
+    table = Table.from_columns(doc=docs, color=color)
+    model = Pipeline([
+        Tokenizer(input_col="doc", output_col="tokens"),
+        StopWordsRemover(input_cols=["tokens"], output_cols=["filtered"]),
+        HashingTF(input_col="filtered", output_col="tf", num_features=64),
+        IDF(input_col="tf", output_col="tfidf"),
+        StringIndexer(input_cols=["color"], output_cols=["colorIdx"],
+                      string_order_type="alphabetAsc"),
+    ]).fit(table)
+    out = model.transform(table)[0]
+    print("columns:", out.column_names)
+    print("color indices:", out["colorIdx"])
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""KMeans quickstart (ref: flink-ml-examples KMeansExample.java:34-66)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.clustering import KMeans
+
+
+def main():
+    rng = np.random.default_rng(0)
+    points = np.concatenate([rng.normal(size=(100, 2)),
+                             rng.normal(size=(100, 2)) + 8]).astype(np.float32)
+    table = Table.from_columns(features=points)
+    model = KMeans(k=2, seed=0).fit(table)
+    out = model.transform(table)[0]
+    for features, cluster in list(zip(out["features"], out["prediction"]))[:5]:
+        print(f"features: {np.round(features, 2)}\tcluster: {cluster}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

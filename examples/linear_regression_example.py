@@ -1,0 +1,24 @@
+"""LinearRegression (ref: LinearRegressionExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.regression import LinearRegression
+
+
+def main():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(400, 3)).astype(np.float32)
+    y = x @ [2.0, -1.0, 0.5]
+    model = LinearRegression(max_iter=200, global_batch_size=400,
+                             learning_rate=0.3).fit(
+        Table.from_columns(features=x, label=y))
+    print("coefficients:", np.round(model.coefficients, 3))
+    return model
+
+
+if __name__ == "__main__":
+    main()

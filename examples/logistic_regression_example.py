@@ -1,0 +1,25 @@
+"""LogisticRegression train + predict (ref: LogisticRegressionExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.classification import LogisticRegression
+
+
+def main():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(500, 4)).astype(np.float32)
+    y = (x @ [1.0, -2.0, 0.5, 1.5] > 0).astype(np.float64)
+    train = Table.from_columns(features=x, label=y)
+    model = LogisticRegression(max_iter=50, global_batch_size=500,
+                               learning_rate=0.5).fit(train)
+    out = model.transform(train)[0]
+    print("accuracy:", np.mean(out["prediction"] == y))
+    return out
+
+
+if __name__ == "__main__":
+    main()

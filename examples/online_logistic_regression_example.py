@@ -1,0 +1,33 @@
+"""Online FTRL training on a stream (ref: OnlineLogisticRegressionExample)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+from flink_ml_tpu.common.table import as_dense_vector_column
+from flink_ml_tpu.iteration.streaming import StreamTable
+from flink_ml_tpu.models.classification import OnlineLogisticRegression
+
+
+def main():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2000, 4))
+    y = (x @ [1.0, 1.0, -1.0, 0.5] > 0).astype(np.float64)
+    stream = StreamTable.from_table(Table.from_columns(features=x, label=y),
+                                    chunk_size=250)
+    init = Table.from_columns(
+        coefficient=as_dense_vector_column(np.zeros((1, 4))),
+        modelVersion=np.asarray([0]))
+    model = (OnlineLogisticRegression(global_batch_size=500, alpha=0.5)
+             .set_initial_model_data(init).fit(stream))
+    print("model versions produced:", model.model_version)
+    out = model.transform(Table.from_columns(features=x, label=y))[0]
+    print("accuracy:", np.mean(out["prediction"] == y),
+          "version col:", out["version"][0])
+    return model
+
+
+if __name__ == "__main__":
+    main()

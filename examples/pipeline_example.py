@@ -1,0 +1,36 @@
+"""Assemble→scale→classify pipeline with save/load (ref: builder examples)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import tempfile
+
+import numpy as np
+from flink_ml_tpu import Table
+from flink_ml_tpu.api import Pipeline, PipelineModel
+from flink_ml_tpu.models.classification import LogisticRegression
+from flink_ml_tpu.models.feature import StandardScaler, VectorAssembler
+
+
+def main():
+    rng = np.random.default_rng(4)
+    a, b = rng.normal(size=300) * 10, rng.normal(size=300)
+    label = (a / 10 + b > 0).astype(np.float64)
+    table = Table.from_columns(a=a, b=b, label=label)
+    pipeline = Pipeline([
+        VectorAssembler(input_cols=["a", "b"], output_col="assembled"),
+        StandardScaler(input_col="assembled", output_col="features"),
+        LogisticRegression(max_iter=40, global_batch_size=300),
+    ])
+    model = pipeline.fit(table)
+    path = os.path.join(tempfile.mkdtemp(), "pipeline")
+    model.save(path)
+    reloaded = PipelineModel.load(path)
+    out = reloaded.transform(table)[0]
+    print("accuracy:", np.mean(out["prediction"] == label))
+    return out
+
+
+if __name__ == "__main__":
+    main()

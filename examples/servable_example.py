@@ -1,0 +1,36 @@
+"""Serve a trained pipeline without the training runtime (ref: servable docs)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import tempfile
+
+import numpy as np
+from flink_ml_tpu import Table
+from flink_ml_tpu.api import Pipeline
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.classification import LogisticRegression
+from flink_ml_tpu.servable import DataFrame, DataTypes, PipelineModelServable, Row
+
+
+def main():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(200, 3))
+    y = (x @ [1.0, 2.0, -1.0] > 0).astype(np.float64)
+    model = Pipeline([LogisticRegression(max_iter=30,
+                                         global_batch_size=200)]).fit(
+        Table.from_columns(features=x, label=y))
+    path = os.path.join(tempfile.mkdtemp(), "m")
+    model.save(path)
+
+    servable = PipelineModelServable.load(path)
+    df = DataFrame(["features"], [DataTypes.vector()],
+                   [Row([Vectors.dense(v)]) for v in x[:5]])
+    out = servable.transform(df)
+    print("served predictions:", out.get("prediction").values)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Swing item recommendation (ref: SwingExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.recommendation import Swing
+
+
+def main():
+    users = np.array([1, 1, 1, 2, 2, 2, 3, 3, 3], dtype=np.int64)
+    items = np.array([10, 11, 12, 10, 11, 13, 11, 12, 13], dtype=np.int64)
+    out = Swing(min_user_behavior=2, k=3).transform(
+        Table.from_columns(user=users, item=items))[0]
+    for item, recs in zip(out["item"], out["output"]):
+        print(f"item {item} -> {recs}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

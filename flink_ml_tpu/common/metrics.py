@@ -1,0 +1,92 @@
+"""ML metrics and profiling hooks.
+
+Ref parity: flink-ml-servable-core/.../common/metrics/MLMetrics.java —
+metric group names (``ml`` / ``model``) and the model ``timestamp`` /
+``version`` gauges used by online models
+(OnlineStandardScalerModel.java:202-210). The reference otherwise relies on
+Flink's web UI; we expose a process-local registry plus a first-class
+profiler hook (jax.profiler) — SURVEY.md §5 flags profiling as a reference
+gap worth closing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict
+
+ML_GROUP = "ml"
+MODEL_GROUP = "model"
+TIMESTAMP_GAUGE = "timestamp"
+VERSION_GAUGE = "version"
+
+
+class MetricGroup:
+    def __init__(self, name: str):
+        self.name = name
+        self._gauges: Dict[str, float] = {}
+        self._counters: Dict[str, int] = {}
+
+    def gauge(self, name: str, value) -> None:
+        self._gauges[name] = value
+
+    def counter(self, name: str, increment: int = 1) -> int:
+        self._counters[name] = self._counters.get(name, 0) + increment
+        return self._counters[name]
+
+    def get_gauge(self, name: str):
+        return self._gauges.get(name)
+
+    def get_counter(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+
+class MetricsRegistry:
+    """Process-local metric registry; groups address as 'ml.model'."""
+
+    def __init__(self):
+        self._groups: Dict[str, MetricGroup] = {}
+
+    def group(self, *path: str) -> MetricGroup:
+        key = ".".join(path)
+        if key not in self._groups:
+            self._groups[key] = MetricGroup(key)
+        return self._groups[key]
+
+    def model_group(self) -> MetricGroup:
+        return self.group(ML_GROUP, MODEL_GROUP)
+
+    def report_model(self, version: int, timestamp_ms: int = None) -> None:
+        """The ml.model version/timestamp gauges (ref: MLMetrics usage)."""
+        group = self.model_group()
+        group.gauge(VERSION_GAUGE, version)
+        group.gauge(TIMESTAMP_GAUGE,
+                    timestamp_ms if timestamp_ms is not None
+                    else int(time.time() * 1000))
+
+    def snapshot(self) -> Dict[str, Dict[str, Dict[str, float]]]:
+        return {name: {"gauges": dict(g._gauges),
+                       "counters": dict(g._counters)}
+                for name, g in self._groups.items()}
+
+
+#: default process-wide registry
+metrics = MetricsRegistry()
+
+
+@contextlib.contextmanager
+def profile(trace_dir: str = None):
+    """Profile a region: wall-time gauge always; a jax.profiler trace when
+    ``trace_dir`` is given (view with TensorBoard / xprof)."""
+    import jax
+
+    start = time.perf_counter()
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        if trace_dir:
+            jax.profiler.stop_trace()
+        metrics.group(ML_GROUP).gauge(
+            "lastProfiledRegionMs", (time.perf_counter() - start) * 1000.0)

@@ -1,0 +1,55 @@
+"""Termination criteria helpers.
+
+Ref parity: flink-ml-core/.../common/iteration/{TerminateOnMaxIter.java:34,
+TerminateOnMaxIterOrTol.java:34, ForwardInputsOfLastRound.java:34}. In the
+reference these are dataflow UDFs feeding the coordinator's termination
+vote; here they are predicate factories for ``iterate_bounded``'s
+``terminate`` argument (epoch bounding is the driver's ``max_iter``; these
+add the tol / data-dependent parts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+
+def terminate_on_max_iter(max_iter: int) -> Callable:
+    """Pure round-count bound (ref: TerminateOnMaxIter) — provided for
+    symmetry; equivalent to passing ``max_iter`` to iterate_bounded."""
+    def predicate(carry: Any, epoch) -> jnp.ndarray:
+        return jnp.asarray(epoch + 1 >= max_iter)
+    return predicate
+
+
+def terminate_on_max_iter_or_tol(tol: float,
+                                 loss_fn: Callable[[Any], Any] = None
+                                 ) -> Callable:
+    """Stop when the carry's loss drops below tol (ref:
+    TerminateOnMaxIterOrTol — the maxIter half is the driver's bound).
+    ``loss_fn`` extracts the loss scalar from the carry (default: carry
+    itself, or its 'loss' entry for dict carries)."""
+    def predicate(carry: Any, epoch) -> jnp.ndarray:
+        loss = (loss_fn(carry) if loss_fn is not None
+                else (carry["loss"] if isinstance(carry, dict) else carry))
+        return jnp.asarray(loss) < tol
+    return predicate
+
+
+def terminate_on_empty_round(count_fn: Callable[[Any], Any]) -> Callable:
+    """Stop when a round processed zero records — the coordinator's
+    data-driven vote (ref: SharedProgressAligner.EpochStatus.isTerminated,
+    SharedProgressAligner.java:277-292). ``count_fn`` extracts the global
+    (already psum'd) record count from the carry."""
+    def predicate(carry: Any, epoch) -> jnp.ndarray:
+        return jnp.asarray(count_fn(carry)) == 0
+    return predicate
+
+
+def forward_inputs_of_last_round(final_carry: Any,
+                                 extract: Callable[[Any], Any] = None):
+    """The final carry IS the last round's value (ref:
+    ForwardInputsOfLastRound buffers then emits at termination — on TPU
+    nothing needs buffering; this helper just documents the mapping)."""
+    return extract(final_carry) if extract is not None else final_carry

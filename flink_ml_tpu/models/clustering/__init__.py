@@ -5,4 +5,7 @@ from flink_ml_tpu.models.clustering.kmeans import (  # noqa: F401
 from flink_ml_tpu.models.clustering.agglomerative import (  # noqa: F401
     AgglomerativeClustering,
 )
-from flink_ml_tpu.models.online import OnlineKMeans  # noqa: F401,E402
+from flink_ml_tpu.models.online import (  # noqa: F401,E402
+    OnlineKMeans,
+    OnlineKMeansModel,
+)

@@ -213,6 +213,12 @@ class OnlineKMeansParams(KMeansModelParams, HasBatchStrategy,
     pass
 
 
+class OnlineKMeansModel(KMeansModel):
+    """Ref: OnlineKMeansModel.java — a KMeansModel fed by a stream of
+    versioned model data; prediction logic is identical, the model data is
+    whatever snapshot was consumed last."""
+
+
 class OnlineKMeans(Estimator, OnlineKMeansParams):
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
@@ -223,7 +229,7 @@ class OnlineKMeans(Estimator, OnlineKMeansParams):
         self._initial_model_data = model_data
         return self
 
-    def fit(self, data: Union[Table, StreamTable]) -> KMeansModel:
+    def fit(self, data: Union[Table, StreamTable]) -> "OnlineKMeansModel":
         if self._initial_model_data is None:
             raise ValueError("initial model data must be set before fit "
                              "(setInitialModelData)")
@@ -251,7 +257,7 @@ class OnlineKMeans(Estimator, OnlineKMeansParams):
                 centroids[i] = (1 - lam) * centroids[i] \
                     + (lam / counts[i]) * sums[i]
 
-        model = KMeansModel(centroids=centroids, weights=weights)
+        model = OnlineKMeansModel(centroids=centroids, weights=weights)
         return self.copy_params_to(model)
 
 

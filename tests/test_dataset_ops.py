@@ -1,0 +1,129 @@
+"""Dataset primitive + termination helper + metrics tests
+(ref: DataStreamUtilsTest, common/iteration tests, MLMetrics usage)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common import dataset
+from flink_ml_tpu.common.metrics import MetricsRegistry, metrics, profile
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.common.window import CountTumblingWindows, GlobalWindows
+from flink_ml_tpu.iteration import termination
+from flink_ml_tpu.iteration.streaming import StreamTable
+
+
+@pytest.fixture
+def table(rng):
+    return Table.from_columns(k=np.array([1, 2, 1, 3, 2, 1]),
+                              v=np.arange(6.0))
+
+
+def test_partition_and_map_partition(table):
+    parts = dataset.partition(table, 4)
+    assert sum(p.num_rows for p in parts) == 6
+    out = dataset.map_partition(
+        table, lambda t: t.with_column("v", t["v"] * 2), num_partitions=3)
+    np.testing.assert_array_equal(out["v"], np.arange(6.0) * 2)
+
+
+def test_reduce_and_keyed(table):
+    assert dataset.reduce([1, 2, 3], lambda a, b: a + b) == 6
+    with pytest.raises(ValueError):
+        dataset.reduce([], lambda a, b: a + b)
+    grouped = dataset.reduce_keyed(
+        zip(table["k"], table["v"]), key_fn=lambda t: t[0],
+        fn=lambda a, b: (a[0], a[1] + b[1]))
+    assert grouped[1] == (1, 0 + 2 + 5)
+
+
+def test_aggregate():
+    out = dataset.aggregate(
+        range(10), create_accumulator=lambda: (0, 0),
+        add=lambda acc, v: (acc[0] + v, acc[1] + 1),
+        get_result=lambda acc: acc[0] / acc[1])
+    assert out == 4.5
+    # partitioned accumulators combined via merge
+    out2 = dataset.aggregate(
+        range(10), create_accumulator=lambda: (0, 0),
+        add=lambda acc, v: (acc[0] + v, acc[1] + 1),
+        merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        get_result=lambda acc: acc[0] / acc[1], num_partitions=3)
+    assert out2 == 4.5
+    with pytest.raises(ValueError):
+        dataset.aggregate(range(4), lambda: 0, lambda a, v: a + v,
+                          num_partitions=2)
+
+
+def test_sample(table):
+    s = dataset.sample(table, 3, seed=1)
+    assert s.num_rows == 3
+    s2 = dataset.sample(table, 3, seed=1)
+    np.testing.assert_array_equal(s["v"], s2["v"])  # deterministic
+    assert dataset.sample(table, 100).num_rows == 6  # oversample = identity
+
+
+def test_co_group():
+    a = Table.from_columns(k=np.array([1, 2, 2]), x=np.array([10., 20., 21.]))
+    b = Table.from_columns(k=np.array([2, 3]), y=np.array([200., 300.]))
+    out = dataset.co_group(
+        a, b, "k", "k",
+        fn=lambda k, ra, rb: [(k, ra.num_rows, rb.num_rows)],
+        out_names=["k", "na", "nb"])
+    assert out.rows() == [(1, 1, 0), (2, 2, 1), (3, 0, 1)]
+
+
+def test_window_all_and_process(table):
+    stream = StreamTable.from_table(table, 2)
+    counts = dataset.window_all_and_process(
+        stream, CountTumblingWindows.of(4), lambda t: t.num_rows)
+    assert counts == [4, 2]
+    counts2 = dataset.window_all_and_process(
+        table, GlobalWindows(), lambda t: t.num_rows)
+    assert counts2 == [6]
+    # global window over a multi-chunk stream is still ONE window
+    stream2 = StreamTable.from_table(table, 2)
+    counts3 = dataset.window_all_and_process(
+        stream2, GlobalWindows(), lambda t: t.num_rows)
+    assert counts3 == [6]
+
+
+def test_termination_helpers():
+    import jax.numpy as jnp
+    from flink_ml_tpu.iteration import iterate_bounded
+
+    pred = termination.terminate_on_max_iter_or_tol(0.1)
+    out = iterate_bounded({"w": jnp.float32(0.), "loss": jnp.float32(1.0)},
+                          lambda c, e: {"w": c["w"] + 1,
+                                        "loss": c["loss"] * 0.5},
+                          max_iter=100, terminate=pred)
+    assert float(out["loss"]) < 0.1 and float(out["w"]) < 10
+
+    empty = termination.terminate_on_empty_round(lambda c: c["count"])
+    out2 = iterate_bounded(
+        {"n": jnp.int32(0), "count": jnp.int32(3)},
+        lambda c, e: {"n": c["n"] + 1, "count": c["count"] - 1},
+        max_iter=100, terminate=empty)
+    assert int(out2["n"]) == 3
+
+    assert termination.forward_inputs_of_last_round({"a": 1},
+                                                    lambda c: c["a"]) == 1
+
+
+def test_metrics_registry():
+    reg = MetricsRegistry()
+    reg.report_model(version=3)
+    group = reg.model_group()
+    assert group.get_gauge("version") == 3
+    assert group.get_gauge("timestamp") > 0
+    reg.group("ml").counter("fits")
+    reg.group("ml").counter("fits")
+    assert reg.group("ml").get_counter("fits") == 2
+    snap = reg.snapshot()
+    assert "ml.model" in snap
+    assert snap["ml"]["counters"]["fits"] == 2
+
+
+def test_profile_context():
+    with profile():
+        sum(range(1000))
+    assert metrics.group("ml").get_gauge("lastProfiledRegionMs") >= 0

@@ -1,0 +1,24 @@
+"""Every example must run green (ref: the 48 runnable example mains)."""
+
+import glob
+import importlib.util
+import os
+
+import pytest
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
+EXAMPLES = sorted(glob.glob(os.path.join(EXAMPLES_DIR, "*_example.py")))
+
+
+@pytest.mark.parametrize("path", EXAMPLES,
+                         ids=[os.path.basename(p) for p in EXAMPLES])
+def test_example_runs(path):
+    spec = importlib.util.spec_from_file_location(
+        os.path.basename(path)[:-3], path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    assert module.main() is not None
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 10
