@@ -119,9 +119,18 @@ class KMeansModel(Model, KMeansModelParams):
         if self.centroids is None:
             raise ValueError("KMeansModel has no model data")
         x = table.vectors(self.features_col)
-        assign = _build_assign_program(self.distance_measure)
-        labels = np.asarray(assign(jnp.asarray(x),
-                                   jnp.asarray(self.centroids, jnp.float32)))
+        from flink_ml_tpu.ops.pallas_kernels import (
+            assign_nearest,
+            pallas_supported,
+        )
+        if self.distance_measure == "euclidean" and pallas_supported():
+            # fused distance+argmin pallas kernel: no (n, k) matrix in HBM
+            labels = np.asarray(assign_nearest(
+                x, np.asarray(self.centroids, np.float32)))
+        else:
+            assign = _build_assign_program(self.distance_measure)
+            labels = np.asarray(assign(
+                jnp.asarray(x), jnp.asarray(self.centroids, jnp.float32)))
         return (table.with_column(self.prediction_col,
                                   labels.astype(np.int64)),)
 

@@ -89,7 +89,28 @@ class Swing(AlgoOperator, HasOutputCol):
         weights = {u: 1.0 / (alpha1 + len(s)) ** beta
                    for u, s in user_items.items()}
 
+        from flink_ml_tpu import native
+        if native.available():
+            ranked = self._score_native(user_items, item_users, weights,
+                                        alpha2)
+        else:
+            ranked = self._score_python(user_items, item_users, weights,
+                                        alpha2)
+
         out_items, out_recs = [], []
+        for item, top in ranked:
+            if not top:
+                continue
+            out_items.append(item)
+            out_recs.append(";".join(f"{j},{s}" for j, s in top))
+        return (Table.from_columns(**{
+            self.item_col: np.asarray(out_items, np.int64),
+            self.output_col: np.asarray(out_recs, dtype=object)}),)
+
+    # -- scoring backends ----------------------------------------------------
+    def _score_python(self, user_items, item_users, weights, alpha2):
+        """Pure-Python fallback (also the native kernel's test oracle)."""
+        ranked = []
         for item, purchasers in item_users.items():
             scores: dict = {}
             for a in range(len(purchasers)):
@@ -103,11 +124,39 @@ class Swing(AlgoOperator, HasOutputCol):
                     for j in inter.tolist():
                         if j != item:
                             scores[j] = scores.get(j, 0.0) + sim
-            if not scores:
-                continue
-            top = sorted(scores.items(), key=lambda t: -t[1])[: self.k]
-            out_items.append(item)
-            out_recs.append(";".join(f"{j},{s}" for j, s in top))
-        return (Table.from_columns(**{
-            self.item_col: np.asarray(out_items, np.int64),
-            self.output_col: np.asarray(out_recs, dtype=object)}),)
+            top = sorted(scores.items(),
+                         key=lambda t: (-t[1], t[0]))[: self.k]
+            ranked.append((item, top))
+        return ranked
+
+    def _score_native(self, user_items, item_users, weights, alpha2):
+        """CSR-pack the groupings and run the C++ kernel
+        (flink_ml_tpu/native/swing_kernel.cpp)."""
+        from flink_ml_tpu import native
+        users = list(user_items)
+        user_index = {u: i for i, u in enumerate(users)}
+        u_offsets = np.zeros(len(users) + 1, np.int64)
+        for i, u in enumerate(users):
+            u_offsets[i + 1] = u_offsets[i] + len(user_items[u])
+        u_flat = (np.concatenate([user_items[u] for u in users])
+                  if users else np.zeros(0, np.int64))
+        w = np.asarray([weights[u] for u in users], np.float64)
+
+        items = list(item_users)
+        i_offsets = np.zeros(len(items) + 1, np.int64)
+        for i, it in enumerate(items):
+            i_offsets[i + 1] = i_offsets[i] + len(item_users[it])
+        i_flat = (np.asarray([user_index[u] for it in items
+                              for u in item_users[it]], np.int64)
+                  if items else np.zeros(0, np.int64))
+
+        out_items, out_scores, out_counts = native.swing_similarity(
+            u_flat, u_offsets, w, i_flat, i_offsets,
+            np.asarray(items, np.int64), float(alpha2), int(self.k))
+        ranked = []
+        for i, item in enumerate(items):
+            n = int(out_counts[i])
+            ranked.append((item, [(int(out_items[i, r]),
+                                   float(out_scores[i, r]))
+                                  for r in range(n)]))
+        return ranked

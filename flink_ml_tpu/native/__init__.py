@@ -1,0 +1,127 @@
+"""Native (C++) kernel tier.
+
+The reference's "native layer" is the JVM runtime itself (SURVEY.md: zero
+C++/CUDA in the repo); this framework's equivalent split is: XLA/Pallas for
+device compute, and C++ for host-side kernels that are neither XLA-friendly
+nor fast in Python — currently the Swing pairwise-intersection core.
+
+Kernels compile lazily with g++ into a shared library next to the sources
+and bind via ctypes; every caller must handle ``available() == False`` and
+fall back to its Python implementation (no hard native dependency).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "swing_kernel.cpp")
+_LIB = os.path.join(_DIR, "_native_kernels.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    try:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            # per-process temp name: concurrent builders never share a file,
+            # and os.replace publishes atomically
+            tmp = f"{_LIB}.{os.getpid()}.tmp"
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                     "-std=c++17", _SRC, "-o", tmp],
+                    check=True, capture_output=True)
+                os.replace(tmp, _LIB)
+            finally:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+        lib = ctypes.CDLL(_LIB)
+        lib.swing_similarity.restype = ctypes.c_int
+        lib.swing_similarity.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),  # user_items
+            ctypes.POINTER(ctypes.c_int64),  # user_offsets
+            ctypes.POINTER(ctypes.c_double),  # user_weights
+            ctypes.c_int64,                   # n_users
+            ctypes.POINTER(ctypes.c_int64),  # item_users
+            ctypes.POINTER(ctypes.c_int64),  # item_offsets
+            ctypes.POINTER(ctypes.c_int64),  # item_ids
+            ctypes.c_int64,                   # n_items
+            ctypes.c_double,                  # alpha2
+            ctypes.c_int64,                   # k
+            ctypes.POINTER(ctypes.c_int64),  # out_items
+            ctypes.POINTER(ctypes.c_double),  # out_scores
+            ctypes.POINTER(ctypes.c_int64),  # out_counts
+        ]
+        return lib
+    except (OSError, subprocess.CalledProcessError):
+        # a concurrent builder may have published a valid library even if
+        # our own attempt failed — prefer loading it over giving up
+        try:
+            if os.path.exists(_LIB):
+                return ctypes.CDLL(_LIB)
+        except OSError:
+            pass
+        _build_failed = True
+        return None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is None and not _build_failed:
+        with _lock:
+            if _lib is None and not _build_failed:
+                _lib = _build()
+    return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def swing_similarity(user_items: np.ndarray, user_offsets: np.ndarray,
+                     user_weights: np.ndarray, item_users: np.ndarray,
+                     item_offsets: np.ndarray, item_ids: np.ndarray,
+                     alpha2: float, k: int):
+    """Native Swing scoring. Returns (out_items (n_items, k),
+    out_scores (n_items, k), out_counts (n_items,)); raises RuntimeError
+    if the native library is unavailable."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native kernels unavailable (g++ build failed)")
+    user_items = np.ascontiguousarray(user_items, np.int64)
+    user_offsets = np.ascontiguousarray(user_offsets, np.int64)
+    user_weights = np.ascontiguousarray(user_weights, np.float64)
+    item_users = np.ascontiguousarray(item_users, np.int64)
+    item_offsets = np.ascontiguousarray(item_offsets, np.int64)
+    item_ids = np.ascontiguousarray(item_ids, np.int64)
+    n_items = len(item_ids)
+    out_items = np.zeros((n_items, k), np.int64)
+    out_scores = np.zeros((n_items, k), np.float64)
+    out_counts = np.zeros(n_items, np.int64)
+    rc = lib.swing_similarity(
+        _ptr(user_items, ctypes.c_int64), _ptr(user_offsets, ctypes.c_int64),
+        _ptr(user_weights, ctypes.c_double),
+        ctypes.c_int64(len(user_offsets) - 1),
+        _ptr(item_users, ctypes.c_int64), _ptr(item_offsets, ctypes.c_int64),
+        _ptr(item_ids, ctypes.c_int64), ctypes.c_int64(n_items),
+        ctypes.c_double(alpha2), ctypes.c_int64(k),
+        _ptr(out_items, ctypes.c_int64), _ptr(out_scores, ctypes.c_double),
+        _ptr(out_counts, ctypes.c_int64))
+    if rc != 0:
+        raise RuntimeError(f"swing_similarity failed with code {rc}")
+    return out_items, out_scores, out_counts

@@ -1,0 +1,73 @@
+"""Pallas TPU kernels.
+
+The framework's hot device loops are mostly single fused matmuls that XLA
+already schedules well (SURVEY.md §7 layer 1: "Pallas where XLA fusion is
+insufficient"). The case where hand-tiling pays is nearest-centroid
+assignment with large k: XLA materializes the (n, k) distance matrix in HBM
+between the matmul and the argmin; this kernel keeps each (tile_n, k)
+distance block in VMEM and writes only the argmin — HBM traffic drops from
+O(n·k) to O(n·d + k·d + n).
+
+Used by KMeans/KNN paths when running on a real TPU backend; elsewhere the
+plain XLA path runs. Tests exercise the kernel in interpreter mode on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE_N = 1024
+
+
+def _assign_kernel(x_ref, c_ref, csq_ref, out_ref):
+    x = x_ref[:]                       # (tile_n, d)
+    c = c_ref[:]                       # (k, d)
+    # ‖x−c‖² up to the per-point constant ‖x‖² (irrelevant to the argmin)
+    cross = jnp.dot(x, c.T, preferred_element_type=jnp.float32)
+    d2 = csq_ref[:][None, :] - 2.0 * cross
+    out_ref[:, 0] = jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _assign_padded(x, centroids, interpret=False):
+    n, d = x.shape
+    k = centroids.shape[0]
+    csq = jnp.sum(centroids * centroids, axis=1)
+    grid = (n // TILE_N,)
+    return pl.pallas_call(
+        _assign_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE_N, d), lambda i: (i, 0)),
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((k,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((TILE_N, 1), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x, centroids, csq)
+
+
+def assign_nearest(x, centroids, interpret: bool = False):
+    """Nearest-centroid index per row of x — fused distance+argmin.
+
+    x: (n, d) float32; centroids: (k, d) float32 → (n,) int32.
+    Pads n up to the tile size; callers slice with the true n.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    centroids = jnp.asarray(centroids, jnp.float32)
+    n = x.shape[0]
+    pad = (-n) % TILE_N
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    out = _assign_padded(x, centroids, interpret=interpret)
+    return out[:n, 0]
+
+
+def pallas_supported() -> bool:
+    """True when the default backend can run compiled pallas kernels."""
+    return jax.default_backend() == "tpu"

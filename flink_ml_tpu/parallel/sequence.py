@@ -1,0 +1,184 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no sequence models (SURVEY.md §5: no attention anywhere);
+this module is the TPU-native long-context capability the framework adds so
+sequence workloads scale the same way the rest of the framework does —
+shard_map over a mesh axis with XLA collectives over ICI.
+
+Two standard strategies (cf. the public ring-attention / DeepSpeed-Ulysses
+literature):
+
+- :func:`ring_attention` — shard the sequence over the ``seq`` axis; K/V
+  blocks rotate around the ring via ``ppermute`` while each shard folds one
+  block per step into an online-softmax accumulator (numerically exact, at
+  no point does any device hold the full sequence). Memory per device is
+  O(L/P); supports causal masking via global position offsets.
+- :func:`ulysses_attention` — ``all_to_all`` re-shards from
+  sequence-parallel to head-parallel, runs full attention on H/P heads
+  locally, and re-shards back. One collective pair instead of P ppermutes;
+  requires heads % axis_size == 0.
+
+Both are drop-in jnp functions for use inside ``shard_map`` bodies; tests
+validate exactness against single-device full attention on the virtual
+8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+SEQ_AXIS = "seq"
+
+
+def _block_scores(q, k_blk, scale, mask):
+    """(H, L, M) attention scores of local q against one K block."""
+    scores = jnp.einsum("lhd,mhd->hlm", q, k_blk) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    return scores
+
+
+def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False):
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Args: q, k, v — per-shard blocks of shape (L_local, H, Dh).
+    Returns the per-shard output block (L_local, H, Dh).
+
+    Per step: fold the resident K/V block into an online-softmax state
+    (running max m, denominator l, weighted sum o), then rotate K/V one hop
+    around the ring (``ppermute``) — compute and communication overlap
+    naturally under XLA async collectives.
+    """
+    axis_size = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    l_local, num_heads, d_head = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d_head, q.dtype))
+
+    q_pos = my_idx * l_local + jnp.arange(l_local)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def fold(i, m, l, o, k_blk, v_blk):
+        """Fold one resident K/V block into the online-softmax state."""
+        # the resident block originated at shard (my_idx - i) mod P
+        src = (my_idx - i) % axis_size
+        mask = None
+        if causal:
+            k_pos = src * l_local + jnp.arange(l_local)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, :, :]
+        scores = _block_scores(q, k_blk, scale, mask)           # (H, L, M)
+
+        blk_max = jnp.max(scores, axis=-1)                      # (H, L)
+        m_new = jnp.maximum(m, blk_max)
+        # guard fully-masked rows (m_new == -inf)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        correction = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+        probs = jnp.exp(scores - m_safe[:, :, None])
+        probs = jnp.where(jnp.isfinite(scores), probs, 0.0)
+        l_new = l * correction + jnp.sum(probs, axis=-1)
+        o_new = (o * correction[:, :, None]
+                 + jnp.einsum("hlm,mhd->hld", probs, v_blk))
+        return m_new, l_new, o_new
+
+    def step(i, state):
+        m, l, o, k_blk, v_blk = state
+        m, l, o = fold(i, m, l, o, k_blk, v_blk)
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return m, l, o, k_next, v_next
+
+    m0 = jnp.full((num_heads, l_local), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((num_heads, l_local), q.dtype)
+    o0 = jnp.zeros((num_heads, l_local, d_head), q.dtype)
+    # rotate P-1 times; the last resident block folds outside the loop so
+    # no discarded final ppermute pair is issued
+    m, l, o, k_last, v_last = jax.lax.fori_loop(
+        0, axis_size - 1, step, (m0, l0, o0, k, v))
+    m, l, o = fold(axis_size - 1, m, l, o, k_last, v_last)
+    out = o / jnp.maximum(l, 1e-30)[:, :, None]
+    return jnp.transpose(out, (1, 0, 2))  # back to (L, H, Dh)
+
+
+def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
+                      causal: bool = False):
+    """Sequence→head re-sharding attention (DeepSpeed-Ulysses pattern).
+
+    Args: q, k, v — per-shard (L_local, H, Dh) with H divisible by the axis
+    size. all_to_all gathers the full sequence while scattering heads, runs
+    dense attention on H/P heads, then re-shards back to sequence parallel.
+    """
+    axis_size = jax.lax.axis_size(axis_name)
+
+    def to_head_parallel(x):
+        # (L_local, H, Dh) → (L_global, H/P, Dh)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0,
+                                  tiled=True)
+
+    def to_seq_parallel(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = (to_head_parallel(t) for t in (q, k, v))
+    l_global = qh.shape[0]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(qh.shape[-1], q.dtype))
+    scores = jnp.einsum("lhd,mhd->hlm", qh, kh) * scale
+    if causal:
+        pos = jnp.arange(l_global)
+        scores = jnp.where(pos[None, :, None] >= pos[None, None, :],
+                           scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("hlm,mhd->lhd", probs, vh)
+    return to_seq_parallel(out)
+
+
+def full_attention(q, k, v, causal: bool = False):
+    """Single-device reference implementation (test oracle): (L, H, Dh)."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    scores = jnp.einsum("lhd,mhd->hlm", q, k) * scale
+    if causal:
+        pos = jnp.arange(q.shape[0])
+        scores = jnp.where(pos[None, :, None] >= pos[None, None, :],
+                           scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("hlm,mhd->lhd", probs, v)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_sharded_attention(mesh: Mesh, kind: str, causal: bool,
+                             axis_name: str):
+    fn = ring_attention if kind == "ring" else ulysses_attention
+
+    def per_shard(q, k, v):
+        return fn(q, k, v, axis_name=axis_name, causal=causal)
+
+    spec = P(axis_name, None, None)
+    return jax.jit(jax.shard_map(per_shard, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_vma=False))
+
+
+def sharded_attention(mesh: Mesh, q, k, v, kind: str = "ring",
+                      causal: bool = False, axis_name: str = None):
+    """Host-level entry: q/k/v are global (L, H, Dh) arrays; the sequence
+    dim is sharded over the mesh's sequence axis and attention runs with
+    the chosen strategy."""
+    if axis_name is None:
+        axis_name = (SEQ_AXIS if SEQ_AXIS in mesh.axis_names
+                     else mesh.axis_names[0])
+    if kind not in ("ring", "ulysses"):
+        raise ValueError(f"unknown attention kind {kind!r}")
+    axis_size = mesh.shape[axis_name]
+    if q.shape[0] % axis_size:
+        raise ValueError(
+            f"sequence length {q.shape[0]} must be divisible by the "
+            f"{axis_name!r} axis size {axis_size} (pad the sequence)")
+    if kind == "ulysses" and q.shape[1] % axis_size:
+        raise ValueError(
+            f"ulysses attention needs heads ({q.shape[1]}) divisible by the "
+            f"{axis_name!r} axis size {axis_size}; use kind='ring' instead")
+    program = _build_sharded_attention(mesh, kind, causal, axis_name)
+    return program(q, k, v)

@@ -1,0 +1,25 @@
+"""Pallas kernel tests (interpreter mode on the CPU mesh)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from flink_ml_tpu.ops.pallas_kernels import assign_nearest
+
+
+def test_assign_nearest_matches_xla(rng):
+    x = rng.normal(size=(300, 16)).astype(np.float32)
+    c = rng.normal(size=(7, 16)).astype(np.float32)
+    got = np.asarray(assign_nearest(x, c, interpret=True))
+    d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+    want = d2.argmin(1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_assign_nearest_exact_tile_boundary(rng):
+    from flink_ml_tpu.ops.pallas_kernels import TILE_N
+    x = rng.normal(size=(TILE_N, 4)).astype(np.float32)
+    c = rng.normal(size=(3, 4)).astype(np.float32)
+    got = np.asarray(assign_nearest(x, c, interpret=True))
+    want = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1).argmin(1)
+    np.testing.assert_array_equal(got, want)
