@@ -108,6 +108,11 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
         out_specs=(P(), P()), check_vma=False))
 
 
+# set on the first pallas lowering failure so later transforms skip straight
+# to the XLA path instead of re-tracing the kernel to the same exception
+_pallas_assign_broken = False
+
+
 class KMeansModel(Model, KMeansModelParams):
     def __init__(self, centroids: Optional[np.ndarray] = None,
                  weights: Optional[np.ndarray] = None, **kwargs):
@@ -123,11 +128,17 @@ class KMeansModel(Model, KMeansModelParams):
             assign_nearest,
             pallas_supported,
         )
-        if self.distance_measure == "euclidean" and pallas_supported():
-            # fused distance+argmin pallas kernel: no (n, k) matrix in HBM
-            labels = np.asarray(assign_nearest(
-                x, np.asarray(self.centroids, np.float32)))
-        else:
+        global _pallas_assign_broken
+        labels = None
+        if (self.distance_measure == "euclidean" and pallas_supported()
+                and not _pallas_assign_broken):
+            try:
+                # fused distance+argmin pallas kernel: no (n, k) in HBM
+                labels = np.asarray(assign_nearest(
+                    x, np.asarray(self.centroids, np.float32)))
+            except Exception:
+                _pallas_assign_broken = True  # lowering failed; use XLA
+        if labels is None:
             assign = _build_assign_program(self.distance_measure)
             labels = np.asarray(assign(
                 jnp.asarray(x), jnp.asarray(self.centroids, jnp.float32)))

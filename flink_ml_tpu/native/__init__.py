@@ -66,9 +66,11 @@ def _build() -> Optional[ctypes.CDLL]:
         return lib
     except (OSError, subprocess.CalledProcessError):
         # a concurrent builder may have published a valid library even if
-        # our own attempt failed — prefer loading it over giving up
+        # our own attempt failed — but never load a library older than the
+        # source (a stale kernel is worse than the Python fallback)
         try:
-            if os.path.exists(_LIB):
+            if (os.path.exists(_LIB)
+                    and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
                 return ctypes.CDLL(_LIB)
         except OSError:
             pass

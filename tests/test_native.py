@@ -59,7 +59,9 @@ def test_native_matches_python_oracle(rng):
             assert si == pytest.approx(sj, rel=1e-12)
 
 
+@needs_gcc
 def test_swing_transform_uses_native(rng):
+    assert native.available()
     table = make_purchases(rng)
     out = Swing(min_user_behavior=2, k=4).transform(table)[0]
     assert out.num_rows > 0
