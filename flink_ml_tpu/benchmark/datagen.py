@@ -162,10 +162,22 @@ class RandomStringArrayGenerator(InputTableGenerator, HasNumDistinctValues,
 
 @_register
 class DoubleGenerator(InputTableGenerator):
+    """arity 0 → uniform [0,1) doubles; arity > 0 → random integers in
+    [0, arity) as doubles (ref: DoubleGenerator.java:37-66)."""
+
+    ARITY = IntParam("arity", "Arity of generated values.", 0,
+                     ParamValidators.gt_eq(0))
+
     def get_data(self) -> Table:
         rng = self._rng()
-        cols = {name: rng.random(self.num_values, dtype=np.float64)
-                for name in self._col_names()}
+        arity = self.ARITY
+        if arity > 0:
+            cols = {name: rng.integers(
+                        0, arity, self.num_values).astype(np.float64)
+                    for name in self._col_names()}
+        else:
+            cols = {name: rng.random(self.num_values, dtype=np.float64)
+                    for name in self._col_names()}
         return Table.from_columns(**cols)
 
 

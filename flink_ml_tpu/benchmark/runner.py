@@ -67,15 +67,16 @@ def load_config(path: str) -> dict:
 
 def run_benchmark(name: str, spec: dict) -> dict:
     stage = resolve_stage(spec["stage"]["className"])()
-    stage.params_from_json(spec["stage"].get("paramMap", {}))
+    stage.params_from_json(spec["stage"].get("paramMap", {}), strict=True)
 
     gen = resolve_generator(spec["inputData"]["className"])()
-    gen.params_from_json(spec["inputData"].get("paramMap", {}))
+    gen.params_from_json(spec["inputData"].get("paramMap", {}), strict=True)
 
     model_gen = None
     if "modelData" in spec:
         model_gen = resolve_generator(spec["modelData"]["className"])()
-        model_gen.params_from_json(spec["modelData"].get("paramMap", {}))
+        model_gen.params_from_json(spec["modelData"].get("paramMap", {}),
+                                   strict=True)
 
     # datagen is part of the measured job in the reference; keep it inside
     start = time.perf_counter()

@@ -22,6 +22,7 @@ from flink_ml_tpu.params.param import (
     FloatParam,
     IntArrayParam,
     IntParam,
+    ParamValidator,
     ParamValidators,
     VectorParam,
 )
@@ -118,16 +119,38 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol,
                       HasHandleInvalid):
     """Concatenate scalar/vector columns into one vector
     (ref: feature/vectorassembler/). handleInvalid: error (default) raises on
-    NaN, skip drops the row, keep passes NaN through."""
+    NaN, skip drops the row, keep passes NaN through. inputSizes optionally
+    declares the expected width of every input (scalars are width 1); a
+    mismatch raises, except in skip mode where the offending rows are
+    dropped (ref: VectorAssemblerParams.java INPUT_SIZES + sizesValidator,
+    VectorAssembler.java:99-144 checkSize)."""
+
+    INPUT_SIZES = IntArrayParam(
+        "inputSizes", "Sizes of the input elements to be assembled.", None,
+        ParamValidator(
+            lambda sizes: sizes is None
+            or (len(sizes) > 0 and all(s > 0 for s in sizes)),
+            "unset, or a non-empty array of positive sizes"))
 
     def transform(self, table: Table) -> Tuple[Table]:
+        sizes = self.input_sizes
+        if sizes is not None and len(sizes) != len(self.input_cols):
+            raise ValueError("inputSizes must match inputCols length")
         mats = []
-        for name in self.input_cols:
+        for i, name in enumerate(self.input_cols):
             col = table.column(name)
             if col.dtype == object or col.ndim == 2:
                 mats.append(table.vectors(name, np.float64))
             else:
                 mats.append(np.asarray(col, np.float64)[:, None])
+            if sizes is not None and mats[-1].shape[1] != sizes[i]:
+                if self.handle_invalid == self.SKIP_INVALID:
+                    return (table.take(np.arange(0))
+                            .with_column(self.output_col,
+                                         np.zeros((0, sum(sizes)))),)
+                raise ValueError(
+                    f"input column {name!r} has size {mats[-1].shape[1]}, "
+                    f"declared inputSizes[{i}]={sizes[i]}")
         out = np.concatenate(mats, axis=1)
         invalid = np.isnan(out).any(axis=1)
         if invalid.any():

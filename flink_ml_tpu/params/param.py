@@ -411,11 +411,18 @@ class WithParams:
             out[p.name] = p.json_encode(value)
         return out
 
-    def params_from_json(self, data: dict):
+    def params_from_json(self, data: dict, strict: bool = False):
+        """strict=False ignores unknown names (save/load forward compat);
+        strict=True raises like ParamUtils.instantiateWithParams does for
+        undefined parameters (the benchmark CLI contract)."""
         for name, raw in data.items():
             param = self._find_param(name)
             if param is None:
-                continue  # forward/backward compat: ignore unknown params
+                if strict:
+                    raise ValueError(
+                        f"unknown parameter {name!r} for "
+                        f"{type(self).__name__}")
+                continue
             self.set(param, param.json_decode(raw))
         return self
 

@@ -103,6 +103,28 @@ def test_shipped_configs_parse():
             resolve_generator(spec["inputData"]["className"])
 
 
+def test_shipped_configs_execute_scaled_down():
+    """Every shipped workload runs end-to-end (numValues cut to 1000; the
+    demo's two deliberately-broken entries must fail, everything else must
+    succeed — BenchmarkTest.java parity for the full config set)."""
+    import glob
+    import os
+    cfg_dir = os.path.join(os.path.dirname(__file__), "..",
+                           "flink_ml_tpu", "benchmark", "configs")
+    expected_failures = {"Undefined-Parameter", "Unmatch-Input"}
+    for f in sorted(glob.glob(os.path.join(cfg_dir, "*.json"))):
+        config = load_config(f)
+        for spec in config.values():
+            spec["inputData"].setdefault("paramMap", {})["numValues"] = 1000
+        results = run_benchmarks(config)
+        for name, entry in results.items():
+            if name in expected_failures:
+                assert "exception" in entry, (f, name)
+            else:
+                assert "results" in entry, (f, name, entry.get("exception"))
+                assert entry["results"]["inputRecordNum"] == 1000
+
+
 def test_model_benchmark_with_model_data():
     spec = {
         "stage": {"className": "KMeansModel",

@@ -86,6 +86,21 @@ def test_vector_assembler_handle_invalid():
     assert out_keep.num_rows == 2
 
 
+def test_vector_assembler_input_sizes():
+    t = Table.from_columns(
+        s=np.array([1.0, 2.0]),
+        v=np.array([[10.0, 20.0], [30.0, 40.0]]))
+    out = VectorAssembler(input_cols=["s", "v"],
+                          input_sizes=[1, 2]).transform(t)[0]["output"]
+    np.testing.assert_allclose(out, [[1, 10, 20], [2, 30, 40]])
+    with pytest.raises(ValueError):
+        VectorAssembler(input_cols=["s", "v"],
+                        input_sizes=[1, 3]).transform(t)
+    skipped = VectorAssembler(input_cols=["s", "v"], input_sizes=[1, 3],
+                              handle_invalid="skip").transform(t)[0]
+    assert skipped.num_rows == 0
+
+
 def test_vector_slicer():
     t = Table.from_columns(input=np.array([[1.0, 2.0, 3.0, 4.0]]))
     out = VectorSlicer(indices=[3, 1]).transform(t)[0]["output"]
