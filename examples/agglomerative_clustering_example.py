@@ -1,0 +1,27 @@
+"""AgglomerativeClustering (ref: flink-ml-examples AgglomerativeClusteringExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.clustering import AgglomerativeClustering
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = np.concatenate([rng.normal(size=(10, 2)),
+                        rng.normal(size=(10, 2)) + 10])
+    t = Table.from_columns(features=x)
+    out, merges = AgglomerativeClustering(
+        num_clusters=2, compute_full_tree=True).transform(t)
+    print("cluster sizes:", np.bincount(out["prediction"].astype(int)))
+    print("first merge:", merges.take([0])["clusterId1"][0],
+          "+", merges.take([0])["clusterId2"][0])
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""ANOVATest (ref: flink-ml-examples ANOVATest (stats/anovatest))."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.stats import ANOVATest
+
+
+def main():
+    rng = np.random.default_rng(0)
+    label = rng.integers(0, 3, 300).astype(float)
+    informative = label * 2 + rng.normal(size=300) * 0.2
+    noise = rng.normal(size=300)
+    t = Table.from_columns(features=np.stack([informative, noise], axis=1),
+                           label=label)
+    out = ANOVATest(flatten=True).transform(t)[0]
+    for r in range(out.num_rows):
+        print(f"feature {int(out['featureIndex'][r])}: "
+              f"p-value {out['pValue'][r]:.4g}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""arrayToVector UDF (ref: flink-ml-examples ArrayToVectorExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu import array_to_vector
+
+
+def main():
+    col = np.empty(2, dtype=object)
+    col[0] = [1.0, 2.0]
+    col[1] = [3.0, 4.0]
+    t = Table.from_columns(arr=col)
+    out = array_to_vector(t, "arr", "vec")
+    print("vectors:\n", out["vec"])
+    return out
+
+
+if __name__ == "__main__":
+    main()

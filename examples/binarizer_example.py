@@ -1,0 +1,24 @@
+"""Binarizer (ref: flink-ml-examples BinarizerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import Binarizer
+
+
+def main():
+    t = Table.from_columns(f0=np.array([0.1, 0.9, 0.4]),
+                           f1=np.array([[1.0, 2.0], [0.0, 0.2], [3.0, 0.1]]))
+    out = Binarizer(input_cols=["f0", "f1"], output_cols=["b0", "b1"],
+                    thresholds=[0.5, 0.5]).transform(t)[0]
+    for r in range(out.num_rows):
+        print(f"b0: {out['b0'][r]}\tb1: {out['b1'][r]}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

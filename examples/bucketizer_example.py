@@ -1,0 +1,24 @@
+"""Bucketizer (ref: flink-ml-examples BucketizerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import Bucketizer
+
+
+def main():
+    t = Table.from_columns(f0=np.array([-0.5, 0.3, 1.5, 99.0]))
+    out = Bucketizer(input_cols=["f0"], output_cols=["bucket"],
+                     splits_array=[[-1.0, 0.0, 1.0, 2.0]],
+                     handle_invalid="keep").transform(t)[0]
+    for v, b in zip(out["f0"], out["bucket"]):
+        print(f"value: {v}\tbucket: {b}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""ChiSqTest (ref: flink-ml-examples ChiSqTestExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.stats import ChiSqTest
+
+
+def main():
+    rng = np.random.default_rng(0)
+    label = rng.integers(0, 2, 500).astype(float)
+    dependent = label + rng.integers(0, 2, 500) * 0.0   # fully dependent
+    noise = rng.integers(0, 3, 500).astype(float)       # independent
+    t = Table.from_columns(features=np.stack([dependent, noise], axis=1),
+                           label=label)
+    out = ChiSqTest(flatten=True).transform(t)[0]
+    for r in range(out.num_rows):
+        print(f"feature {int(out['featureIndex'][r])}: "
+              f"p-value {out['pValue'][r]:.4g}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

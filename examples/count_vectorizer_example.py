@@ -1,0 +1,26 @@
+"""CountVectorizer (ref: flink-ml-examples CountVectorizerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import CountVectorizer
+
+
+def main():
+    docs = np.array([["a", "b", "c"], ["a", "b", "b", "c", "a"]],
+                    dtype=object)
+    t = Table.from_columns(docs=docs)
+    model = CountVectorizer(input_col="docs", output_col="vec").fit(t)
+    out = model.transform(t)[0]
+    print("vocabulary:", list(model.vocabulary))
+    for d, v in zip(docs, out["vec"]):
+        print(f"doc: {list(d)}\tcounts: {v}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Discrete cosine transform (ref: flink-ml-examples DCTExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import DCT
+
+
+def main():
+    t = Table.from_columns(input=np.array([[1.0, 1.0, 1.0, 1.0],
+                                           [1.0, 0.0, -1.0, 0.0]]))
+    out = DCT().transform(t)[0]
+    for x, y in zip(out["input"], out["output"]):
+        print(f"input: {x}\tdct: {np.round(y, 4)}")
+    inv = DCT(inverse=True).transform(
+        Table.from_columns(input=out["output"]))[0]
+    print("inverse recovers:", np.round(inv["output"], 4))
+    return out
+
+
+if __name__ == "__main__":
+    main()

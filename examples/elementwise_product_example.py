@@ -1,0 +1,25 @@
+"""Elementwise product (ref: flink-ml-examples ElementwiseProductExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.feature import ElementwiseProduct
+
+
+def main():
+    t = Table.from_columns(input=np.array([[1.0, 2.0, 3.0],
+                                           [4.0, 5.0, 6.0]]))
+    out = ElementwiseProduct(
+        scaling_vec=Vectors.dense(2.0, 0.0, -1.0)).transform(t)[0]
+    for x, y in zip(out["input"], out["output"]):
+        print(f"input: {x}\tscaled: {y}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

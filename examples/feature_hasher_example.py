@@ -1,0 +1,25 @@
+"""Feature hasher (ref: flink-ml-examples FeatureHasherExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import FeatureHasher
+
+
+def main():
+    t = Table.from_columns(
+        num=np.array([3.5, 1.0]),
+        cat=np.array(["red", "blue"], dtype=object))
+    out = FeatureHasher(input_cols=["num", "cat"], categorical_cols=["cat"],
+                        num_features=32).transform(t)[0]
+    for v in out["output"]:
+        print("hashed:", v)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""FValueTest (ref: flink-ml-examples FValueTest (stats/fvaluetest))."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.stats import FValueTest
+
+
+def main():
+    rng = np.random.default_rng(0)
+    label = rng.normal(size=300)
+    informative = label * 3 + rng.normal(size=300) * 0.1
+    noise = rng.normal(size=300)
+    t = Table.from_columns(features=np.stack([informative, noise], axis=1),
+                           label=label)
+    out = FValueTest(flatten=True).transform(t)[0]
+    for r in range(out.num_rows):
+        print(f"feature {int(out['featureIndex'][r])}: "
+              f"p-value {out['pValue'][r]:.4g}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

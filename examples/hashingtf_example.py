@@ -1,0 +1,24 @@
+"""HashingTF (ref: flink-ml-examples HashingTFExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import HashingTF
+
+
+def main():
+    docs = np.array([["flink", "ml", "flink"], ["tpu", "native"]],
+                    dtype=object)
+    t = Table.from_columns(input=docs)
+    out = HashingTF(num_features=16).transform(t)[0]
+    for doc, v in zip(docs, out["output"]):
+        print(f"doc: {list(doc)}\ttf: {v}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

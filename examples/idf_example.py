@@ -1,0 +1,25 @@
+"""IDF (ref: flink-ml-examples IDFExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import IDF
+
+
+def main():
+    x = np.array([[1.0, 1.0], [1.0, 0.0], [1.0, 0.0], [1.0, 0.0]])
+    t = Table.from_columns(input=x)
+    model = IDF().fit(t)
+    print("idf:", np.round(model.idf, 4))
+    out = model.transform(t)[0]
+    for a, b in zip(x, out["output"]):
+        print(f"tf: {a}\ttf-idf: {np.round(b, 4)}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

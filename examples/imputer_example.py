@@ -1,0 +1,26 @@
+"""Imputer (ref: flink-ml-examples ImputerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import Imputer
+
+
+def main():
+    t = Table.from_columns(a=np.array([1.0, np.nan, 3.0]),
+                           b=np.array([np.nan, 4.0, 6.0]))
+    model = Imputer(input_cols=["a", "b"],
+                    output_cols=["ai", "bi"]).fit(t)
+    out = model.transform(t)[0]
+    for r in range(out.num_rows):
+        print(f"a: {out['a'][r]} -> {out['ai'][r]}\t"
+              f"b: {out['b'][r]} -> {out['bi'][r]}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""IndexToString (ref: flink-ml-examples IndexToStringModelExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import IndexToString, StringIndexer
+
+
+def main():
+    t = Table.from_columns(c=np.array(["b", "a", "b", "c"], dtype=object))
+    si = StringIndexer(input_cols=["c"], output_cols=["i"],
+                       string_order_type="alphabetAsc").fit(t)
+    indexed = si.transform(t)[0]
+    its = IndexToString(input_cols=["i"], output_cols=["s"])
+    its.set_model_data(*si.get_model_data())
+    out = its.transform(indexed)[0]
+    for i, s in zip(out["i"], out["s"]):
+        print(f"index: {i}\tstring: {s}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

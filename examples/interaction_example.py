@@ -1,0 +1,24 @@
+"""Interaction (ref: flink-ml-examples InteractionExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import Interaction
+
+
+def main():
+    t = Table.from_columns(
+        a=np.array([2.0, 3.0]),
+        b=np.array([[1.0, 10.0], [2.0, 20.0]]))
+    out = Interaction(input_cols=["a", "b"]).transform(t)[0]
+    for r in range(out.num_rows):
+        print(f"a: {out['a'][r]} b: {out['b'][r]} -> {out['output'][r]}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""KBinsDiscretizer (ref: flink-ml-examples KBinsDiscretizerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import KBinsDiscretizer
+
+
+def main():
+    rng = np.random.default_rng(0)
+    t = Table.from_columns(input=rng.normal(size=(20, 2)))
+    model = KBinsDiscretizer(strategy="quantile", num_bins=4).fit(t)
+    out = model.transform(t)[0]
+    for x, b in list(zip(out["input"], out["output"]))[:5]:
+        print(f"value: {np.round(x, 3)}\tbins: {b}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""KNN (ref: flink-ml-examples KnnExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.classification import Knn
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = np.concatenate([rng.normal(size=(50, 2)),
+                        rng.normal(size=(50, 2)) + 4])
+    y = np.concatenate([np.zeros(50), np.ones(50)])
+    train = Table.from_columns(features=x, label=y)
+    model = Knn(k=5).fit(train)
+    test = Table.from_columns(features=np.array([[0.0, 0.0], [4.0, 4.0]]))
+    out = model.transform(test)[0]
+    for f, p in zip(out["features"], out["prediction"]):
+        print(f"features: {f}\tprediction: {p}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""LinearSVC (ref: flink-ml-examples LinearSVCExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.classification import LinearSVC
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = np.concatenate([rng.normal(size=(200, 2)),
+                        rng.normal(size=(200, 2)) + 3]).astype(np.float32)
+    y = np.concatenate([np.zeros(200), np.ones(200)]).astype(np.float32)
+    t = Table.from_columns(features=x, label=y)
+    model = LinearSVC(max_iter=50, global_batch_size=400,
+                      learning_rate=0.1, reg=0.01).fit(t)
+    out = model.transform(t)[0]
+    acc = (out["prediction"] == y).mean()
+    print(f"train accuracy: {acc:.3f}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""MaxAbsScaler (ref: flink-ml-examples MaxAbsScalerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import MaxAbsScaler
+
+
+def main():
+    t = Table.from_columns(input=np.array([[1.0, -8.0], [2.0, 4.0]]))
+    model = MaxAbsScaler().fit(t)
+    out = model.transform(t)[0]
+    for x, y in zip(out["input"], out["output"]):
+        print(f"input: {x}\tscaled: {y}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""MinMaxScaler (ref: flink-ml-examples MinMaxScalerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import MinMaxScaler
+
+
+def main():
+    t = Table.from_columns(input=np.array([[0.0, 10.0], [5.0, 20.0],
+                                           [10.0, 30.0]]))
+    model = MinMaxScaler().fit(t)
+    out = model.transform(t)[0]
+    for x, y in zip(out["input"], out["output"]):
+        print(f"input: {x}\tscaled: {y}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""MinHashLSH (ref: flink-ml-examples MinHashLSHExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.feature import MinHashLSH
+
+
+def main():
+    col = np.empty(3, dtype=object)
+    col[0] = Vectors.sparse(10, [0, 1, 2], [1, 1, 1])
+    col[1] = Vectors.sparse(10, [0, 1, 3], [1, 1, 1])
+    col[2] = Vectors.sparse(10, [7, 8, 9], [1, 1, 1])
+    t = Table.from_columns(id=np.arange(3.0), vec=col)
+    model = MinHashLSH(input_col="vec", output_col="hashes",
+                       num_hash_tables=4, seed=11).fit(t)
+
+    key = Vectors.sparse(10, [0, 1, 2], [1, 1, 1])
+    nn = model.approx_nearest_neighbors(t, key, k=2)
+    print("nearest ids:", nn["id"], "distances:", nn["distCol"])
+
+    joined = model.approx_similarity_join(t, t, 0.6, "id")
+    print("similar pairs:", list(zip(joined["idA"], joined["idB"])))
+    return nn
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""NaiveBayes (ref: flink-ml-examples NaiveBayesExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.classification import NaiveBayes
+
+
+def main():
+    x = np.array([[0.0, 0.0], [0.0, 1.0], [1.0, 0.0], [1.0, 1.0],
+                  [2.0, 2.0], [2.0, 3.0], [3.0, 2.0], [3.0, 3.0]])
+    y = np.array([0.0, 0, 0, 0, 1, 1, 1, 1])
+    t = Table.from_columns(features=x, label=y)
+    model = NaiveBayes(smoothing=1.0).fit(t)
+    out = model.transform(t)[0]
+    acc = (out["prediction"] == y).mean()
+    print(f"train accuracy: {acc:.3f}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""NGram (ref: flink-ml-examples NGramExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import NGram
+
+
+def main():
+    t = Table.from_columns(input=np.array(
+        [["an", "example", "sentence", "here"], ["too", "short"]],
+        dtype=object))
+    out = NGram(n=3).transform(t)[0]
+    for tokens, grams in zip(out["input"], out["output"]):
+        print(f"tokens: {list(tokens)}\t3-grams: {list(grams)}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Normalizer (ref: flink-ml-examples NormalizerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import Normalizer
+
+
+def main():
+    t = Table.from_columns(input=np.array([[3.0, 4.0], [1.0, -1.0]]))
+    out = Normalizer(p=2.0).transform(t)[0]
+    for x, y in zip(out["input"], out["output"]):
+        print(f"input: {x}\tl2-normalized: {y}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""OneHotEncoder (ref: flink-ml-examples OneHotEncoderExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import OneHotEncoder
+
+
+def main():
+    t = Table.from_columns(c=np.array([0.0, 1.0, 2.0, 1.0]))
+    model = OneHotEncoder(input_cols=["c"], output_cols=["v"]).fit(t)
+    out = model.transform(t)[0]
+    for c, v in zip(out["c"], out["v"]):
+        print(f"category: {c}\tencoded: {v}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""OnlineKMeans (ref: flink-ml-examples OnlineKMeansExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.iteration.streaming import StreamTable
+from flink_ml_tpu.models.clustering import KMeansModel, OnlineKMeans
+
+
+def main():
+    rng = np.random.default_rng(0)
+    init = KMeansModel(centroids=np.array([[0.0, 0.0], [1.0, 1.0]]),
+                       weights=np.array([1.0, 1.0]))
+
+    def batches():
+        for _ in range(10):
+            yield Table.from_columns(features=np.concatenate(
+                [rng.normal(size=(50, 2)) - 5,
+                 rng.normal(size=(50, 2)) + 5]))
+
+    est = (OnlineKMeans(global_batch_size=100, decay_factor=0.5, k=2)
+           .set_initial_model_data(init.get_model_data()[0]))
+    model = est.fit(StreamTable(batches()))
+    print("final centroids:\n", np.round(model.centroids, 2))
+    return model
+
+
+if __name__ == "__main__":
+    main()

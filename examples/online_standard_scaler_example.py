@@ -1,0 +1,27 @@
+"""OnlineStandardScaler (ref: flink-ml-examples OnlineStandardScalerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.common.window import CountTumblingWindows
+from flink_ml_tpu.models.feature import OnlineStandardScaler
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1000, 2)) * [2, 7] + [1, -3]
+    t = Table.from_columns(input=x)
+    model = OnlineStandardScaler(
+        windows=CountTumblingWindows.of(250), with_mean=True).fit(t)
+    print("model versions produced:", model.model_version + 1)
+    out = model.transform(t)[0]
+    print("output std ~1:", np.round(out["output"].std(axis=0), 3))
+    return out
+
+
+if __name__ == "__main__":
+    main()

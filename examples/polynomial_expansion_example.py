@@ -1,0 +1,22 @@
+"""Polynomial expansion (ref: flink-ml-examples PolynomialExpansionExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import PolynomialExpansion
+
+
+def main():
+    t = Table.from_columns(input=np.array([[2.0, 1.0]]))
+    out = PolynomialExpansion(degree=2).transform(t)[0]
+    print("input:", out["input"][0])
+    print("expanded:", out["output"][0])
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""RandomSplitter (ref: flink-ml-examples RandomSplitterExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import RandomSplitter
+
+
+def main():
+    t = Table.from_columns(f0=np.arange(100.0))
+    train, test = RandomSplitter(weights=[8.0, 2.0], seed=4).transform(t)
+    print(f"train rows: {train.num_rows}  test rows: {test.num_rows}")
+    return train
+
+
+if __name__ == "__main__":
+    main()

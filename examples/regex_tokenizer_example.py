@@ -1,0 +1,22 @@
+"""RegexTokenizer (ref: flink-ml-examples RegexTokenizerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import RegexTokenizer
+
+
+def main():
+    t = Table.from_columns(input=np.array(["a,b,,c", "X;;Y"], dtype=object))
+    out = RegexTokenizer(pattern="[,;]", min_token_length=1).transform(t)[0]
+    for s, tok in zip(out["input"], out["output"]):
+        print(f"text: {s!r}\ttokens: {list(tok)}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

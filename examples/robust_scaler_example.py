@@ -1,0 +1,24 @@
+"""RobustScaler (ref: flink-ml-examples RobustScalerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import RobustScaler
+
+
+def main():
+    rng = np.random.default_rng(1)
+    x = np.concatenate([rng.normal(size=(50, 2)), [[100.0, -100.0]]])
+    model = RobustScaler(with_centering=True).fit(
+        Table.from_columns(input=x))
+    out = model.transform(Table.from_columns(input=x))[0]
+    print("scaled medians ~0:", np.round(np.median(out["output"], axis=0), 3))
+    return out
+
+
+if __name__ == "__main__":
+    main()

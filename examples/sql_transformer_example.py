@@ -1,0 +1,23 @@
+"""SQLTransformer (ref: flink-ml-examples SQLTransformerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import SQLTransformer
+
+
+def main():
+    t = Table.from_columns(v1=np.array([0.0, 2.0]), v2=np.array([1.0, 4.0]))
+    out = SQLTransformer(
+        statement="SELECT *, (v1 + v2) AS v3 FROM __THIS__").transform(t)[0]
+    for r in range(out.num_rows):
+        print(f"v1: {out['v1'][r]} v2: {out['v2'][r]} v3: {out['v3'][r]}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

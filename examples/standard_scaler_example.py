@@ -1,0 +1,23 @@
+"""StandardScaler (ref: flink-ml-examples StandardScalerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import StandardScaler
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(100, 3)) * [1, 5, 10] + [0, 2, -4]
+    model = StandardScaler(with_mean=True).fit(Table.from_columns(input=x))
+    out = model.transform(Table.from_columns(input=x))[0]
+    print("output std ~1:", np.round(out["output"].std(axis=0), 3))
+    return out
+
+
+if __name__ == "__main__":
+    main()

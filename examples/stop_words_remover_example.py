@@ -1,0 +1,25 @@
+"""StopWordsRemover (ref: flink-ml-examples StopWordsRemoverExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import StopWordsRemover
+
+
+def main():
+    t = Table.from_columns(tokens=np.array(
+        [["i", "saw", "the", "red", "balloon"],
+         ["mary", "had", "a", "little", "lamb"]], dtype=object))
+    out = StopWordsRemover(input_cols=["tokens"],
+                           output_cols=["filtered"]).transform(t)[0]
+    for a, b in zip(out["tokens"], out["filtered"]):
+        print(f"tokens: {list(a)}\tfiltered: {list(b)}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

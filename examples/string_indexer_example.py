@@ -1,0 +1,24 @@
+"""StringIndexer (ref: flink-ml-examples StringIndexerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import StringIndexer
+
+
+def main():
+    t = Table.from_columns(c=np.array(["b", "a", "b", "c"], dtype=object))
+    model = StringIndexer(input_cols=["c"], output_cols=["idx"],
+                          string_order_type="frequencyDesc").fit(t)
+    out = model.transform(t)[0]
+    for s, i in zip(out["c"], out["idx"]):
+        print(f"string: {s}\tindex: {i}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

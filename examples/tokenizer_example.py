@@ -1,0 +1,23 @@
+"""Tokenizer (ref: flink-ml-examples TokenizerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import Tokenizer
+
+
+def main():
+    t = Table.from_columns(input=np.array(
+        ["Build ML on TPUs", "Functional and compiled"], dtype=object))
+    out = Tokenizer().transform(t)[0]
+    for s, tok in zip(out["input"], out["output"]):
+        print(f"text: {s!r}\ttokens: {list(tok)}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

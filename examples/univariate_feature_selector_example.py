@@ -1,0 +1,27 @@
+"""UnivariateFeatureSelector (ref: flink-ml-examples UnivariateFeatureSelectorExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import UnivariateFeatureSelector
+
+
+def main():
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, 300).astype(float)
+    x = rng.normal(size=(300, 4))
+    x[:, 0] += y * 5   # only feature 0 is informative
+    t = Table.from_columns(features=x, label=y)
+    model = UnivariateFeatureSelector(
+        feature_type="continuous", label_type="categorical",
+        selection_mode="numTopFeatures", selection_threshold=1).fit(t)
+    print("selected feature indices:", list(model.indices))
+    return model.transform(t)[0]
+
+
+if __name__ == "__main__":
+    main()

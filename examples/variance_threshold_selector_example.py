@@ -1,0 +1,23 @@
+"""VarianceThresholdSelector (ref: flink-ml-examples VarianceThresholdSelectorExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import VarianceThresholdSelector
+
+
+def main():
+    x = np.array([[1.0, 7.0, 0.0], [2.0, 7.0, 0.0], [3.0, 7.0, 0.0]])
+    t = Table.from_columns(input=x)
+    model = VarianceThresholdSelector(variance_threshold=0.5).fit(t)
+    out = model.transform(t)[0]
+    print("kept columns:", out["output"])
+    return out
+
+
+if __name__ == "__main__":
+    main()

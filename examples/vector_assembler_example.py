@@ -1,0 +1,27 @@
+"""VectorAssembler (ref: flink-ml-examples VectorAssemblerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import VectorAssembler
+
+
+def main():
+    t = Table.from_columns(
+        hour=np.array([18.0, 19.0]),
+        mobile=np.array([1.0, 0.0]),
+        userFeatures=np.array([[0.0, 10.0, 0.5], [0.2, 5.0, 0.1]]))
+    out = VectorAssembler(
+        input_cols=["hour", "mobile", "userFeatures"],
+        input_sizes=[1, 1, 3], output_col="features").transform(t)[0]
+    for v in out["features"]:
+        print("assembled:", v)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""VectorIndexer (ref: flink-ml-examples VectorIndexerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import VectorIndexer
+
+
+def main():
+    x = np.array([[1.0, 10.5], [1.0, 20.0], [3.0, 30.0], [3.0, 40.0]])
+    t = Table.from_columns(input=x)
+    model = VectorIndexer(max_categories=3).fit(t)
+    out = model.transform(t)[0]
+    for a, b in zip(out["input"], out["output"]):
+        print(f"input: {a}\tindexed: {b}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

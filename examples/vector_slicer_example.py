@@ -1,0 +1,23 @@
+"""VectorSlicer (ref: flink-ml-examples VectorSlicerExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu.models.feature import VectorSlicer
+
+
+def main():
+    t = Table.from_columns(input=np.array([[1.0, 2.0, 3.0, 4.0],
+                                           [5.0, 6.0, 7.0, 8.0]]))
+    out = VectorSlicer(indices=[3, 1]).transform(t)[0]
+    for x, y in zip(out["input"], out["output"]):
+        print(f"vector: {x}\tsliced: {y}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

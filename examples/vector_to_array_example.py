@@ -1,0 +1,22 @@
+"""vectorToArray UDF (ref: flink-ml-examples VectorToArrayExample.java)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from flink_ml_tpu import Table
+
+from flink_ml_tpu import vector_to_array
+
+
+def main():
+    t = Table.from_columns(vec=np.array([[1.0, 2.0], [3.0, 4.0]]))
+    out = vector_to_array(t, "vec", "arr")
+    for a in out["arr"]:
+        print("array:", a, type(a).__name__)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -34,3 +34,7 @@ from flink_ml_tpu.api import (  # noqa: F401
     Transformer,
 )
 from flink_ml_tpu.common.table import Table  # noqa: F401
+from flink_ml_tpu.common.functions import (  # noqa: F401
+    array_to_vector,
+    vector_to_array,
+)

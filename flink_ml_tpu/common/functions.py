@@ -1,0 +1,44 @@
+"""Table-level vector conversion functions.
+
+Ref parity: flink-ml-lib Functions.java:39-71 — the ``vectorToArray`` /
+``arrayToVector`` Table UDFs. Ours operate on a whole column at once (one
+vectorized call instead of a per-row UDF) and return a new Table.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.common.table import Table
+
+__all__ = ["vector_to_array", "array_to_vector"]
+
+
+def vector_to_array(table: Table, input_col: str,
+                    output_col: str) -> Table:
+    """Convert a vector column (dense matrix or dense/sparse Vector objects)
+    into a column of plain Python float lists (ref: Functions.java:41
+    vectorToArray)."""
+    mat = table.vectors(input_col, np.float64)
+    col = np.empty(mat.shape[0], dtype=object)
+    for i in range(mat.shape[0]):
+        col[i] = mat[i].tolist()
+    return table.with_column(output_col, col)
+
+
+def array_to_vector(table: Table, input_col: str,
+                    output_col: str) -> Table:
+    """Convert a column of numeric arrays/lists into a dense vector column
+    (ref: Functions.java:71 arrayToVector). Uniform-length rows become one
+    dense matrix; ragged rows become per-row DenseVectors, matching the
+    reference's per-row UDF which allows differing sizes."""
+    rows = [np.asarray(v, dtype=np.float64).reshape(-1)
+            for v in table.column(input_col)]
+    if rows and all(r.shape == rows[0].shape for r in rows):
+        return table.with_column(output_col, np.stack(rows))
+    from flink_ml_tpu.linalg import Vectors
+
+    col = np.empty(len(rows), dtype=object)
+    for i, r in enumerate(rows):
+        col[i] = Vectors.dense(*r)
+    return table.with_column(output_col, col)

@@ -51,6 +51,8 @@ from flink_ml_tpu.models.feature.selectors import (  # noqa: F401
     VarianceThresholdSelectorModel,
 )
 from flink_ml_tpu.models.feature.misc import (  # noqa: F401
+    Imputer,
+    ImputerModel,
     MinHashLSH,
     MinHashLSHModel,
     RandomSplitter,

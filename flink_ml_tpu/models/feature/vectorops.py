@@ -132,25 +132,52 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol,
             or (len(sizes) > 0 and all(s > 0 for s in sizes)),
             "unset, or a non-empty array of positive sizes"))
 
+    @staticmethod
+    def _row_size(value) -> int:
+        return np.asarray(value, np.float64).reshape(-1).shape[0]
+
     def transform(self, table: Table) -> Tuple[Table]:
         sizes = self.input_sizes
         if sizes is not None and len(sizes) != len(self.input_cols):
             raise ValueError("inputSizes must match inputCols length")
+        if sizes is not None:
+            # Per-row size check BEFORE stacking, so ragged object columns
+            # are skipped/reported row-by-row like checkSize in the
+            # reference rather than crashing inside np.stack.
+            bad = np.zeros(table.num_rows, dtype=bool)
+            first_mismatch = None
+            for i, name in enumerate(self.input_cols):
+                col = table.column(name)
+                if col.dtype == object:
+                    row_sizes = np.fromiter(
+                        (self._row_size(v) for v in col), dtype=np.int64,
+                        count=len(col))
+                elif col.ndim == 2:
+                    row_sizes = np.full(len(col), col.shape[1])
+                else:
+                    row_sizes = np.ones(len(col), dtype=np.int64)
+                mismatch = row_sizes != sizes[i]
+                if mismatch.any() and first_mismatch is None:
+                    r = int(np.nonzero(mismatch)[0][0])
+                    first_mismatch = (name, i, int(row_sizes[r]))
+                bad |= mismatch
+            if bad.any():
+                if self.handle_invalid != self.SKIP_INVALID:
+                    name, i, got = first_mismatch
+                    raise ValueError(
+                        f"input column {name!r} has size {got}, "
+                        f"declared inputSizes[{i}]={sizes[i]}")
+                table = table.take(np.nonzero(~bad)[0])
+                if table.num_rows == 0:
+                    return (table.with_column(
+                        self.output_col, np.zeros((0, sum(sizes)))),)
         mats = []
-        for i, name in enumerate(self.input_cols):
+        for name in self.input_cols:
             col = table.column(name)
             if col.dtype == object or col.ndim == 2:
                 mats.append(table.vectors(name, np.float64))
             else:
                 mats.append(np.asarray(col, np.float64)[:, None])
-            if sizes is not None and mats[-1].shape[1] != sizes[i]:
-                if self.handle_invalid == self.SKIP_INVALID:
-                    return (table.take(np.arange(0))
-                            .with_column(self.output_col,
-                                         np.zeros((0, sum(sizes)))),)
-                raise ValueError(
-                    f"input column {name!r} has size {mats[-1].shape[1]}, "
-                    f"declared inputSizes[{i}]={sizes[i]}")
         out = np.concatenate(mats, axis=1)
         invalid = np.isnan(out).any(axis=1)
         if invalid.any():

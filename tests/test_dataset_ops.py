@@ -127,3 +127,35 @@ def test_profile_context():
     with profile():
         sum(range(1000))
     assert metrics.group("ml").get_gauge("lastProfiledRegionMs") >= 0
+
+
+def test_vector_udfs_roundtrip():
+    """Functions.java:39-71 parity: vectorToArray / arrayToVector."""
+    import numpy as np
+
+    from flink_ml_tpu import Table, array_to_vector, vector_to_array
+    from flink_ml_tpu.linalg import Vectors
+
+    t = Table.from_columns(vec=np.array([[1.0, 2.0], [3.0, 4.0]]))
+    arrs = vector_to_array(t, "vec", "arr")
+    assert arrs["arr"][0] == [1.0, 2.0]
+    back = array_to_vector(arrs, "arr", "vec2")
+    np.testing.assert_array_equal(back["vec2"], t["vec"])
+
+    # sparse vectors densify through the same path
+    col = np.empty(1, dtype=object)
+    col[0] = Vectors.sparse(4, [1, 3], [5.0, 7.0])
+    sp = Table.from_columns(vec=col)
+    assert vector_to_array(sp, "vec", "arr")["arr"][0] == [0.0, 5.0, 0.0, 7.0]
+
+
+def test_array_to_vector_ragged():
+    import numpy as np
+
+    from flink_ml_tpu import Table, array_to_vector
+
+    col = np.empty(2, dtype=object)
+    col[0] = [1.0, 2.0]
+    col[1] = [3.0, 4.0, 5.0]
+    out = array_to_vector(Table.from_columns(arr=col), "arr", "vec")
+    assert out["vec"][0].size == 2 and out["vec"][1].size == 3

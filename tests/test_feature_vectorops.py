@@ -138,3 +138,20 @@ def test_bucketizer():
     out2 = Bucketizer(input_cols=["x"], output_cols=["b"],
                       splits_array=[[0.0, 1.0, 2.0]]).transform(t2)[0]["b"]
     np.testing.assert_allclose(out2, [1])
+
+
+def test_vector_assembler_ragged_object_column():
+    """inputSizes + ragged per-row vectors: skip drops only bad rows,
+    error raises the informative message (checkSize parity)."""
+    col = np.empty(3, dtype=object)
+    col[0] = [1.0, 2.0]
+    col[1] = [3.0, 4.0, 5.0]   # wrong size
+    col[2] = [6.0, 7.0]
+    t = Table.from_columns(v=col, s=np.array([10.0, 20.0, 30.0]))
+    out = VectorAssembler(input_cols=["v", "s"], input_sizes=[2, 1],
+                          handle_invalid="skip").transform(t)[0]
+    assert out.num_rows == 2
+    np.testing.assert_allclose(out["output"], [[1, 2, 10], [6, 7, 30]])
+    with pytest.raises(ValueError, match="declared inputSizes"):
+        VectorAssembler(input_cols=["v", "s"],
+                        input_sizes=[2, 1]).transform(t)
