@@ -69,10 +69,22 @@ def iterate_bounded(initial_carry: Carry,
     exactly like the reference's in-loop data cache.
     """
     config = config or IterationConfig()
-    if config.mode == "device" and not listeners and config.checkpoint_interval == 0 \
-            and config.per_round_init is None:
+    if not needs_host_loop(config, listeners):
         return _device_loop(initial_carry, body, max_iter, terminate)
     return _host_loop(initial_carry, body, max_iter, terminate, config, listeners)
+
+
+def needs_host_loop(config: Optional[IterationConfig],
+                    listeners: Sequence[IterationListener] = ()) -> bool:
+    """True when any configured behavior requires host-driven rounds.
+    The single source of truth for the device/host dispatch — algorithm fast
+    paths (SGD, KMeans) must consult this instead of re-deriving it."""
+    if config is None:
+        return bool(listeners)
+    return bool(listeners) or config.mode == "host" \
+        or config.checkpoint_interval != 0 \
+        or config.checkpoint_manager is not None \
+        or config.per_round_init is not None
 
 
 def _device_loop(initial_carry, body, max_iter, terminate):

@@ -43,6 +43,7 @@ from flink_ml_tpu.params.shared import (
     HasPredictionCol,
     HasSeed,
 )
+from flink_ml_tpu.models.common import IterationRuntimeMixin
 from flink_ml_tpu.utils import io as rw
 
 
@@ -71,11 +72,34 @@ def _build_assign_program(measure_name: str):
     return assign
 
 
+def _lloyd_round_math(measure):
+    """The per-shard math of ONE Lloyd round — shared verbatim by the
+    all-device while_loop program and the host-driven round program so the
+    two modes stay numerically identical by construction. Must be called
+    inside shard_map over DATA_AXIS."""
+
+    def round_step(xl, vl, centroids):
+        k = centroids.shape[0]
+        dists = measure.pairwise(xl, centroids)
+        one_hot = jax.nn.one_hot(jnp.argmin(dists, axis=1), k,
+                                 dtype=xl.dtype) * vl[:, None]
+        packed = jnp.concatenate(
+            [one_hot.T @ xl, jnp.sum(one_hot, axis=0)[:, None]], axis=1)
+        packed = jax.lax.psum(packed, DATA_AXIS)
+        sums, counts = packed[:, :-1], packed[:, -1]
+        new_centroids = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1),
+            centroids)
+        return new_centroids, counts
+
+    return round_step
+
+
 @functools.lru_cache(maxsize=32)
 def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
     """One compiled Lloyd's program per (mesh, measure, maxIter); k and
     shapes are trace-time static, handled by jit's shape cache."""
-    measure = DistanceMeasure.get_instance(measure_name)
+    round_step = _lloyd_round_math(DistanceMeasure.get_instance(measure_name))
 
     def per_shard(xl, vl, c0):
         k = c0.shape[0]
@@ -86,17 +110,8 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
 
         def step(state):
             centroids, _, epoch = state
-            dists = measure.pairwise(xl, centroids)
-            one_hot = jax.nn.one_hot(jnp.argmin(dists, axis=1), k,
-                                     dtype=xl.dtype) * vl[:, None]
-            packed = jnp.concatenate(
-                [one_hot.T @ xl, jnp.sum(one_hot, axis=0)[:, None]], axis=1)
-            packed = jax.lax.psum(packed, DATA_AXIS)
-            sums, counts = packed[:, :-1], packed[:, -1]
-            new_centroids = jnp.where(
-                counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1),
-                centroids)
-            return new_centroids, counts, epoch + 1
+            centroids, counts = round_step(xl, vl, centroids)
+            return centroids, counts, epoch + 1
 
         centroids, counts, _ = jax.lax.while_loop(
             cond, step, (c0, jnp.zeros((k,), xl.dtype), jnp.int32(0)))
@@ -106,6 +121,17 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
         per_shard, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
         out_specs=(P(), P()), check_vma=False))
+
+
+@functools.lru_cache(maxsize=32)
+def _build_lloyd_round_program(mesh, measure_name: str):
+    """ONE Lloyd round — the building block of the checkpointable host loop;
+    wraps the same _lloyd_round_math as the all-device program."""
+    round_step = _lloyd_round_math(DistanceMeasure.get_instance(measure_name))
+    return jax.shard_map(
+        round_step, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        out_specs=(P(), P()), check_vma=False)
 
 
 # set on the first pallas lowering failure so later transforms skip straight
@@ -168,7 +194,7 @@ class KMeansModel(Model, KMeansModelParams):
         self.centroids, self.weights = arrays["centroids"], arrays["weights"]
 
 
-class KMeans(Estimator, KMeansParams):
+class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
     def fit(self, table: Table) -> KMeansModel:
         x = table.vectors(self.features_col)
         n, dim = x.shape
@@ -186,8 +212,30 @@ class KMeans(Estimator, KMeansParams):
         valid[:n] = 1.0  # padded rows must not join any cluster
         vs, _ = shard_batch(mesh, valid)
 
-        fit = _build_lloyd_program(mesh, self.distance_measure, self.max_iter)
-        centroids, counts = fit(xs, vs, jnp.asarray(init))
+        from flink_ml_tpu.iteration.iteration import (iterate_bounded,
+                                                      needs_host_loop)
+        if not needs_host_loop(self._iteration_config,
+                               self._iteration_listeners):
+            fit = _build_lloyd_program(mesh, self.distance_measure,
+                                       self.max_iter)
+            centroids, counts = fit(xs, vs, jnp.asarray(init))
+        else:
+
+            round_fn = _build_lloyd_round_program(mesh,
+                                                  self.distance_measure)
+
+            def body(carry, epoch):
+                centroids, _ = carry
+                return round_fn(xs, vs, centroids)
+
+            from jax.sharding import NamedSharding
+            repl = NamedSharding(mesh, P())
+            centroids, counts = iterate_bounded(
+                (jax.device_put(jnp.asarray(init), repl),
+                 jax.device_put(jnp.zeros((k,), jnp.float32), repl)),
+                body, max_iter=self.max_iter,
+                config=self._iteration_config,
+                listeners=self._iteration_listeners)
 
         model = KMeansModel(centroids=np.asarray(centroids, np.float64),
                             weights=np.asarray(counts, np.float64))

@@ -102,7 +102,24 @@ class LinearModelBase(Model, LinearTrainParams):
         self.coefficients = rw.load_model_arrays(path, "model")["coefficient"]
 
 
-class LinearEstimatorBase(Estimator, LinearTrainParams):
+class IterationRuntimeMixin:
+    """Runtime (non-Param) iteration knobs shared by iterative estimators:
+    host-mode rounds, listeners and mid-fit checkpoint/resume. Ref: in the
+    reference these are Flink runtime settings (checkpoint interval, restart
+    strategy) configured on the environment, not stage params — hence not
+    part of the JSON param map here either."""
+
+    _iteration_config = None
+    _iteration_listeners = ()
+
+    def set_iteration_config(self, config, listeners=()):
+        self._iteration_config = config
+        self._iteration_listeners = tuple(listeners)
+        return self
+
+
+class LinearEstimatorBase(Estimator, LinearTrainParams,
+                          IterationRuntimeMixin):
     """Shared SGD fit path (ref: LogisticRegression.fit:60 → SGD.optimize)."""
 
     #: subclass hooks
@@ -117,7 +134,10 @@ class LinearEstimatorBase(Estimator, LinearTrainParams):
             max_iter=self.max_iter, tol=self.tol, reg=self.reg,
             elastic_net=self.elastic_net)
         init = np.zeros(x.shape[1], np.float32)
-        coeffs, _ = SGD(params).optimize(self.loss, init, x, y, w)
+        coeffs, _ = SGD(params).optimize(
+            self.loss, init, x, y, w,
+            config=self._iteration_config,
+            listeners=self._iteration_listeners)
         model = self.model_class(coefficients=coeffs)
         return self.copy_params_to(model)
 

@@ -134,6 +134,8 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol,
 
     @staticmethod
     def _row_size(value) -> int:
+        if hasattr(value, "to_array"):  # Dense/SparseVector objects
+            return int(value.size)
         return np.asarray(value, np.float64).reshape(-1).shape[0]
 
     def transform(self, table: Table) -> Tuple[Table]:

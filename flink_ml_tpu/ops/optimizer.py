@@ -56,17 +56,18 @@ class SGDParams:
     elastic_net: float = 0.0
 
 
-@functools.lru_cache(maxsize=128)
-def _build_sgd_program(loss_cls, mesh: Mesh, prm: SGDParams):
-    """One jitted SPMD training program per (loss, mesh, hyperparams).
-    Returning the same callable lets jax.jit's shape cache do its job."""
-    loss_func = loss_cls()
-    p = int(mesh.shape[DATA_AXIS])
+def _sgd_round_math(loss_func, prm: SGDParams, p: int):
+    """The per-shard math of ONE training round — shared verbatim by the
+    all-device while_loop program and the host-driven round program so the
+    two modes stay numerically identical by construction.
+
+    Returns ``round(xl, yl, wl, coeffs, offset) ->
+    (coeffs, new_offset, mean_loss)`` operating on this shard's slice;
+    must be called inside shard_map over DATA_AXIS."""
     gb = prm.global_batch_size
     lb_base, lb_rem = gb // p, gb % p
-    max_iter = prm.max_iter
 
-    def per_shard(xl, yl, wl, w0):
+    def round_step(xl, yl, wl, coeffs, offset):
         local_n = xl.shape[0]  # static at trace time
         lb_max = min(lb_base + (1 if lb_rem else 0), local_n)
         task_id = jax.lax.axis_index(DATA_AXIS)
@@ -74,52 +75,88 @@ def _build_sgd_program(loss_cls, mesh: Mesh, prm: SGDParams):
         lb = jnp.minimum(lb_base + (task_id < lb_rem).astype(jnp.int32),
                          local_n)
 
+        # minibatch slice with clip-at-end + wrap-to-zero
+        rel = jnp.arange(lb_max)
+        idx = offset + rel
+        valid = jnp.logical_and(rel < lb, idx < local_n)
+        idx = jnp.where(valid, idx, 0)
+        xb = jnp.where(valid[:, None], xl[idx], 0)
+        yb = yl[idx]
+        wb = wl[idx] * valid.astype(xl.dtype)
+
+        loss_sum, grad_sum = loss_func.loss_and_gradient(coeffs, xb, yb, wb)
+        # one fused all-reduce over [grad, weight, loss] (the
+        # reference's feedbackArray layout, SGD.java:190)
+        packed = jnp.concatenate([
+            grad_sum, jnp.sum(wb)[None].astype(grad_sum.dtype),
+            loss_sum[None]])
+        packed = jax.lax.psum(packed, DATA_AXIS)
+        grad, total_w, total_loss = packed[:-2], packed[-2], packed[-1]
+
+        # ref updateModel (SGD.java:231-243); skip when no weight
+        updated = coeffs - (prm.learning_rate
+                            / jnp.maximum(total_w, 1e-30)) * grad
+        updated, _ = regularize(updated, prm.reg, prm.elastic_net,
+                                prm.learning_rate)
+        coeffs = jnp.where(total_w > 0, updated, coeffs)
+
+        new_offset = jnp.where(offset + lb >= local_n, 0, offset + lb)
+        mean_loss = total_loss / jnp.maximum(total_w, 1e-30)
+        return coeffs, new_offset, mean_loss
+
+    return round_step
+
+
+@functools.lru_cache(maxsize=128)
+def _build_sgd_program(loss_cls, mesh: Mesh, prm: SGDParams):
+    """One jitted SPMD training program per (loss, mesh, hyperparams).
+    Returning the same callable lets jax.jit's shape cache do its job."""
+    p = int(mesh.shape[DATA_AXIS])
+    round_step = _sgd_round_math(loss_cls(), prm, p)
+    max_iter = prm.max_iter
+
+    def per_shard(xl, yl, wl, w0):
         def cond(state):
-            _, _, _, _, epoch, stop = state
+            _, _, _, epoch, stop = state
             return jnp.logical_and(epoch < max_iter, jnp.logical_not(stop))
 
         def step(state):
-            coeffs, offset, _, _, epoch, _ = state
-            # minibatch slice with clip-at-end + wrap-to-zero
-            rel = jnp.arange(lb_max)
-            idx = offset + rel
-            valid = jnp.logical_and(rel < lb, idx < local_n)
-            idx = jnp.where(valid, idx, 0)
-            xb = jnp.where(valid[:, None], xl[idx], 0)
-            yb = yl[idx]
-            wb = wl[idx] * valid.astype(xl.dtype)
-
-            loss_sum, grad_sum = loss_func.loss_and_gradient(
-                coeffs, xb, yb, wb)
-            # one fused all-reduce over [grad, weight, loss] (the
-            # reference's feedbackArray layout, SGD.java:190)
-            packed = jnp.concatenate([
-                grad_sum, jnp.sum(wb)[None].astype(grad_sum.dtype),
-                loss_sum[None]])
-            packed = jax.lax.psum(packed, DATA_AXIS)
-            grad, total_w, total_loss = packed[:-2], packed[-2], packed[-1]
-
-            # ref updateModel (SGD.java:231-243); skip when no weight
-            updated = coeffs - (prm.learning_rate
-                                / jnp.maximum(total_w, 1e-30)) * grad
-            updated, _ = regularize(updated, prm.reg, prm.elastic_net,
-                                    prm.learning_rate)
-            coeffs = jnp.where(total_w > 0, updated, coeffs)
-
-            new_offset = jnp.where(offset + lb >= local_n, 0, offset + lb)
-            mean_loss = total_loss / jnp.maximum(total_w, 1e-30)
+            coeffs, offset, _, epoch, _ = state
+            coeffs, new_offset, mean_loss = round_step(xl, yl, wl, coeffs,
+                                                       offset)
             stop = mean_loss < prm.tol
-            return coeffs, new_offset, mean_loss, total_w, epoch + 1, stop
+            return coeffs, new_offset, mean_loss, epoch + 1, stop
 
         init = (w0, jnp.int32(0), jnp.asarray(jnp.inf, w0.dtype),
-                jnp.asarray(0.0, w0.dtype), jnp.int32(0), jnp.asarray(False))
-        coeffs, _, mean_loss, _, _, _ = jax.lax.while_loop(cond, step, init)
+                jnp.int32(0), jnp.asarray(False))
+        coeffs, _, mean_loss, _, _ = jax.lax.while_loop(cond, step, init)
         return coeffs, mean_loss
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=(P(), P()), check_vma=False))
+
+
+@functools.lru_cache(maxsize=128)
+def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams):
+    """ONE training round as a shard_mapped callable — the building block of
+    the checkpointable host loop. Wraps the same _sgd_round_math as the
+    all-device program, so device and host modes are numerically identical
+    by construction."""
+    p = int(mesh.shape[DATA_AXIS])
+    round_step = _sgd_round_math(loss_cls(), prm, p)
+
+    def per_shard(xl, yl, wl, coeffs, offsets):
+        coeffs, new_offset, mean_loss = round_step(xl, yl, wl, coeffs,
+                                                   offsets[0])
+        return coeffs, new_offset[None], mean_loss
+
+    return jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(),
+                  P(DATA_AXIS)),
+        out_specs=(P(), P(DATA_AXIS), P()), check_vma=False)
 
 
 class SGD:
@@ -132,8 +169,15 @@ class SGD:
                  features: np.ndarray, labels: np.ndarray,
                  weights: Optional[np.ndarray] = None,
                  mesh: Optional[Mesh] = None,
-                 dtype=jnp.float32):
-        """Returns (coeffs (d,) np.ndarray, final mean loss float)."""
+                 dtype=jnp.float32,
+                 config=None, listeners=()):
+        """Returns (coeffs (d,) np.ndarray, final mean loss float).
+
+        With ``config``/``listeners`` (an ``IterationConfig`` needing host
+        hooks — checkpointing, per-round callbacks), training runs as host-
+        driven rounds through ``iterate_bounded``: resumable mid-fit from a
+        checkpoint with results identical to the all-device program (the
+        fault-injection bar of BoundedAllRoundCheckpointITCase)."""
         mesh = mesh or default_mesh()
         n = features.shape[0]
         if weights is None:
@@ -143,6 +187,40 @@ class SGD:
         ys, _ = shard_batch(mesh, np.asarray(labels, np.float32))
         ws, _ = shard_batch(mesh, np.asarray(weights, np.float32))
 
-        fit = _build_sgd_program(type(loss_func), mesh, self.params)
-        coeffs, mean_loss = fit(xs, ys, ws, jnp.asarray(init_coeffs, dtype))
+        from flink_ml_tpu.iteration.iteration import needs_host_loop
+        if not needs_host_loop(config, listeners):
+            fit = _build_sgd_program(type(loss_func), mesh, self.params)
+            coeffs, mean_loss = fit(xs, ys, ws,
+                                    jnp.asarray(init_coeffs, dtype))
+            return np.asarray(coeffs, np.float64), float(mean_loss)
+
+        from flink_ml_tpu.iteration.iteration import iterate_bounded
+
+        round_fn = _build_sgd_round_program(type(loss_func), mesh,
+                                            self.params)
+        p = int(mesh.shape[DATA_AXIS])
+
+        def body(carry, epoch):
+            coeffs, offsets, _ = carry
+            coeffs, offsets, mean_loss = round_fn(xs, ys, ws, coeffs,
+                                                  offsets)
+            return coeffs, offsets, mean_loss
+
+        # carry leaves must live on the full mesh (replicated coeffs/loss,
+        # per-task offsets) — both for the shard_mapped round and so that
+        # checkpoint restore re-places leaves onto the right shardings.
+        from jax.sharding import NamedSharding
+        init = (
+            jax.device_put(jnp.asarray(init_coeffs, dtype),
+                           NamedSharding(mesh, P())),
+            jax.device_put(jnp.zeros((p,), jnp.int32),
+                           NamedSharding(mesh, P(DATA_AXIS))),
+            jax.device_put(jnp.asarray(jnp.inf, dtype),
+                           NamedSharding(mesh, P())),
+        )
+        final = iterate_bounded(
+            init, body, max_iter=self.params.max_iter,
+            terminate=lambda carry, epoch: carry[2] < self.params.tol,
+            config=config, listeners=listeners)
+        coeffs, _, mean_loss = final
         return np.asarray(coeffs, np.float64), float(mean_loss)

@@ -1,0 +1,103 @@
+"""Algorithm-level fault injection (ref: flink-ml-tests
+BoundedAllRoundCheckpointITCase — FailingMap kills the job mid-iteration,
+the restarted job must produce exactly-correct results from the latest
+checkpoint)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+from flink_ml_tpu.iteration.iteration import IterationConfig, IterationListener
+from flink_ml_tpu.models.classification import LogisticRegression
+from flink_ml_tpu.models.clustering import KMeans
+
+
+class _Crash(Exception):
+    pass
+
+
+class _CrashAt(IterationListener):
+    """The FailingMap analog: dies when a given round completes."""
+
+    def __init__(self, at):
+        self.at = at
+
+    def on_epoch_watermark_incremented(self, epoch, carry):
+        if epoch == self.at:
+            raise _Crash()
+
+
+@pytest.fixture
+def lr_data(rng):
+    x = np.concatenate([rng.normal(size=(300, 5)),
+                        rng.normal(size=(300, 5)) + 2]).astype(np.float32)
+    y = np.concatenate([np.zeros(300), np.ones(300)]).astype(np.float32)
+    return Table.from_columns(features=x, label=y)
+
+
+def _lr(**kw):
+    return LogisticRegression(max_iter=12, global_batch_size=200,
+                              learning_rate=0.1, **kw)
+
+
+def test_lr_host_mode_matches_device_mode(lr_data):
+    expected = _lr().fit(lr_data).coefficients
+    host = (_lr().set_iteration_config(IterationConfig(mode="host"))
+            .fit(lr_data).coefficients)
+    np.testing.assert_allclose(host, expected, rtol=1e-6)
+
+
+def test_lr_crash_resume_identical_result(lr_data, tmp_path):
+    expected = _lr().fit(lr_data).coefficients
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=2,
+                          checkpoint_manager=mgr)
+    with pytest.raises(_Crash):
+        (_lr().set_iteration_config(cfg, listeners=[_CrashAt(7)])
+         .fit(lr_data))
+    assert mgr.list_checkpoints()  # something was snapshotted pre-crash
+
+    resumed = _lr().set_iteration_config(cfg).fit(lr_data).coefficients
+    np.testing.assert_allclose(resumed, expected, rtol=1e-6)
+
+
+def test_kmeans_crash_resume_identical_result(rng, tmp_path):
+    x = np.concatenate([rng.normal(size=(100, 3)),
+                        rng.normal(size=(100, 3)) + 6]).astype(np.float32)
+    t = Table.from_columns(features=x)
+
+    expected = KMeans(k=2, seed=7, max_iter=8).fit(t).centroids
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=2,
+                          checkpoint_manager=mgr)
+    with pytest.raises(_Crash):
+        (KMeans(k=2, seed=7, max_iter=8)
+         .set_iteration_config(cfg, listeners=[_CrashAt(5)]).fit(t))
+
+    resumed = (KMeans(k=2, seed=7, max_iter=8)
+               .set_iteration_config(cfg).fit(t).centroids)
+    np.testing.assert_allclose(resumed, expected, rtol=1e-6)
+
+
+def test_lr_tol_termination_parity(lr_data):
+    """Early tol stop must fire identically in host and device mode."""
+    expected = _lr(tol=0.5).fit(lr_data).coefficients
+    host = (_lr(tol=0.5).set_iteration_config(IterationConfig(mode="host"))
+            .fit(lr_data).coefficients)
+    np.testing.assert_allclose(host, expected, rtol=1e-6)
+
+
+def test_assembler_input_sizes_sparse_vectors():
+    """Regression: _row_size must handle SparseVector objects."""
+    from flink_ml_tpu.linalg import Vectors
+    from flink_ml_tpu.models.feature import VectorAssembler
+
+    col = np.empty(2, dtype=object)
+    col[0] = Vectors.sparse(3, [0], [1.0])
+    col[1] = Vectors.sparse(3, [1, 2], [2.0, 3.0])
+    t = Table.from_columns(v=col)
+    out = VectorAssembler(input_cols=["v"], input_sizes=[3]).transform(t)[0]
+    np.testing.assert_allclose(out["output"], [[1, 0, 0], [0, 2, 3]])
