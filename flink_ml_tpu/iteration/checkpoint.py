@@ -51,6 +51,12 @@ class CheckpointManager:
         self._gc()
         return ckpt_dir
 
+    def clear(self) -> None:
+        """Discard all checkpoints (called when an iteration completes)."""
+        for name in self.list_checkpoints():
+            shutil.rmtree(os.path.join(self.base_dir, name),
+                          ignore_errors=True)
+
     def _gc(self) -> None:
         ckpts = self.list_checkpoints()
         for stale in ckpts[:-self.keep]:

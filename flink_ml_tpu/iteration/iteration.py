@@ -44,6 +44,12 @@ class IterationConfig:
     #: host mode: reset part of the carry each round (PER_ROUND lifecycle).
     per_round_init: Optional[Callable[[Carry, int], Carry]] = None
 
+    def __post_init__(self):
+        if self.mode not in ("device", "host"):
+            raise ValueError(
+                f"IterationConfig.mode must be 'device' or 'host', "
+                f"got {self.mode!r}")
+
 
 class IterationListener:
     """Ref: iteration/IterationListener.java."""
@@ -151,6 +157,12 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners):
             break
     for lst in listeners:
         lst.on_iteration_terminated(carry)
+    if mgr is not None:
+        # The iteration completed: discard its checkpoints so a later run
+        # against the same manager starts fresh instead of restoring this
+        # run's final state (the reference likewise discards checkpoints on
+        # job success). A crash skips this, leaving the resume point intact.
+        mgr.clear()
     return carry
 
 

@@ -82,6 +82,28 @@ def test_kmeans_crash_resume_identical_result(rng, tmp_path):
     np.testing.assert_allclose(resumed, expected, rtol=1e-6)
 
 
+def test_completed_fit_clears_checkpoints(lr_data, tmp_path):
+    """A successful fit must not leave a checkpoint behind: refitting with
+    the same manager has to train from scratch, not restore the old run's
+    final state (the reference discards checkpoints on job success)."""
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=2,
+                          checkpoint_manager=mgr)
+    first = _lr().set_iteration_config(cfg).fit(lr_data).coefficients
+    assert not mgr.list_checkpoints()
+
+    flipped = Table.from_columns(features=lr_data["features"],
+                                 label=1.0 - lr_data["label"])
+    second = _lr().set_iteration_config(cfg).fit(flipped).coefficients
+    assert not np.allclose(first, second)
+    np.testing.assert_allclose(second, -first, rtol=1e-5)
+
+
+def test_invalid_iteration_mode_rejected():
+    with pytest.raises(ValueError, match="mode"):
+        IterationConfig(mode="Host")
+
+
 def test_lr_tol_termination_parity(lr_data):
     """Early tol stop must fire identically in host and device mode."""
     expected = _lr(tol=0.5).fit(lr_data).coefficients
