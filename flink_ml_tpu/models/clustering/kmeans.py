@@ -34,7 +34,7 @@ from flink_ml_tpu.common.table import Table, as_dense_vector_column
 from flink_ml_tpu.linalg.distance import DistanceMeasure
 from flink_ml_tpu.linalg.vectors import DenseVector
 from flink_ml_tpu.parallel.collective import shard_batch
-from flink_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from flink_ml_tpu.parallel.mesh import data_axes, data_pspec, default_mesh
 from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam
 from flink_ml_tpu.params.shared import (
     HasDistanceMeasure,
@@ -72,11 +72,11 @@ def _build_assign_program(measure_name: str):
     return assign
 
 
-def _lloyd_round_math(measure):
+def _lloyd_round_math(measure, axes):
     """The per-shard math of ONE Lloyd round — shared verbatim by the
     all-device while_loop program and the host-driven round program so the
     two modes stay numerically identical by construction. Must be called
-    inside shard_map over DATA_AXIS."""
+    inside shard_map over the mesh's data axes (flat or dcn-hybrid)."""
 
     def round_step(xl, vl, centroids):
         k = centroids.shape[0]
@@ -85,7 +85,7 @@ def _lloyd_round_math(measure):
                                  dtype=xl.dtype) * vl[:, None]
         packed = jnp.concatenate(
             [one_hot.T @ xl, jnp.sum(one_hot, axis=0)[:, None]], axis=1)
-        packed = jax.lax.psum(packed, DATA_AXIS)
+        packed = jax.lax.psum(packed, axes)
         sums, counts = packed[:, :-1], packed[:, -1]
         new_centroids = jnp.where(
             counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1),
@@ -99,7 +99,10 @@ def _lloyd_round_math(measure):
 def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
     """One compiled Lloyd's program per (mesh, measure, maxIter); k and
     shapes are trace-time static, handled by jit's shape cache."""
-    round_step = _lloyd_round_math(DistanceMeasure.get_instance(measure_name))
+    axes = data_axes(mesh)
+    spec0 = data_pspec(mesh)
+    round_step = _lloyd_round_math(
+        DistanceMeasure.get_instance(measure_name), axes)
 
     def per_shard(xl, vl, c0):
         k = c0.shape[0]
@@ -119,7 +122,7 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        in_specs=(P(spec0, None), P(spec0), P()),
         out_specs=(P(), P()), check_vma=False))
 
 
@@ -127,10 +130,13 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
 def _build_lloyd_round_program(mesh, measure_name: str):
     """ONE Lloyd round — the building block of the checkpointable host loop;
     wraps the same _lloyd_round_math as the all-device program."""
-    round_step = _lloyd_round_math(DistanceMeasure.get_instance(measure_name))
+    axes = data_axes(mesh)
+    spec0 = data_pspec(mesh)
+    round_step = _lloyd_round_math(
+        DistanceMeasure.get_instance(measure_name), axes)
     return jax.shard_map(
         round_step, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        in_specs=(P(spec0, None), P(spec0), P()),
         out_specs=(P(), P()), check_vma=False)
 
 
@@ -207,10 +213,11 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
             init = np.resize(init, (k, init.shape[1]))
 
         mesh = default_mesh()
-        xs, _ = shard_batch(mesh, np.asarray(x, np.float32))
+        axes = data_axes(mesh)
+        xs, _ = shard_batch(mesh, np.asarray(x, np.float32), axes)
         valid = np.zeros(xs.shape[0], np.float32)
         valid[:n] = 1.0  # padded rows must not join any cluster
-        vs, _ = shard_batch(mesh, valid)
+        vs, _ = shard_batch(mesh, valid, axes)
 
         from flink_ml_tpu.iteration.iteration import (iterate_bounded,
                                                       needs_host_loop)

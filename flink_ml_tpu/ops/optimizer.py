@@ -41,7 +41,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from flink_ml_tpu.ops.losses import LossFunc
 from flink_ml_tpu.ops.regularization import regularize
-from flink_ml_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from flink_ml_tpu.parallel.mesh import (
+    data_axes,
+    data_pspec,
+    data_shard_count,
+    default_mesh,
+)
 from flink_ml_tpu.parallel.collective import shard_batch
 
 
@@ -56,21 +61,22 @@ class SGDParams:
     elastic_net: float = 0.0
 
 
-def _sgd_round_math(loss_func, prm: SGDParams, p: int):
+def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes):
     """The per-shard math of ONE training round — shared verbatim by the
     all-device while_loop program and the host-driven round program so the
     two modes stay numerically identical by construction.
 
     Returns ``round(xl, yl, wl, coeffs, offset) ->
     (coeffs, new_offset, mean_loss)`` operating on this shard's slice;
-    must be called inside shard_map over DATA_AXIS."""
+    must be called inside shard_map over the mesh's data axes (``axes`` —
+    a flat ("data",) mesh or a ("dcn", "data") hybrid)."""
     gb = prm.global_batch_size
     lb_base, lb_rem = gb // p, gb % p
 
     def round_step(xl, yl, wl, coeffs, offset):
         local_n = xl.shape[0]  # static at trace time
         lb_max = min(lb_base + (1 if lb_rem else 0), local_n)
-        task_id = jax.lax.axis_index(DATA_AXIS)
+        task_id = jax.lax.axis_index(axes)
         # ref SGD.java:206-213 — low task ids take the remainder
         lb = jnp.minimum(lb_base + (task_id < lb_rem).astype(jnp.int32),
                          local_n)
@@ -90,7 +96,7 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int):
         packed = jnp.concatenate([
             grad_sum, jnp.sum(wb)[None].astype(grad_sum.dtype),
             loss_sum[None]])
-        packed = jax.lax.psum(packed, DATA_AXIS)
+        packed = jax.lax.psum(packed, axes)
         grad, total_w, total_loss = packed[:-2], packed[-2], packed[-1]
 
         # ref updateModel (SGD.java:231-243); skip when no weight
@@ -111,8 +117,10 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int):
 def _build_sgd_program(loss_cls, mesh: Mesh, prm: SGDParams):
     """One jitted SPMD training program per (loss, mesh, hyperparams).
     Returning the same callable lets jax.jit's shape cache do its job."""
-    p = int(mesh.shape[DATA_AXIS])
-    round_step = _sgd_round_math(loss_cls(), prm, p)
+    axes = data_axes(mesh)
+    spec0 = data_pspec(mesh)
+    p = data_shard_count(mesh)
+    round_step = _sgd_round_math(loss_cls(), prm, p, axes)
     max_iter = prm.max_iter
 
     def per_shard(xl, yl, wl, w0):
@@ -134,7 +142,7 @@ def _build_sgd_program(loss_cls, mesh: Mesh, prm: SGDParams):
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P()),
+        in_specs=(P(spec0, None), P(spec0), P(spec0), P()),
         out_specs=(P(), P()), check_vma=False))
 
 
@@ -144,8 +152,10 @@ def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams):
     the checkpointable host loop. Wraps the same _sgd_round_math as the
     all-device program, so device and host modes are numerically identical
     by construction."""
-    p = int(mesh.shape[DATA_AXIS])
-    round_step = _sgd_round_math(loss_cls(), prm, p)
+    axes = data_axes(mesh)
+    spec0 = data_pspec(mesh)
+    p = data_shard_count(mesh)
+    round_step = _sgd_round_math(loss_cls(), prm, p, axes)
 
     def per_shard(xl, yl, wl, coeffs, offsets):
         coeffs, new_offset, mean_loss = round_step(xl, yl, wl, coeffs,
@@ -154,9 +164,9 @@ def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams):
 
     return jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(),
-                  P(DATA_AXIS)),
-        out_specs=(P(), P(DATA_AXIS), P()), check_vma=False)
+        in_specs=(P(spec0, None), P(spec0), P(spec0), P(),
+                  P(spec0)),
+        out_specs=(P(), P(spec0), P()), check_vma=False)
 
 
 class SGD:
@@ -183,9 +193,10 @@ class SGD:
         if weights is None:
             weights = np.ones(n, dtype=np.float32)
 
-        xs, _ = shard_batch(mesh, np.asarray(features, np.float32))
-        ys, _ = shard_batch(mesh, np.asarray(labels, np.float32))
-        ws, _ = shard_batch(mesh, np.asarray(weights, np.float32))
+        axes = data_axes(mesh)
+        xs, _ = shard_batch(mesh, np.asarray(features, np.float32), axes)
+        ys, _ = shard_batch(mesh, np.asarray(labels, np.float32), axes)
+        ws, _ = shard_batch(mesh, np.asarray(weights, np.float32), axes)
 
         from flink_ml_tpu.iteration.iteration import needs_host_loop
         if not needs_host_loop(config, listeners):
@@ -198,7 +209,8 @@ class SGD:
 
         round_fn = _build_sgd_round_program(type(loss_func), mesh,
                                             self.params)
-        p = int(mesh.shape[DATA_AXIS])
+        p = data_shard_count(mesh)
+        spec0 = data_pspec(mesh)
 
         def body(carry, epoch):
             coeffs, offsets, _ = carry
@@ -214,7 +226,7 @@ class SGD:
             jax.device_put(jnp.asarray(init_coeffs, dtype),
                            NamedSharding(mesh, P())),
             jax.device_put(jnp.zeros((p,), jnp.int32),
-                           NamedSharding(mesh, P(DATA_AXIS))),
+                           NamedSharding(mesh, P(spec0))),
             jax.device_put(jnp.asarray(jnp.inf, dtype),
                            NamedSharding(mesh, P())),
         )

@@ -8,9 +8,15 @@ replaced by a jax.sharding.Mesh with XLA collectives over ICI/DCN.
 
 from flink_ml_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
+    DCN_AXIS,
     MODEL_AXIS,
+    create_hybrid_mesh,
     create_mesh,
+    data_axes,
+    data_pspec,
+    data_shard_count,
     default_mesh,
+    init_distributed,
     local_device_count,
     set_default_mesh,
 )

@@ -28,8 +28,13 @@ from flink_ml_tpu.parallel.mesh import DATA_AXIS
 
 # -- in-axis collectives (inside shard_map / with named axes) ---------------
 
-def all_reduce_sum(x, axis_name: str = DATA_AXIS):
-    """Sum across the mesh axis (ref: AllReduceImpl.java:54 allReduceSum)."""
+def all_reduce_sum(x, axis_name=DATA_AXIS):
+    """Sum across the mesh axis (ref: AllReduceImpl.java:54 allReduceSum).
+
+    ``axis_name`` may be a tuple of axes — e.g. ``("dcn", "data")`` on a
+    hybrid multi-slice mesh — in which case XLA emits the hierarchical
+    all-reduce (in-slice over ICI, one cross-slice DCN exchange).
+    """
     return jax.lax.psum(x, axis_name)
 
 
@@ -74,13 +79,15 @@ def shard_batch(mesh: Mesh, array, axis_name: str = DATA_AXIS):
     Returns (device_array, original_length).
     """
     array = np.asarray(array)
-    n_shards = mesh.shape[axis_name]
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    n_shards = int(np.prod([mesh.shape[a] for a in axes]))
     n = array.shape[0]
     rem = (-n) % n_shards
     if rem:
         pad = np.zeros((rem,) + array.shape[1:], dtype=array.dtype)
         array = np.concatenate([array, pad], axis=0)
-    spec = P(axis_name, *([None] * (array.ndim - 1)))
+    dim0 = axes[0] if len(axes) == 1 else axes
+    spec = P(dim0, *([None] * (array.ndim - 1)))
     sharding = NamedSharding(mesh, spec)
     return jax.device_put(array, sharding), n
 
