@@ -136,6 +136,9 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners):
                 if terminate is not None else jnp.asarray(False))
         return new_carry, stop
 
+    from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+    iter_group = metrics.group(ML_GROUP, "iteration")
+
     carry = initial_carry
     start_epoch = 0
     mgr = config.checkpoint_manager
@@ -144,7 +147,9 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners):
         if restored is not None:
             carry, start_epoch = restored
 
+    import time as _time
     for epoch in range(start_epoch, max_iter):
+        round_start = _time.perf_counter()
         if config.per_round_init is not None:
             carry = config.per_round_init(carry, epoch)
         carry, stop = round_fn(carry, jnp.int32(epoch))
@@ -153,7 +158,13 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners):
         if mgr is not None and config.checkpoint_interval and \
                 (epoch + 1) % config.checkpoint_interval == 0:
             mgr.save(carry, epoch + 1)
-        if bool(stop):
+        stop = bool(stop)  # host sync point: device round now complete
+        # per-round wall time: the profiling surface the reference lacks
+        # (its per-round wrapper only feeds Flink's LatencyStats)
+        iter_group.gauge("lastRoundMs",
+                         (_time.perf_counter() - round_start) * 1000.0)
+        iter_group.counter("rounds")
+        if stop:
             break
     for lst in listeners:
         lst.on_iteration_terminated(carry)

@@ -51,10 +51,19 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if coordinator_address is None and num_processes is None:
         # rely on cluster auto-detection (TPU metadata, SLURM, ...); if no
         # cluster environment exists this raises, which we treat as
-        # "single process"
+        # "single process" — but log it, since on a real pod a transient
+        # join failure here would otherwise silently degrade this process
+        # to single-host while its peers form the cluster
         try:
             jax.distributed.initialize(**kwargs)
-        except Exception:
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "jax.distributed.initialize auto-detection failed (%s); "
+                "continuing single-process. Pass coordinator_address/"
+                "num_processes/process_id explicitly to force a cluster "
+                "join.", e)
             return False
     else:
         jax.distributed.initialize(
@@ -130,9 +139,12 @@ def data_axes(mesh: Mesh) -> tuple:
     ("data",) mesh and a ("dcn", "data") hybrid mesh with the same total
     device count run the identical SPMD program — the hybrid one simply
     routes the outer reduction leg over DCN."""
-    if DCN_AXIS in mesh.axis_names:
-        return (DCN_AXIS, DATA_AXIS)
-    return (DATA_AXIS,)
+    axes = tuple(a for a in (DCN_AXIS, DATA_AXIS) if a in mesh.axis_names)
+    if not axes:
+        raise ValueError(
+            f"mesh has no data-parallel axis: expected {DATA_AXIS!r} "
+            f"(optionally with {DCN_AXIS!r}) among {mesh.axis_names}")
+    return axes
 
 
 def data_shard_count(mesh: Mesh) -> int:

@@ -152,3 +152,39 @@ def test_graft_entry_single_device():
 def test_graft_entry_dryrun_multichip():
     from __graft_entry__ import dryrun_multichip
     dryrun_multichip(8)
+
+
+def test_visualize_results(tmp_path):
+    """Ref parity: bin/benchmark-results-visualize.py — chart from results."""
+    import json
+
+    from flink_ml_tpu.benchmark import visualize
+
+    results = {
+        "KMeans-1": {"stage": {}, "results": {
+            "totalTimeMs": 100.0, "inputRecordNum": 1000,
+            "inputThroughput": 10000.0, "outputRecordNum": 1000,
+            "outputThroughput": 10000.0}},
+        "Broken-1": {"exception": "ValueError: nope"},
+    }
+    p1 = tmp_path / "r1.json"
+    p1.write_text(json.dumps(results))
+    out = tmp_path / "chart.png"
+    visualize.main([str(p1), str(p1), "--output-file", str(out)])
+    assert out.exists() and out.stat().st_size > 0
+
+
+def test_host_loop_round_metrics():
+    """The host-mode iteration publishes per-round timing gauges."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.common.metrics import metrics
+    from flink_ml_tpu.iteration.iteration import (IterationConfig,
+                                                  iterate_bounded)
+
+    group = metrics.group("ml", "iteration")
+    before = group.get_counter("rounds")
+    iterate_bounded(jnp.float32(0.0), lambda c, e: c + 1.0, max_iter=3,
+                    config=IterationConfig(mode="host"))
+    assert group.get_counter("rounds") == before + 3
+    assert group.get_gauge("lastRoundMs") is not None
