@@ -115,6 +115,10 @@ class Param(Generic[T]):
         self.attr_name = camel_to_snake(name)  # snake_case Python-side name
         self.description = description
         self.validator = validator or ParamValidators.always_true()
+        # canonicalize at declaration time so the default compares equal to
+        # the same value set later (e.g. an int default on a FloatParam)
+        if default_value is not None:
+            default_value = self.coerce(default_value)
         self.validate(default_value, allow_none=True)
         self.default_value = default_value
 
@@ -400,8 +404,23 @@ class WithParams:
         for name, value in self.params_to_json().items():
             param = dst._find_param(name)
             if param is not None:
-                dst.set(param, param.json_decode(value))
+                dst._set_decoded(param, value)
         return dst
+
+    def _set_decoded(self, param: Param, raw) -> None:
+        """Apply one JSON-encoded value. ``null`` is an explicit None value
+        when the param can legally hold None (e.g. modelVersionCol=None
+        disables the version column), otherwise it means "unset" (e.g. a
+        default instance's required inputCols) and is left at the default —
+        the single rule shared by params_from_json and copy_params_to."""
+        if raw is None:
+            try:
+                param.validate(None)
+            except ValueError:
+                return
+            self._param_map[param.name] = None
+            return
+        self.set(param, param.json_decode(raw))
 
     # -- JSON round-trip (ref: ParamUtils + ReadWriteUtils metadata) --------
     def params_to_json(self) -> dict:
@@ -423,7 +442,7 @@ class WithParams:
                         f"unknown parameter {name!r} for "
                         f"{type(self).__name__}")
                 continue
-            self.set(param, param.json_decode(raw))
+            self._set_decoded(param, raw)
         return self
 
     def params_to_json_str(self) -> str:

@@ -1,0 +1,146 @@
+"""Graph / GraphBuilder / GraphModel tests.
+
+Ref parity: flink-ml-core/src/test/.../builder/GraphTest.java +
+GraphBuilderTest scenarios — estimator chains, model-data edges in both
+directions, save/load round-trips, and dependency-failure diagnostics.
+"""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.graph import GraphBuilder, Graph, GraphModel, TableId
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.models.classification import LogisticRegression
+from flink_ml_tpu.models.clustering import KMeans
+from flink_ml_tpu.models.feature import MinMaxScaler, StandardScaler
+
+
+@pytest.fixture
+def data(rng):
+    x = rng.normal(size=(120, 4)) * 3 + 1
+    y = (x @ [1.0, -2.0, 0.5, 1.0] > 0).astype(np.float64)
+    return Table.from_columns(features=x, label=y)
+
+
+def _lr(**kw):
+    return LogisticRegression(features_col="scaled", max_iter=10,
+                              global_batch_size=60, **kw)
+
+
+def test_graph_chain_matches_manual_fit(data):
+    """scaler → LR through a graph == fitting the two stages by hand."""
+    builder = GraphBuilder()
+    src = builder.create_table_id()
+    (scaled,) = builder.add_estimator(
+        StandardScaler(input_col="features", output_col="scaled"), [src])
+    (pred,) = builder.add_estimator(_lr(), [scaled])
+    graph = builder.build_estimator([src], [pred])
+    out = graph.fit(data).transform(data)[0]
+
+    scaler_model = StandardScaler(input_col="features",
+                                  output_col="scaled").fit(data)
+    scaled_t = scaler_model.transform(data)[0]
+    manual = _lr().fit(scaled_t).transform(scaled_t)[0]
+    np.testing.assert_allclose(out["prediction"], manual["prediction"])
+
+
+def test_graph_fan_out(data):
+    """One scaled table feeding two independent downstream estimators."""
+    builder = GraphBuilder()
+    src = builder.create_table_id()
+    (scaled,) = builder.add_estimator(
+        StandardScaler(input_col="features", output_col="scaled"), [src])
+    (pred,) = builder.add_estimator(_lr(), [scaled])
+    (clustered,) = builder.add_estimator(
+        KMeans(k=2, seed=1, max_iter=3, features_col="scaled"), [scaled])
+    model = builder.build_estimator([src], [pred, clustered]).fit(data)
+    out_pred, out_clust = model.transform(data)
+    assert "prediction" in out_pred and "prediction" in out_clust
+
+
+def test_graph_get_model_data_as_output(data):
+    """getModelData exposes the fitted model's data tables as graph outputs
+    (ref: GraphBuilder.getModelDataOnEstimator)."""
+    builder = GraphBuilder()
+    src = builder.create_table_id()
+    km = KMeans(k=2, seed=5, max_iter=3)
+    (pred,) = builder.add_estimator(km, [src])
+    (model_data,) = builder.get_model_data(km)
+    model = builder.build_estimator([src], [pred, model_data]).fit(data)
+    _, md = model.transform(data)
+    assert "centroid" in md and md.num_rows == 2
+
+
+def test_graph_set_model_data_on_model(data):
+    """A model node fed model data from another node's output (ref:
+    setModelDataOnModel): KMeansModel initialized from a fitted KMeans."""
+    fitted = KMeans(k=2, seed=5, max_iter=3).fit(data)
+    (md_table,) = fitted.get_model_data()
+
+    from flink_ml_tpu.models.clustering.kmeans import KMeansModel
+
+    builder = GraphBuilder()
+    src = builder.create_table_id()
+    md = builder.create_table_id()
+    blank = KMeansModel()
+    (pred,) = builder.add_algo_operator(blank, [src])
+    builder.set_model_data_on_model(blank, md)
+    gm = builder.build_model([src, md], [pred])
+    out = gm.transform(data, md_table)[0]
+    np.testing.assert_allclose(out["prediction"],
+                               fitted.transform(data)[0]["prediction"])
+
+
+def test_graph_save_load_round_trip(data, tmp_path):
+    builder = GraphBuilder()
+    src = builder.create_table_id()
+    (scaled,) = builder.add_estimator(
+        StandardScaler(input_col="features", output_col="scaled"), [src])
+    (pred,) = builder.add_estimator(_lr(), [scaled])
+    graph = builder.build_estimator([src], [pred])
+
+    graph.save(str(tmp_path / "graph"))
+    reloaded = Graph.load(str(tmp_path / "graph"))
+    out = reloaded.fit(data).transform(data)[0]
+    expected = graph.fit(data).transform(data)[0]
+    np.testing.assert_allclose(out["prediction"], expected["prediction"])
+
+
+def test_graph_model_save_load_round_trip(data, tmp_path):
+    builder = GraphBuilder()
+    src = builder.create_table_id()
+    (scaled,) = builder.add_estimator(
+        MinMaxScaler(input_col="features", output_col="scaled"), [src])
+    (pred,) = builder.add_estimator(_lr(), [scaled])
+    model = builder.build_estimator([src], [pred]).fit(data)
+    expected = model.transform(data)[0]
+
+    model.save(str(tmp_path / "gm"))
+    reloaded = GraphModel.load(str(tmp_path / "gm"))
+    out = reloaded.transform(data)[0]
+    np.testing.assert_allclose(out["prediction"], expected["prediction"])
+
+
+def test_graph_unsatisfiable_dependency(data):
+    """A node consuming a TableId nobody produces must fail with a
+    diagnostic, not hang (ref: GraphExecutionHelper ready-queue)."""
+    builder = GraphBuilder()
+    src = builder.create_table_id()
+    orphan = builder.create_table_id()  # never produced, never an input
+    (pred,) = builder.add_estimator(
+        LogisticRegression(max_iter=2, global_batch_size=60), [orphan])
+    graph = builder.build_estimator([src], [pred])
+    with pytest.raises(ValueError, match="unsatisfiable"):
+        graph.fit(data)
+
+
+def test_set_model_data_on_unknown_estimator():
+    builder = GraphBuilder()
+    with pytest.raises(ValueError, match="not found"):
+        builder.set_model_data_on_estimator(_lr(), TableId(0))
+
+
+def test_table_ids_are_unique():
+    builder = GraphBuilder()
+    ids = {builder.create_table_id() for _ in range(100)}
+    assert len(ids) == 100
