@@ -407,16 +407,20 @@ class WithParams:
                 dst._set_decoded(param, value)
         return dst
 
-    def _set_decoded(self, param: Param, raw) -> None:
+    def _set_decoded(self, param: Param, raw, strict: bool = False) -> None:
         """Apply one JSON-encoded value. ``null`` is an explicit None value
         when the param can legally hold None (e.g. modelVersionCol=None
         disables the version column), otherwise it means "unset" (e.g. a
         default instance's required inputCols) and is left at the default —
-        the single rule shared by params_from_json and copy_params_to."""
+        the single rule shared by params_from_json and copy_params_to.
+        Under ``strict`` (the benchmark CLI contract) a null that the param
+        cannot hold is a config error and raises."""
         if raw is None:
             try:
                 param.validate(None)
             except ValueError:
+                if strict:
+                    raise
                 return
             self._param_map[param.name] = None
             return
@@ -442,7 +446,7 @@ class WithParams:
                         f"unknown parameter {name!r} for "
                         f"{type(self).__name__}")
                 continue
-            self._set_decoded(param, raw)
+            self._set_decoded(param, raw, strict=strict)
         return self
 
     def params_to_json_str(self) -> str:

@@ -18,7 +18,10 @@ from flink_ml_tpu.servable.api import (  # noqa: F401
     Row,
     TransformerServable,
 )
-from flink_ml_tpu.servable.builder import PipelineModelServable  # noqa: F401
+from flink_ml_tpu.servable.builder import (  # noqa: F401
+    PipelineModelServable,
+    load_servable,
+)
 from flink_ml_tpu.servable.lr import (  # noqa: F401
     LogisticRegressionModelServable,
 )
