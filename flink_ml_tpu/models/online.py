@@ -112,16 +112,67 @@ class OnlineLogisticRegressionModel(Model,
             self.model_version_col: np.full(len(dots), self.model_version,
                                             np.int64)}),)
 
-    def transform_stream(self, stream: StreamTable):
+    def transform_stream(self, stream: StreamTable, model_stream=None,
+                         timestamp_col: Optional[str] = None):
         """Unbounded predict: each chunk is scored with the latest model
         version available at that point (the reference's model-broadcast
-        join); yields output Tables."""
-        versions = iter(self.history or [(self.model_version,
-                                          self.coefficients)])
+        join); yields output Tables.
+
+        With ``model_stream`` (an iterable of ``(timestamp_ms, version,
+        coefficients)``) and ``timestamp_col`` (event-time column on the
+        data), the bounded model-delay join of the reference applies
+        (HasMaxAllowedModelDelayMs, used by
+        OnlineLogisticRegressionModel.java:67-95): a record with event time
+        ``t`` is held until a model with timestamp ``>= t -
+        maxAllowedModelDelayMs`` has arrived, then scored with the latest
+        model received — data never runs ahead of the model by more than
+        the configured delay. If the model stream ends, remaining chunks
+        are scored with the final model (a bounded fixture's end-of-stream;
+        the reference's unbounded job would instead keep waiting).
+        """
+        if (model_stream is None) != (timestamp_col is None):
+            raise ValueError(
+                "model_stream and timestamp_col must be given together for "
+                "the event-time model-delay join")
+        if model_stream is None:
+            versions = iter(self.history or [(self.model_version,
+                                              self.coefficients)])
+            for chunk in stream:
+                advanced = next(versions, None)
+                if advanced is not None:
+                    self.model_version, self.coefficients = advanced
+                yield self.transform(chunk)[0]
+            return
+
+        max_delay = self.max_allowed_model_delay_ms
+        models = iter(model_stream)
+        model_ts = None
+        pending = None  # one-model peek buffer
+
+        def take(nxt):
+            nonlocal model_ts
+            model_ts, self.model_version, self.coefficients = (
+                nxt[0], nxt[1], np.asarray(nxt[2], np.float64))
+
         for chunk in stream:
-            advanced = next(versions, None)
-            if advanced is not None:
-                self.model_version, self.coefficients = advanced
+            newest_data_ts = int(np.max(chunk.column(timestamp_col)))
+            # 1) every model that has already arrived (ts <= data time) is
+            #    applied — scoring always uses the LATEST arrived model
+            while True:
+                if pending is None:
+                    pending = next(models, None)
+                if pending is None or pending[0] > newest_data_ts:
+                    break
+                take(pending)
+                pending = None
+            # 2) the delay bound: data is held until a model fresh enough
+            #    (ts >= t - maxDelay) exists; pull forward if necessary
+            while (model_ts is None or model_ts < newest_data_ts - max_delay):
+                nxt = pending or next(models, None)
+                pending = None
+                if nxt is None:
+                    break  # stream over: score with what we have
+                take(nxt)
             yield self.transform(chunk)[0]
 
     def set_model_data(self, model_data: Table):

@@ -135,3 +135,61 @@ def test_online_standard_scaler_save_load(rng, tmp_path):
     reloaded = OnlineStandardScalerModel.load(str(tmp_path / "oss"))
     np.testing.assert_array_equal(reloaded.mean, model.mean)
     assert reloaded.model_version == model.model_version
+
+
+def test_online_lr_model_delay_join(rng):
+    """maxAllowedModelDelayMs semantics: a chunk with event time t must be
+    scored by a model of timestamp >= t - maxDelay, so raising the allowed
+    delay lets data run ahead on an older model version."""
+    from flink_ml_tpu.models.online import OnlineLogisticRegressionModel
+
+    x = rng.normal(size=(40, 2))
+    ts = np.arange(40, dtype=np.int64) * 100  # event times 0..3900
+    t = Table.from_columns(features=x, ts=ts)
+    chunks = StreamTable.from_table(t, 10)  # chunk max ts: 900/1900/2900/3900
+
+    w_old, w_new = np.array([1.0, 0.0]), np.array([0.0, 1.0])
+    # models arrive at t=0 (v1, old) and t=2900 (v2, new)
+    model_stream = [(0, 1, w_old), (2900, 2, w_new)]
+
+    model = OnlineLogisticRegressionModel(coefficients=w_old,
+                                          model_version=1)
+    model.set_max_allowed_model_delay_ms(0)
+    outs = list(model.transform_stream(chunks, model_stream, "ts"))
+    # delay 0: chunks ending at 900/1900 need model_ts>=900 → must advance
+    # all the way to v2 (next available with ts>=900 is 2900)
+    assert [int(o["version"][0]) for o in outs] == [2, 2, 2, 2]
+
+    model2 = OnlineLogisticRegressionModel(coefficients=w_old,
+                                           model_version=1)
+    model2.set_max_allowed_model_delay_ms(2000)
+    outs2 = list(model2.transform_stream(
+        StreamTable.from_table(t, 10), iter(model_stream), "ts"))
+    # delay 2000: chunk@900,1900 satisfied by model@0 (v1); chunk@2900
+    # needs >=900 → still v1? 2900-2000=900 > 0 → advance to v2
+    assert [int(o["version"][0]) for o in outs2] == [1, 1, 2, 2]
+
+
+def test_online_lr_delay_join_always_uses_latest_arrived(rng):
+    """A generous delay must not pin scoring to a stale model: models whose
+    timestamps are in the data's past are always applied."""
+    from flink_ml_tpu.models.online import OnlineLogisticRegressionModel
+
+    x = rng.normal(size=(20, 2))
+    ts = 2900 + np.arange(20, dtype=np.int64) * 100
+    t = Table.from_columns(features=x, ts=ts)
+
+    w1, w2 = np.array([1.0, 0.0]), np.array([0.0, 1.0])
+    model = OnlineLogisticRegressionModel(coefficients=w1, model_version=1)
+    model.set_max_allowed_model_delay_ms(5000)
+    outs = list(model.transform_stream(
+        StreamTable.from_table(t, 10), [(0, 1, w1), (100, 2, w2)], "ts"))
+    assert [int(o["version"][0]) for o in outs] == [2, 2]
+
+
+def test_online_lr_delay_join_requires_both_args(rng):
+    from flink_ml_tpu.models.online import OnlineLogisticRegressionModel
+
+    model = OnlineLogisticRegressionModel(coefficients=np.ones(2))
+    with pytest.raises(ValueError, match="together"):
+        list(model.transform_stream(StreamTable([]), model_stream=[]))
