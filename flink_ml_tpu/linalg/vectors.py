@@ -129,6 +129,18 @@ class SparseVector(Vector):
         if self.indices.size > 1 and np.any(np.diff(self.indices) == 0):
             raise ValueError("duplicate indices in SparseVector")
 
+    @classmethod
+    def _unchecked(cls, size: int, indices, values) -> "SparseVector":
+        """Construct from already-sorted, in-range, duplicate-free int64/
+        float64 arrays, skipping validation — the bulk-construction fast
+        path for transformers that build millions of sparse rows from
+        vectorized numpy output (validation dominates their runtime)."""
+        v = object.__new__(cls)
+        v._size = size
+        v.indices = indices
+        v.values = values
+        return v
+
     @property
     def size(self) -> int:
         return self._size

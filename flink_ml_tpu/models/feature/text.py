@@ -177,16 +177,35 @@ class HashingTF(Transformer, HasInputCol, HasOutputCol, HasNumFeatures):
     def transform(self, table: Table) -> Tuple[Table]:
         m = self.num_features
         col = table.column(self.input_col)
-        out = np.empty(len(col), dtype=object)
-        for i, tokens in enumerate(col):
-            counts = {}
+        n = len(col)
+        # hash each distinct token once; then aggregate (row, bucket) pairs
+        # with one vectorized unique instead of a dict per row
+        lengths = np.fromiter((len(t) for t in col), np.int64, n)
+        total = int(lengths.sum())
+        flat_idx = np.empty(total, np.int64)
+        cache = {}
+        k = 0
+        for tokens in col:
             for t in tokens:
-                idx = _hash_index(str(t), m)
-                counts[idx] = counts.get(idx, 0) + 1
-            indices = sorted(counts)
-            values = [1.0 if self.binary else float(counts[j])
-                      for j in indices]
-            out[i] = SparseVector(m, indices, values)
+                s = str(t)
+                h = cache.get(s)
+                if h is None:
+                    h = _hash_index(s, m)
+                    cache[s] = h
+                flat_idx[k] = h
+                k += 1
+        rows = np.repeat(np.arange(n, dtype=np.int64), lengths)
+        key, counts = np.unique(rows * m + flat_idx, return_counts=True)
+        buckets = key % m
+        values = (np.ones(len(key)) if self.binary
+                  else counts.astype(np.float64))
+        bounds = np.searchsorted(key // m, np.arange(n + 1, dtype=np.int64))
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            lo, hi = bounds[i], bounds[i + 1]
+            # copies: a slice view would pin the table-sized base arrays
+            out[i] = SparseVector._unchecked(m, buckets[lo:hi].copy(),
+                                             values[lo:hi].copy())
         return (table.with_column(self.output_col, out),)
 
 
@@ -199,21 +218,56 @@ class FeatureHasher(Transformer, HasInputCols, HasOutputCol, HasNumFeatures,
 
     def transform(self, table: Table) -> Tuple[Table]:
         m = self.num_features
+        n = table.num_rows
         categorical = set(self.categorical_cols or ())
-        cols = [(name, table.column(name)) for name in self.input_cols]
-        out = np.empty(table.num_rows, dtype=object)
-        for i in range(table.num_rows):
-            entries = {}
-            for name, col in cols:
-                v = col[i]
-                if name in categorical or isinstance(v, (str, bool, np.bool_)):
-                    idx = _hash_index(f"{name}={v}", m)
-                    entries[idx] = entries.get(idx, 0.0) + 1.0
+
+        # per column: an (n,) int64 bucket array + an (n,) float64 value
+        # array; numeric columns hash their NAME once, categorical columns
+        # hash each distinct "name=value" once
+        idx_cols, val_cols = [], []
+        for name in self.input_cols:
+            col = np.asarray(table.column(name))
+            numeric_dtype = (col.dtype != object
+                             and not col.dtype.kind in ("U", "S", "b"))
+            if name not in categorical and numeric_dtype:
+                # whole column numeric: one name hash, vectorized values
+                idx_cols.append(np.full(n, _hash_index(name, m), np.int64))
+                val_cols.append(np.asarray(col, np.float64))
+                continue
+            # object/string column (or forced categorical): classify per
+            # value — mixed numeric/string cells keep their semantics
+            cache = {}
+            name_idx = _hash_index(name, m)
+            idx = np.empty(n, np.int64)
+            vals = np.empty(n)
+            force_cat = name in categorical
+            for i, v in enumerate(col):
+                if force_cat or isinstance(v, (str, bool, np.bool_)):
+                    s = f"{name}={v}"
+                    h = cache.get(s)
+                    if h is None:
+                        h = _hash_index(s, m)
+                        cache[s] = h
+                    idx[i], vals[i] = h, 1.0
                 else:
-                    idx = _hash_index(name, m)
-                    entries[idx] = entries.get(idx, 0.0) + float(v)
-            indices = sorted(entries)
-            out[i] = SparseVector(m, indices, [entries[j] for j in indices])
+                    idx[i], vals[i] = name_idx, float(v)
+            idx_cols.append(idx)
+            val_cols.append(vals)
+
+        rows = np.tile(np.arange(n, dtype=np.int64), len(idx_cols))
+        keys = rows * m + np.concatenate(idx_cols)
+        vals = np.concatenate(val_cols)
+        # sum values per (row, bucket): collisions within a row accumulate
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        sums = np.bincount(inverse, weights=vals, minlength=len(uniq))
+        buckets = uniq % m
+        bounds = np.searchsorted(uniq // m, np.arange(n + 1, dtype=np.int64))
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            lo, hi = bounds[i], bounds[i + 1]
+            # copies: a slice view would pin the table-sized base arrays
+            out[i] = SparseVector._unchecked(m, buckets[lo:hi].copy(),
+                                             sums[lo:hi].copy())
         return (table.with_column(self.output_col, out),)
 
 

@@ -73,15 +73,24 @@ class PolynomialExpansion(Transformer, HasInputCol, HasOutputCol):
 
     def transform(self, table: Table) -> Tuple[Table]:
         x = table.vectors(self.input_col, np.float64)
-        d = x.shape[1]
-        cols = []
-        for deg in range(1, self.degree + 1):
-            for combo in itertools.combinations_with_replacement(range(d), deg):
-                prod = np.ones(x.shape[0])
-                for idx in combo:
-                    prod = prod * x[:, idx]
-                cols.append(prod)
-        return (table.with_column(self.output_col, np.stack(cols, axis=1)),)
+        n, d = x.shape
+        xT = np.ascontiguousarray(x.T)
+        combos = [c for deg in range(1, self.degree + 1)
+                  for c in itertools.combinations_with_replacement(
+                      range(d), deg)]
+        # each monomial = its degree-(k-1) prefix times one feature: one
+        # contiguous multiply per output column instead of rebuilding the
+        # product from scratch
+        out = np.empty((len(combos), n))
+        pos = {}
+        for k, combo in enumerate(combos):
+            if len(combo) == 1:
+                out[k] = xT[combo[0]]
+            else:
+                np.multiply(out[pos[combo[:-1]]], xT[combo[-1]], out=out[k])
+            pos[combo] = k
+        return (table.with_column(self.output_col,
+                                  np.ascontiguousarray(out.T)),)
 
 
 class DCT(Transformer, HasInputCol, HasOutputCol):

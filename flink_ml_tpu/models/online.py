@@ -116,7 +116,7 @@ class OnlineLogisticRegressionModel(Model,
                          timestamp_col: Optional[str] = None):
         """Unbounded predict: each chunk is scored with the latest model
         version available at that point (the reference's model-broadcast
-        join); yields output Tables.
+        join); returns a generator of output Tables.
 
         With ``model_stream`` (an iterable of ``(timestamp_ms, version,
         coefficients)``) and ``timestamp_col`` (event-time column on the
@@ -130,10 +130,16 @@ class OnlineLogisticRegressionModel(Model,
         are scored with the final model (a bounded fixture's end-of-stream;
         the reference's unbounded job would instead keep waiting).
         """
+        # validate eagerly (this is a plain function returning a generator,
+        # so the error surfaces at the call site, not at first iteration)
         if (model_stream is None) != (timestamp_col is None):
             raise ValueError(
                 "model_stream and timestamp_col must be given together for "
                 "the event-time model-delay join")
+        return self._transform_stream_impl(stream, model_stream,
+                                           timestamp_col)
+
+    def _transform_stream_impl(self, stream, model_stream, timestamp_col):
         if model_stream is None:
             versions = iter(self.history or [(self.model_version,
                                               self.coefficients)])

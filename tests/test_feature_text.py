@@ -187,3 +187,20 @@ def test_vector_indexer():
     out = model.transform(t)[0]["output"]
     np.testing.assert_allclose(out[:, 0], [0, 1, 0, 1])  # indexed
     np.testing.assert_allclose(out[:, 1], x[:, 1])       # passthrough
+
+
+def test_feature_hasher_mixed_object_column():
+    """A column mixing numeric and string cells keeps per-value semantics:
+    numerics contribute their value at the name hash, strings hash as
+    name=value categories."""
+    col = np.empty(3, dtype=object)
+    col[0], col[1], col[2] = 1.5, "x", 2.5
+    t = Table.from_columns(a=col)
+    out = FeatureHasher(input_cols=["a"], output_col="o",
+                        num_features=1 << 18).transform(t)[0]["o"]
+    from flink_ml_tpu.models.feature.text import _hash_index
+    name_idx = _hash_index("a", 1 << 18)
+    cat_idx = _hash_index("a=x", 1 << 18)
+    assert out[0].get(name_idx) == 1.5
+    assert out[1].get(cat_idx) == 1.0
+    assert out[2].get(name_idx) == 2.5
