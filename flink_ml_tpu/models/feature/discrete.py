@@ -203,18 +203,22 @@ class OneHotEncoderModel(Model, OneHotEncoderParams):
             size = n_cats - 1 if self.drop_last else n_cats
             if self.handle_invalid == self.KEEP_INVALID:
                 size += 1  # extra category for invalid values
+            # one-hot rows have 0 or 1 entries: compute the entry index for
+            # every row vectorized, then build via the unchecked fast path
+            entry = ints.copy()
+            has_entry = (~invalid & (ints < size)
+                         & ~(self.drop_last & (ints == n_cats - 1)))
+            if self.handle_invalid == self.KEEP_INVALID:
+                entry[invalid] = size - 1  # the extra invalid category
+                has_entry |= invalid
+            empty_i, empty_v = np.empty(0, np.int64), np.empty(0)
             out = np.empty(len(vals), dtype=object)
-            for i, v in enumerate(ints):
-                if invalid[i]:
-                    idx = size - 1 if self.handle_invalid == self.KEEP_INVALID \
-                        else 0
-                    out[i] = SparseVector(size, [idx], [1.0]) \
-                        if self.handle_invalid == self.KEEP_INVALID \
-                        else SparseVector(size, [], [])
-                elif v < size and not (self.drop_last and v == n_cats - 1):
-                    out[i] = SparseVector(size, [v], [1.0])
+            for i in range(len(vals)):
+                if has_entry[i]:
+                    out[i] = SparseVector._unchecked(
+                        size, entry[i:i + 1].copy(), np.ones(1))
                 else:
-                    out[i] = SparseVector(size, [], [])
+                    out[i] = SparseVector._unchecked(size, empty_i, empty_v)
             outs[out_name] = out
         if invalid_any.any() and self.handle_invalid == self.ERROR_INVALID:
             raise ValueError("invalid category values encountered "

@@ -180,6 +180,8 @@ class HashingTF(Transformer, HasInputCol, HasOutputCol, HasNumFeatures):
         n = len(col)
         # hash each distinct token once; then aggregate (row, bucket) pairs
         # with one vectorized unique instead of a dict per row
+        if any(not hasattr(t, "__len__") for t in col):
+            col = [t if hasattr(t, "__len__") else list(t) for t in col]
         lengths = np.fromiter((len(t) for t in col), np.int64, n)
         total = int(lengths.sum())
         flat_idx = np.empty(total, np.int64)
@@ -307,21 +309,37 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
         index = {t: i for i, t in enumerate(self.vocabulary)}
         size = len(self.vocabulary)
         col = table.column(self.input_col)
-        out = np.empty(len(col), dtype=object)
-        for i, tokens in enumerate(col):
-            tokens = list(tokens)
-            counts = {}
+        n = len(col)
+        # flat pass: vocab id per token (-1 = OOV), then one vectorized
+        # aggregation — same bulk shape as HashingTF.transform
+        if any(not hasattr(t, "__len__") for t in col):
+            col = [t if hasattr(t, "__len__") else list(t) for t in col]
+        lengths = np.fromiter((len(t) for t in col), np.int64, n)
+        flat = np.empty(int(lengths.sum()), np.int64)
+        k = 0
+        for tokens in col:
             for t in tokens:
-                j = index.get(str(t))
-                if j is not None:
-                    counts[j] = counts.get(j, 0) + 1
-            min_tf = (self.min_tf if self.min_tf >= 1.0
-                      else self.min_tf * len(tokens))
-            counts = {j: c for j, c in counts.items() if c >= min_tf}
-            indices = sorted(counts)
-            values = [1.0 if self.binary else float(counts[j])
-                      for j in indices]
-            out[i] = SparseVector(size, indices, values)
+                flat[k] = index.get(str(t), -1)
+                k += 1
+        rows = np.repeat(np.arange(n, dtype=np.int64), lengths)
+        in_vocab = flat >= 0
+        key, counts = np.unique(rows[in_vocab] * size + flat[in_vocab],
+                                return_counts=True)
+        row_of = key // size
+        min_tf = self.min_tf
+        thresholds = (np.full(len(key), min_tf) if min_tf >= 1.0
+                      else min_tf * lengths[row_of])
+        keep = counts >= thresholds
+        key, counts, row_of = key[keep], counts[keep], row_of[keep]
+        term = key % size
+        values = np.ones(len(key)) if self.binary \
+            else counts.astype(np.float64)
+        bounds = np.searchsorted(row_of, np.arange(n + 1, dtype=np.int64))
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            lo, hi = bounds[i], bounds[i + 1]
+            out[i] = SparseVector._unchecked(size, term[lo:hi].copy(),
+                                             values[lo:hi].copy())
         return (table.with_column(self.output_col, out),)
 
     def set_model_data(self, model_data: Table):

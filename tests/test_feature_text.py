@@ -204,3 +204,23 @@ def test_feature_hasher_mixed_object_column():
     assert out[0].get(name_idx) == 1.5
     assert out[1].get(cat_idx) == 1.0
     assert out[2].get(name_idx) == 2.5
+
+
+def test_hashing_tf_and_cv_accept_generator_cells():
+    """Token cells may be one-shot iterables, not just lists."""
+    def cells():
+        col = np.empty(2, dtype=object)
+        col[0] = (w for w in ["a", "b", "a"])
+        col[1] = (w for w in ["b"])
+        return col
+
+    t = Table.from_columns(tokens=cells())
+    out = HashingTF(input_col="tokens", output_col="tf",
+                    num_features=16).transform(t)[0]["tf"]
+    assert out[0].values.sum() == 3.0 and out[1].values.sum() == 1.0
+
+    lists = Table.from_columns(tokens=np.array([["a", "b", "a"], ["b"]],
+                                               dtype=object))
+    cv = CountVectorizer(input_col="tokens", output_col="cv").fit(lists)
+    out2 = cv.transform(Table.from_columns(tokens=cells()))[0]["cv"]
+    assert out2[0].values.sum() == 3.0 and out2[1].values.sum() == 1.0
