@@ -71,20 +71,77 @@ def generate_batches(stream: StreamTable, global_batch_size: int,
         yield buffer.take(np.arange(cursor, buffer.num_rows))
 
 
+class StreamCheckpointer:
+    """Checkpoint/listener plumbing for unbounded fits (the reference
+    checkpoints unbounded iterations the same way as bounded ones; here a
+    checkpoint is the (state pytree, batch count) snapshot between batches).
+
+    Resume semantics are at-least-once: the restored state continues from
+    wherever the *incoming* stream currently is — replaying the exact
+    source position is the source's concern, exactly as in the reference
+    where the source operator holds its own offsets.
+    """
+
+    def __init__(self, config=None, listeners=()):
+        self.mgr = getattr(config, "checkpoint_manager", None) \
+            if config is not None else None
+        self.interval = getattr(config, "checkpoint_interval", 0) \
+            if config is not None else 0
+        self.listeners = tuple(listeners)
+        self.batches = 0
+
+    def restore(self, template_state):
+        """Latest (state, batch_count) or None."""
+        if self.mgr is None:
+            return None
+        restored = self.mgr.restore(template_state)
+        if restored is not None:
+            self.batches = restored[1]
+        return restored
+
+    def after_batch(self, state) -> None:
+        self.batches += 1
+        for lst in self.listeners:
+            lst.on_epoch_watermark_incremented(self.batches - 1, state)
+        if self.mgr is not None and self.interval \
+                and self.batches % self.interval == 0:
+            self.mgr.save(state, self.batches)
+
+    def complete(self, state) -> None:
+        """The stream ended (bounded fixture = job success): notify and
+        discard checkpoints. A crash mid-stream skips this, keeping the
+        resume point."""
+        for lst in self.listeners:
+            lst.on_iteration_terminated(state)
+        if self.mgr is not None:
+            self.mgr.clear()
+
+
 def iterate_unbounded(initial_model: Any,
                       batches: Iterable[Any],
                       step: Callable[[Any, Any], Any],
                       on_model: Optional[Callable[[Any, int], None]] = None,
-                      initial_version: int = 0) -> Iterator[Tuple[Any, int]]:
+                      initial_version: int = 0,
+                      checkpointer: Optional[StreamCheckpointer] = None
+                      ) -> Iterator[Tuple[Any, int]]:
     """Unbounded iteration: fold ``step`` over batches, yielding
     (model_carry, version) after every batch — the feedback edge of
     Iterations.iterateUnboundedStreams as a host generator.
     """
     model = initial_model
     version = initial_version
+    if checkpointer is not None:
+        restored = checkpointer.restore((model, version))
+        if restored is not None:
+            model, version = restored[0]
+            version = int(version)  # np round-trip must not change the type
     for batch in batches:
         model = step(model, batch)
         version += 1
         if on_model is not None:
             on_model(model, version)
+        if checkpointer is not None:
+            checkpointer.after_batch((model, version))
         yield model, version
+    if checkpointer is not None:
+        checkpointer.complete((model, version))

@@ -60,6 +60,13 @@ def _hash_index(token: str, num_features: int) -> int:
     return zlib.crc32(token.encode("utf-8")) % num_features
 
 
+def _materialize_token_cells(col):
+    """Token cells may be one-shot iterables; give every cell a len()."""
+    if any(not hasattr(t, "__len__") for t in col):
+        return [t if hasattr(t, "__len__") else list(t) for t in col]
+    return col
+
+
 class Tokenizer(Transformer, HasInputCol, HasOutputCol):
     """Lowercase + whitespace split (ref: feature/tokenizer/Tokenizer.java)."""
 
@@ -180,8 +187,7 @@ class HashingTF(Transformer, HasInputCol, HasOutputCol, HasNumFeatures):
         n = len(col)
         # hash each distinct token once; then aggregate (row, bucket) pairs
         # with one vectorized unique instead of a dict per row
-        if any(not hasattr(t, "__len__") for t in col):
-            col = [t if hasattr(t, "__len__") else list(t) for t in col]
+        col = _materialize_token_cells(col)
         lengths = np.fromiter((len(t) for t in col), np.int64, n)
         total = int(lengths.sum())
         flat_idx = np.empty(total, np.int64)
@@ -312,8 +318,7 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
         n = len(col)
         # flat pass: vocab id per token (-1 = OOV), then one vectorized
         # aggregation — same bulk shape as HashingTF.transform
-        if any(not hasattr(t, "__len__") for t in col):
-            col = [t if hasattr(t, "__len__") else list(t) for t in col]
+        col = _materialize_token_cells(col)
         lengths = np.fromiter((len(t) for t in col), np.int64, n)
         flat = np.empty(int(lengths.sum()), np.int64)
         k = 0

@@ -35,7 +35,12 @@ import numpy as np
 
 from flink_ml_tpu.api.stage import Estimator, Model
 from flink_ml_tpu.common.table import Table, as_dense_vector_column
-from flink_ml_tpu.iteration.streaming import StreamTable, generate_batches
+from flink_ml_tpu.iteration.streaming import (
+    StreamCheckpointer,
+    StreamTable,
+    generate_batches,
+)
+from flink_ml_tpu.models.common import IterationRuntimeMixin
 from flink_ml_tpu.linalg.distance import DistanceMeasure
 from flink_ml_tpu.models.clustering.kmeans import KMeansModel, KMeansModelParams
 from flink_ml_tpu.params.param import FloatParam, ParamValidators
@@ -205,7 +210,8 @@ class OnlineLogisticRegressionModel(Model,
         self.model_version = int(arrays["modelVersion"][0])
 
 
-class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams):
+class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
+                               IterationRuntimeMixin):
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
         self._initial_model_data: Optional[Table] = None
@@ -236,6 +242,23 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams):
         self.copy_params_to(model)
         history: List[Tuple[int, np.ndarray]] = []
 
+        ckpt = StreamCheckpointer(self._iteration_config,
+                                  self._iteration_listeners)
+
+        def pack():
+            # history rides in the checkpoint as two stacked arrays so the
+            # state pytree has a fixed leaf count regardless of its length
+            hv = np.asarray([v for v, _ in history], np.int64)
+            hc = (np.stack([c for _, c in history])
+                  if history else np.zeros((0,) + coeffs.shape))
+            return coeffs, z, n, version, hv, hc
+
+        restored = ckpt.restore(pack())
+        if restored is not None:
+            coeffs, z, n, version, hv, hc = restored[0]
+            version = int(version)
+            history[:] = [(int(v), c) for v, c in zip(hv, hc)]
+
         for batch in _as_stream(data, self.global_batch_size):
             x = batch.vectors(self.features_col, np.float64)
             y = batch.scalars(self.label_col, np.float64)
@@ -254,7 +277,9 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams):
                 (np.sign(z) * l1 - z) / ((beta + np.sqrt(n)) / alpha + l2))
             version += 1
             history.append((version, coeffs.copy()))
+            ckpt.after_batch(pack())
 
+        ckpt.complete(pack())
         model.coefficients = coeffs
         model.model_version = version
         model.history = history
@@ -276,7 +301,7 @@ class OnlineKMeansModel(KMeansModel):
     whatever snapshot was consumed last."""
 
 
-class OnlineKMeans(Estimator, OnlineKMeansParams):
+class OnlineKMeans(Estimator, OnlineKMeansParams, IterationRuntimeMixin):
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
         self._initial_model_data: Optional[Table] = None
@@ -297,6 +322,12 @@ class OnlineKMeans(Estimator, OnlineKMeansParams):
         measure = DistanceMeasure.get_instance(self.distance_measure)
         decay = self.decay_factor
 
+        ckpt = StreamCheckpointer(self._iteration_config,
+                                  self._iteration_listeners)
+        restored = ckpt.restore((centroids, weights))
+        if restored is not None:
+            centroids, weights = restored[0]
+
         for batch in _as_stream(data, self.global_batch_size):
             x = batch.vectors(self.features_col, np.float64)
             dists = np.asarray(measure.pairwise(x, centroids))
@@ -313,7 +344,9 @@ class OnlineKMeans(Estimator, OnlineKMeansParams):
                 lam = counts[i] / weights[i]
                 centroids[i] = (1 - lam) * centroids[i] \
                     + (lam / counts[i]) * sums[i]
+            ckpt.after_batch((centroids, weights))
 
+        ckpt.complete((centroids, weights))
         model = OnlineKMeansModel(centroids=centroids, weights=weights)
         return self.copy_params_to(model)
 
@@ -393,7 +426,8 @@ class OnlineStandardScalerModel(Model, OnlineStandardScalerModelParams):
         self._with_mean, self._with_std = (bool(v) for v in arrays["flags"])
 
 
-class OnlineStandardScaler(Estimator, OnlineStandardScalerParams):
+class OnlineStandardScaler(Estimator, OnlineStandardScalerParams,
+                           IterationRuntimeMixin):
     def fit(self, data: Union[Table, StreamTable],
             batch_size: int = 1000) -> OnlineStandardScalerModel:
         from flink_ml_tpu.common.window import CountTumblingWindows
@@ -408,6 +442,36 @@ class OnlineStandardScaler(Estimator, OnlineStandardScalerParams):
         version = 0
         history = []
         mean = std = None
+        ckpt = StreamCheckpointer(self._iteration_config,
+                                  self._iteration_listeners)
+
+        def moments():
+            m = total / count
+            if count > 1:
+                s = np.sqrt(np.maximum(
+                    (sq_total - count * m * m) / (count - 1), 0.0))
+            else:
+                s = np.zeros_like(m)
+            return m, s
+
+        def pack():
+            hv = np.asarray([v for v, _, _ in history], np.int64)
+            hm = (np.stack([m for _, m, _ in history])
+                  if history else np.zeros((0, 0)))
+            hs = (np.stack([s for _, _, s in history])
+                  if history else np.zeros((0, 0)))
+            return total, sq_total, count, version, hv, hm, hs
+
+        # restore before consuming the stream (shapes come from the saved
+        # arrays, the zero-size template only fixes the pytree structure)
+        restored = ckpt.restore(
+            (np.zeros(0), np.zeros(0), 0, 0,
+             np.zeros(0, np.int64), np.zeros((0, 0)), np.zeros((0, 0))))
+        if restored is not None:
+            total, sq_total, count, version, hv, hm, hs = restored[0]
+            count, version = int(count), int(version)
+            history[:] = [(int(v), m, s) for v, m, s in zip(hv, hm, hs)]
+
         for chunk in data:
             x = chunk.vectors(self.input_col, np.float64)
             if total is None:
@@ -416,16 +480,15 @@ class OnlineStandardScaler(Estimator, OnlineStandardScalerParams):
             total += x.sum(axis=0)
             sq_total += (x * x).sum(axis=0)
             count += x.shape[0]
-            mean = total / count
-            if count > 1:
-                std = np.sqrt(np.maximum(
-                    (sq_total - count * mean * mean) / (count - 1), 0.0))
-            else:
-                std = np.zeros_like(mean)
+            mean, std = moments()
             history.append((version, mean.copy(), std.copy()))
             version += 1
-        if mean is None:
+            ckpt.after_batch(pack())
+        if count == 0:
             raise ValueError("empty input stream")
+        if mean is None:  # resumed onto an already-exhausted stream
+            mean, std = moments()
+        ckpt.complete(pack())
         model = OnlineStandardScalerModel(
             mean=mean, std=std, model_version=version - 1,
             timestamp=int(time.time() * 1000),

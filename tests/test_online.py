@@ -193,3 +193,139 @@ def test_online_lr_delay_join_requires_both_args(rng):
     model = OnlineLogisticRegressionModel(coefficients=np.ones(2))
     with pytest.raises(ValueError, match="together"):
         list(model.transform_stream(StreamTable([]), model_stream=[]))
+
+
+class _DieAfter:
+    """Crash injection for unbounded fits: raises after N batches."""
+
+    def __init__(self, at):
+        self.at = at
+
+    def on_epoch_watermark_incremented(self, batch_idx, state):
+        if batch_idx + 1 == self.at:
+            raise RuntimeError("injected crash")
+
+    def on_iteration_terminated(self, state):
+        pass
+
+
+def test_online_lr_checkpoint_resume(rng, tmp_path):
+    """Crash mid-stream, rerun the tail of the stream: the resumed fit
+    continues from the checkpointed FTRL state (version keeps counting)."""
+    from flink_ml_tpu.iteration import CheckpointManager, IterationConfig
+    from flink_ml_tpu.models.online import OnlineLogisticRegression
+
+    x = rng.normal(size=(800, 4))
+    y = (x @ [1, -1, 2, 0.5] > 0).astype(float)
+    t = Table.from_columns(features=x, label=y)
+    init = Table.from_columns(
+        coefficient=np.zeros((1, 4)), modelVersion=np.asarray([0]))
+
+    def est(**kw):
+        e = OnlineLogisticRegression(global_batch_size=100, reg=0.0)
+        e.set_initial_model_data(init)
+        return e
+
+    expected = est().fit(StreamTable.from_table(t, 100))
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=2,
+                          checkpoint_manager=mgr)
+    with pytest.raises(RuntimeError):
+        (est().set_iteration_config(cfg, listeners=[_DieAfter(3)])
+         .fit(StreamTable.from_table(t, 100)))
+    assert mgr.list_checkpoints()
+
+    # crash fired in batch 3's listener, before that batch's checkpoint:
+    # last snapshot = after batch 2, so re-feed batches 3..8
+    tail = t.take(np.arange(200, 800))
+    resumed = (est().set_iteration_config(cfg)
+               .fit(StreamTable.from_table(tail, 100)))
+    assert resumed.model_version == expected.model_version
+    np.testing.assert_allclose(resumed.coefficients, expected.coefficients,
+                               rtol=1e-8)
+    assert not mgr.list_checkpoints()  # success cleared them
+
+
+def test_online_kmeans_checkpoint_resume(rng, tmp_path):
+    from flink_ml_tpu.iteration import CheckpointManager, IterationConfig
+    from flink_ml_tpu.models.online import OnlineKMeans
+
+    x = np.concatenate([rng.normal(size=(200, 3)),
+                        rng.normal(size=(200, 3)) + 5])
+    rng.shuffle(x)
+    t = Table.from_columns(features=x)
+    init = KMeansModel(centroids=x[:2].copy(),
+                       weights=np.zeros(2)).get_model_data()[0]
+
+    def est():
+        e = OnlineKMeans(global_batch_size=100, decay_factor=1.0, seed=0)
+        e.set_initial_model_data(init)
+        return e
+
+    expected = est().fit(StreamTable.from_table(t, 100)).centroids
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=1,
+                          checkpoint_manager=mgr)
+    with pytest.raises(RuntimeError):
+        (est().set_iteration_config(cfg, listeners=[_DieAfter(2)])
+         .fit(StreamTable.from_table(t, 100)))
+    resumed = (est().set_iteration_config(cfg)
+               .fit(StreamTable.from_table(t.take(np.arange(100, 400)), 100)))
+    np.testing.assert_allclose(resumed.centroids, expected, rtol=1e-8)
+
+
+def test_online_scaler_checkpoint_resume(rng, tmp_path):
+    from flink_ml_tpu.iteration import CheckpointManager, IterationConfig
+    from flink_ml_tpu.models.online import OnlineStandardScaler
+
+    x = rng.normal(size=(400, 3)) * 2 + 1
+    t = Table.from_columns(input=x)
+    expected = OnlineStandardScaler(input_col="input", output_col="o").fit(
+        StreamTable.from_table(t, 100))
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=1,
+                          checkpoint_manager=mgr)
+    est = OnlineStandardScaler(input_col="input", output_col="o")
+    with pytest.raises(RuntimeError):
+        (est.set_iteration_config(cfg, listeners=[_DieAfter(2)])
+         .fit(StreamTable.from_table(t, 100)))
+    est2 = OnlineStandardScaler(input_col="input", output_col="o")
+    resumed = (est2.set_iteration_config(cfg)
+               .fit(StreamTable.from_table(t.take(np.arange(100, 400)), 100)))
+    np.testing.assert_allclose(resumed.mean, expected.mean, rtol=1e-8)
+    np.testing.assert_allclose(resumed.std, expected.std, rtol=1e-8)
+    assert resumed.model_version == expected.model_version
+
+
+def test_iterate_unbounded_checkpointer(tmp_path):
+    """The generalized iterate_unbounded checkpoint path: resume restores
+    (model, version) with native Python types."""
+    from flink_ml_tpu.iteration import CheckpointManager, IterationConfig
+    from flink_ml_tpu.iteration.streaming import (StreamCheckpointer,
+                                                  iterate_unbounded)
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=1,
+                          checkpoint_manager=mgr)
+    step = lambda model, batch: model + batch  # noqa: E731
+
+    out = list(iterate_unbounded(0.0, [1.0, 2.0], step,
+                                 checkpointer=StreamCheckpointer(cfg)))
+    assert out[-1] == (3.0, 2)
+    assert not mgr.list_checkpoints()  # completion cleared
+
+    # crash after two batches: simulate by not completing (partial iteration)
+    gen = iterate_unbounded(0.0, [1.0, 2.0, 4.0], step,
+                            checkpointer=StreamCheckpointer(cfg))
+    assert next(gen) == (1.0, 1) and next(gen) == (3.0, 2)
+    del gen  # abandoned mid-stream: checkpoints survive
+    assert mgr.list_checkpoints()
+
+    resumed = list(iterate_unbounded(0.0, [4.0], step,
+                                     checkpointer=StreamCheckpointer(cfg)))
+    (model, ver), = resumed
+    assert (model, ver) == (7.0, 3)
+    assert type(ver) is int
