@@ -99,20 +99,29 @@ class StreamCheckpointer:
             self.batches = restored[1]
         return restored
 
-    def after_batch(self, state) -> None:
+    def after_batch(self, state_fn) -> None:
+        """``state_fn`` is a zero-arg thunk producing the state pytree — it
+        is only invoked when a listener or a due checkpoint actually needs
+        the state, so an inert checkpointer adds no per-batch cost."""
         self.batches += 1
+        due = (self.mgr is not None and self.interval
+               and self.batches % self.interval == 0)
+        if not self.listeners and not due:
+            return
+        state = state_fn()
         for lst in self.listeners:
             lst.on_epoch_watermark_incremented(self.batches - 1, state)
-        if self.mgr is not None and self.interval \
-                and self.batches % self.interval == 0:
+        if due:
             self.mgr.save(state, self.batches)
 
-    def complete(self, state) -> None:
+    def complete(self, state_fn) -> None:
         """The stream ended (bounded fixture = job success): notify and
         discard checkpoints. A crash mid-stream skips this, keeping the
         resume point."""
-        for lst in self.listeners:
-            lst.on_iteration_terminated(state)
+        if self.listeners:
+            state = state_fn()
+            for lst in self.listeners:
+                lst.on_iteration_terminated(state)
         if self.mgr is not None:
             self.mgr.clear()
 
@@ -141,7 +150,7 @@ def iterate_unbounded(initial_model: Any,
         if on_model is not None:
             on_model(model, version)
         if checkpointer is not None:
-            checkpointer.after_batch((model, version))
+            checkpointer.after_batch(lambda: (model, version))
         yield model, version
     if checkpointer is not None:
-        checkpointer.complete((model, version))
+        checkpointer.complete(lambda: (model, version))

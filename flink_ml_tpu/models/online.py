@@ -277,9 +277,9 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                 (np.sign(z) * l1 - z) / ((beta + np.sqrt(n)) / alpha + l2))
             version += 1
             history.append((version, coeffs.copy()))
-            ckpt.after_batch(pack())
+            ckpt.after_batch(pack)
 
-        ckpt.complete(pack())
+        ckpt.complete(pack)
         model.coefficients = coeffs
         model.model_version = version
         model.history = history
@@ -344,9 +344,9 @@ class OnlineKMeans(Estimator, OnlineKMeansParams, IterationRuntimeMixin):
                 lam = counts[i] / weights[i]
                 centroids[i] = (1 - lam) * centroids[i] \
                     + (lam / counts[i]) * sums[i]
-            ckpt.after_batch((centroids, weights))
+            ckpt.after_batch(lambda: (centroids, weights))
 
-        ckpt.complete((centroids, weights))
+        ckpt.complete(lambda: (centroids, weights))
         model = OnlineKMeansModel(centroids=centroids, weights=weights)
         return self.copy_params_to(model)
 
@@ -483,12 +483,12 @@ class OnlineStandardScaler(Estimator, OnlineStandardScalerParams,
             mean, std = moments()
             history.append((version, mean.copy(), std.copy()))
             version += 1
-            ckpt.after_batch(pack())
+            ckpt.after_batch(pack)
         if count == 0:
             raise ValueError("empty input stream")
         if mean is None:  # resumed onto an already-exhausted stream
             mean, std = moments()
-        ckpt.complete(pack())
+        ckpt.complete(pack)
         model = OnlineStandardScalerModel(
             mean=mean, std=std, model_version=version - 1,
             timestamp=int(time.time() * 1000),

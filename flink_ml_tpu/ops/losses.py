@@ -21,14 +21,25 @@ __all__ = ["LossFunc", "BinaryLogisticLoss", "HingeLoss", "LeastSquareLoss"]
 class LossFunc:
     """Batched loss: given coefficients and a weighted minibatch, return
     (loss_sum, grad_sum) — the reference's computeLoss/computeGradient
-    accumulated over the batch (LossFunc.java:40-49)."""
+    accumulated over the batch (LossFunc.java:40-49).
+
+    Every loss here decomposes as ``dots = X @ w``, elementwise
+    ``terms(dots) -> (loss_sum, multipliers)``, ``grad = X.T @ multipliers``
+    — which is what lets the tensor-parallel SGD path compute partial dots
+    on a feature shard, psum them over the model axis, and keep the
+    gradient matvec local (see optimizer._sgd_round_math)."""
 
     NAME = None
+
+    def terms(self, dots, labels, weights):
+        """(b,) margins → (scalar loss sum, (b,) gradient multipliers)."""
+        raise NotImplementedError
 
     def loss_and_gradient(self, coeffs, features, labels, weights):
         """coeffs (d,), features (b, d), labels (b,), weights (b,) →
         (scalar loss sum, (d,) gradient sum)."""
-        raise NotImplementedError
+        loss, multipliers = self.terms(features @ coeffs, labels, weights)
+        return loss, features.T @ multipliers
 
     @staticmethod
     def by_name(name: str) -> "LossFunc":
@@ -44,15 +55,13 @@ class BinaryLogisticLoss(LossFunc):
 
     NAME = "logistic"
 
-    def loss_and_gradient(self, coeffs, features, labels, weights):
-        dots = features @ coeffs
+    def terms(self, dots, labels, weights):
         label_scaled = 2.0 * labels - 1.0
         margins = dots * label_scaled
         # log1p(exp(-m)) with the standard overflow-safe rewrite
         loss = jnp.sum(weights * (jnp.logaddexp(0.0, -margins)))
         multipliers = weights * (-label_scaled / (jnp.exp(margins) + 1.0))
-        grad = features.T @ multipliers
-        return loss, grad
+        return loss, multipliers
 
 
 class HingeLoss(LossFunc):
@@ -61,15 +70,13 @@ class HingeLoss(LossFunc):
 
     NAME = "hinge"
 
-    def loss_and_gradient(self, coeffs, features, labels, weights):
-        dots = features @ coeffs
+    def terms(self, dots, labels, weights):
         label_scaled = 2.0 * labels - 1.0
         hinge = 1.0 - label_scaled * dots
         loss = jnp.sum(weights * jnp.maximum(hinge, 0.0))
         active = (hinge > 0.0).astype(dots.dtype)
         multipliers = -label_scaled * weights * active
-        grad = features.T @ multipliers
-        return loss, grad
+        return loss, multipliers
 
 
 class LeastSquareLoss(LossFunc):
@@ -77,9 +84,7 @@ class LeastSquareLoss(LossFunc):
 
     NAME = "least_square"
 
-    def loss_and_gradient(self, coeffs, features, labels, weights):
-        dots = features @ coeffs
+    def terms(self, dots, labels, weights):
         err = dots - labels
         loss = jnp.sum(weights * 0.5 * err * err)
-        grad = features.T @ (weights * err)
-        return loss, grad
+        return loss, weights * err

@@ -42,6 +42,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from flink_ml_tpu.ops.losses import LossFunc
 from flink_ml_tpu.ops.regularization import regularize
 from flink_ml_tpu.parallel.mesh import (
+    MODEL_AXIS,
     data_axes,
     data_pspec,
     data_shard_count,
@@ -61,7 +62,8 @@ class SGDParams:
     elastic_net: float = 0.0
 
 
-def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes):
+def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
+                    model_axis=None):
     """The per-shard math of ONE training round — shared verbatim by the
     all-device while_loop program and the host-driven round program so the
     two modes stay numerically identical by construction.
@@ -69,7 +71,15 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes):
     Returns ``round(xl, yl, wl, coeffs, offset) ->
     (coeffs, new_offset, mean_loss)`` operating on this shard's slice;
     must be called inside shard_map over the mesh's data axes (``axes`` —
-    a flat ("data",) mesh or a ("dcn", "data") hybrid)."""
+    a flat ("data",) mesh or a ("dcn", "data") hybrid).
+
+    With ``model_axis`` (tensor parallelism for wide models — a TPU-native
+    capability beyond the reference's DP-only design), the feature
+    dimension of ``xl`` and ``coeffs`` is additionally sharded over that
+    axis: the per-sample margins are partial dots psum'd over the model
+    axis (every loss here is margin-decomposable, LossFunc.terms), the
+    gradient matvec and the coefficient update stay local to the feature
+    shard, and the loss/weight reduction crosses the data axes only."""
     gb = prm.global_batch_size
     lb_base, lb_rem = gb // p, gb % p
 
@@ -90,7 +100,13 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes):
         yb = yl[idx]
         wb = wl[idx] * valid.astype(xl.dtype)
 
-        loss_sum, grad_sum = loss_func.loss_and_gradient(coeffs, xb, yb, wb)
+        if model_axis is None:
+            loss_sum, grad_sum = loss_func.loss_and_gradient(coeffs, xb, yb,
+                                                             wb)
+        else:
+            dots = jax.lax.psum(xb @ coeffs, model_axis)
+            loss_sum, multipliers = loss_func.terms(dots, yb, wb)
+            grad_sum = xb.T @ multipliers  # local feature shard
         # one fused all-reduce over [grad, weight, loss] (the
         # reference's feedbackArray layout, SGD.java:190)
         packed = jnp.concatenate([
@@ -120,7 +136,9 @@ def _build_sgd_program(loss_cls, mesh: Mesh, prm: SGDParams):
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     p = data_shard_count(mesh)
-    round_step = _sgd_round_math(loss_cls(), prm, p, axes)
+    model_axis = MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None
+    wspec = P(model_axis) if model_axis else P()
+    round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis)
     max_iter = prm.max_iter
 
     def per_shard(xl, yl, wl, w0):
@@ -142,8 +160,8 @@ def _build_sgd_program(loss_cls, mesh: Mesh, prm: SGDParams):
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(spec0, None), P(spec0), P(spec0), P()),
-        out_specs=(P(), P()), check_vma=False))
+        in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec),
+        out_specs=(wspec, P()), check_vma=False))
 
 
 @functools.lru_cache(maxsize=128)
@@ -155,7 +173,9 @@ def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams):
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     p = data_shard_count(mesh)
-    round_step = _sgd_round_math(loss_cls(), prm, p, axes)
+    model_axis = MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None
+    wspec = P(model_axis) if model_axis else P()
+    round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis)
 
     def per_shard(xl, yl, wl, coeffs, offsets):
         coeffs, new_offset, mean_loss = round_step(xl, yl, wl, coeffs,
@@ -164,9 +184,9 @@ def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams):
 
     return jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(spec0, None), P(spec0), P(spec0), P(),
+        in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
                   P(spec0)),
-        out_specs=(P(), P(spec0), P()), check_vma=False)
+        out_specs=(wspec, P(spec0), P()), check_vma=False)
 
 
 class SGD:
@@ -190,20 +210,45 @@ class SGD:
         fault-injection bar of BoundedAllRoundCheckpointITCase)."""
         mesh = mesh or default_mesh()
         n = features.shape[0]
+        d = features.shape[1]
         if weights is None:
             weights = np.ones(n, dtype=np.float32)
 
         axes = data_axes(mesh)
-        xs, _ = shard_batch(mesh, np.asarray(features, np.float32), axes)
+        features = np.asarray(features, np.float32)
+        init_coeffs = np.asarray(init_coeffs)
+        tp = MODEL_AXIS in mesh.axis_names
+        if tp:
+            # tensor parallelism: feature dim padded to the model-axis size
+            # and sharded over it (padded coords stay exactly zero: zero
+            # features → zero grad → soft-threshold(0) = 0)
+            tp_size = int(mesh.shape[MODEL_AXIS])
+            pad = (-d) % tp_size
+            if pad:
+                features = np.pad(features, ((0, 0), (0, pad)))
+                init_coeffs = np.pad(init_coeffs, (0, pad))
+        from jax.sharding import NamedSharding
+        if tp:
+            spec0 = data_pspec(mesh)
+            rem = (-n) % data_shard_count(mesh)
+            if rem:
+                features = np.pad(features, ((0, rem), (0, 0)))
+            xs = jax.device_put(features,
+                                NamedSharding(mesh, P(spec0, MODEL_AXIS)))
+            w_sharding = NamedSharding(mesh, P(MODEL_AXIS))
+        else:
+            xs, _ = shard_batch(mesh, features, axes)
+            w_sharding = NamedSharding(mesh, P())
         ys, _ = shard_batch(mesh, np.asarray(labels, np.float32), axes)
         ws, _ = shard_batch(mesh, np.asarray(weights, np.float32), axes)
+        w0 = jax.device_put(jnp.asarray(init_coeffs, dtype), w_sharding)
 
         from flink_ml_tpu.iteration.iteration import needs_host_loop
         if not needs_host_loop(config, listeners):
             fit = _build_sgd_program(type(loss_func), mesh, self.params)
-            coeffs, mean_loss = fit(xs, ys, ws,
-                                    jnp.asarray(init_coeffs, dtype))
-            return np.asarray(coeffs, np.float64), float(mean_loss)
+            coeffs, mean_loss = fit(xs, ys, ws, w0)
+            return (np.asarray(coeffs, np.float64)[:d],
+                    float(mean_loss))
 
         from flink_ml_tpu.iteration.iteration import iterate_bounded
 
@@ -218,13 +263,12 @@ class SGD:
                                                   offsets)
             return coeffs, offsets, mean_loss
 
-        # carry leaves must live on the full mesh (replicated coeffs/loss,
-        # per-task offsets) — both for the shard_mapped round and so that
-        # checkpoint restore re-places leaves onto the right shardings.
-        from jax.sharding import NamedSharding
+        # carry leaves must live on the full mesh (replicated or
+        # model-sharded coeffs, per-task offsets) — both for the
+        # shard_mapped round and so that checkpoint restore re-places
+        # leaves onto the right shardings.
         init = (
-            jax.device_put(jnp.asarray(init_coeffs, dtype),
-                           NamedSharding(mesh, P())),
+            w0,
             jax.device_put(jnp.zeros((p,), jnp.int32),
                            NamedSharding(mesh, P(spec0))),
             jax.device_put(jnp.asarray(jnp.inf, dtype),
@@ -235,4 +279,4 @@ class SGD:
             terminate=lambda carry, epoch: carry[2] < self.params.tol,
             config=config, listeners=listeners)
         coeffs, _, mean_loss = final
-        return np.asarray(coeffs, np.float64), float(mean_loss)
+        return np.asarray(coeffs, np.float64)[:d], float(mean_loss)

@@ -221,7 +221,7 @@ def test_online_lr_checkpoint_resume(rng, tmp_path):
     init = Table.from_columns(
         coefficient=np.zeros((1, 4)), modelVersion=np.asarray([0]))
 
-    def est(**kw):
+    def est():
         e = OnlineLogisticRegression(global_batch_size=100, reg=0.0)
         e.set_initial_model_data(init)
         return e
