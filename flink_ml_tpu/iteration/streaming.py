@@ -71,6 +71,71 @@ def generate_batches(stream: StreamTable, global_batch_size: int,
         yield buffer.take(np.arange(cursor, buffer.num_rows))
 
 
+def window_stream(stream: StreamTable, windows,
+                  timestamp_col: Optional[str] = None,
+                  with_end_ts: bool = False) -> Iterator:
+    """Regroup a stream's rows into tumbling time windows.
+
+    Ref: the Windows param consumed by OnlineStandardScaler (
+    feature/standardscaler/OnlineStandardScaler.java — per-window model
+    emission).
+
+    - Event-time windows bucket rows by ``timestamp_col // size_ms``; a
+      window is emitted when a later window's first row arrives (in-order
+      streams — the reference's watermark generator with zero
+      out-of-orderness), the trailing window at end-of-stream.
+    - Processing-time windows bucket whole chunks by wall-clock arrival
+      time; no timestamp column is involved (reference semantics).
+
+    Yields Tables, or ``(window_end_ms, Table)`` with ``with_end_ts=True``
+    (the timestamp the reference stamps on each per-window model).
+    """
+    import time as _time
+
+    from flink_ml_tpu.common.window import (
+        EventTimeTumblingWindows,
+        ProcessingTimeTumblingWindows,
+    )
+
+    if isinstance(windows, EventTimeTumblingWindows):
+        if timestamp_col is None:
+            raise ValueError(
+                "event-time windows need timestamp_col to assign rows to "
+                "windows")
+        event_time = True
+    elif isinstance(windows, ProcessingTimeTumblingWindows):
+        event_time = False
+    else:
+        raise ValueError(f"window_stream supports tumbling time windows, "
+                         f"got {type(windows).__name__}")
+    size_ms = windows.size_ms
+
+    def emit(window_id, table):
+        if with_end_ts:
+            return (int(window_id + 1) * size_ms, table)
+        return table
+
+    pending: Optional[Table] = None
+    pending_window = None
+    for chunk in stream:
+        if event_time:
+            wids = np.asarray(chunk.column(timestamp_col),
+                              np.int64) // size_ms
+            chunk_windows = [(wid, chunk.take(np.nonzero(wids == wid)[0]))
+                             for wid in np.unique(wids)]
+        else:
+            chunk_windows = [(int(_time.time() * 1000) // size_ms, chunk)]
+        for window_id, rows in chunk_windows:
+            if pending_window is None or window_id == pending_window:
+                pending = rows if pending is None else pending.concat(rows)
+                pending_window = window_id
+            else:
+                yield emit(pending_window, pending)
+                pending, pending_window = rows, window_id
+    if pending is not None and pending.num_rows:
+        yield emit(pending_window, pending)
+
+
 class StreamCheckpointer:
     """Checkpoint/listener plumbing for unbounded fits (the reference
     checkpoints unbounded iterations the same way as bounded ones; here a

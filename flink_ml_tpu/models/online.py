@@ -381,6 +381,9 @@ class OnlineStandardScalerModel(Model, OnlineStandardScalerModelParams):
         self.timestamp = int(timestamp)
         self._with_mean, self._with_std = with_mean, with_std
         self.history: List[Tuple[int, np.ndarray, np.ndarray]] = []
+        #: per-snapshot timestamps (window end for time windows): the
+        #: (timestamp, version, data) stream the model-delay join consumes
+        self.history_timestamps: List[int] = []
 
     def transform(self, table: Table) -> Tuple[Table]:
         if self.mean is None:
@@ -429,18 +432,39 @@ class OnlineStandardScalerModel(Model, OnlineStandardScalerModelParams):
 class OnlineStandardScaler(Estimator, OnlineStandardScalerParams,
                            IterationRuntimeMixin):
     def fit(self, data: Union[Table, StreamTable],
-            batch_size: int = 1000) -> OnlineStandardScalerModel:
-        from flink_ml_tpu.common.window import CountTumblingWindows
+            batch_size: int = 1000,
+            timestamp_col: Optional[str] = None
+            ) -> OnlineStandardScalerModel:
+        from flink_ml_tpu.common.window import (
+            CountTumblingWindows,
+            EventTimeTumblingWindows,
+            ProcessingTimeTumblingWindows,
+        )
         windows = self.windows
+        timed = isinstance(windows, (EventTimeTumblingWindows,
+                                     ProcessingTimeTumblingWindows))
         if isinstance(windows, CountTumblingWindows):
             batch_size = windows.size
         if isinstance(data, Table):
             data = StreamTable.from_table(data, batch_size)
+        elif isinstance(windows, CountTumblingWindows):
+            # pre-chunked stream: re-group to the count-window size so one
+            # model is emitted per `size` rows regardless of chunking
+            data = StreamTable(generate_batches(data, batch_size,
+                                                drop_remainder=False))
+        if timed:
+            # time-windowed model emission: one versioned model per
+            # tumbling window, stamped with the window end time (ref
+            # OnlineStandardScaler window semantics)
+            from flink_ml_tpu.iteration.streaming import window_stream
+            data = window_stream(data, windows, timestamp_col,
+                                 with_end_ts=True)
 
         total = sq_total = None
         count = 0
         version = 0
         history = []
+        history_timestamps = []
         mean = std = None
         ckpt = StreamCheckpointer(self._iteration_config,
                                   self._iteration_listeners)
@@ -460,19 +484,26 @@ class OnlineStandardScaler(Estimator, OnlineStandardScalerParams,
                   if history else np.zeros((0, 0)))
             hs = (np.stack([s for _, _, s in history])
                   if history else np.zeros((0, 0)))
-            return total, sq_total, count, version, hv, hm, hs
+            hts = np.asarray(history_timestamps, np.int64)
+            return total, sq_total, count, version, hv, hm, hs, hts
 
         # restore before consuming the stream (shapes come from the saved
         # arrays, the zero-size template only fixes the pytree structure)
         restored = ckpt.restore(
             (np.zeros(0), np.zeros(0), 0, 0,
-             np.zeros(0, np.int64), np.zeros((0, 0)), np.zeros((0, 0))))
+             np.zeros(0, np.int64), np.zeros((0, 0)), np.zeros((0, 0)),
+             np.zeros(0, np.int64)))
         if restored is not None:
-            total, sq_total, count, version, hv, hm, hs = restored[0]
+            total, sq_total, count, version, hv, hm, hs, hts = restored[0]
             count, version = int(count), int(version)
             history[:] = [(int(v), m, s) for v, m, s in zip(hv, hm, hs)]
+            history_timestamps[:] = [int(t) for t in hts]
 
-        for chunk in data:
+        for item in data:
+            if timed:
+                window_end_ms, chunk = item
+            else:
+                window_end_ms, chunk = None, item
             x = chunk.vectors(self.input_col, np.float64)
             if total is None:
                 total = np.zeros(x.shape[1])
@@ -482,6 +513,12 @@ class OnlineStandardScaler(Estimator, OnlineStandardScalerParams,
             count += x.shape[0]
             mean, std = moments()
             history.append((version, mean.copy(), std.copy()))
+            # per-model timestamp: the window end for time windows (what
+            # the reference stamps and the model-delay join consumes),
+            # wall clock otherwise
+            history_timestamps.append(
+                window_end_ms if window_end_ms is not None
+                else int(time.time() * 1000))
             version += 1
             ckpt.after_batch(pack)
         if count == 0:
@@ -491,8 +528,10 @@ class OnlineStandardScaler(Estimator, OnlineStandardScalerParams,
         ckpt.complete(pack)
         model = OnlineStandardScalerModel(
             mean=mean, std=std, model_version=version - 1,
-            timestamp=int(time.time() * 1000),
+            timestamp=(history_timestamps[-1] if history_timestamps
+                       else int(time.time() * 1000)),
             with_mean=self.with_mean, with_std=self.with_std)
         self.copy_params_to(model)
         model.history = history
+        model.history_timestamps = history_timestamps
         return model

@@ -329,3 +329,71 @@ def test_iterate_unbounded_checkpointer(tmp_path):
     (model, ver), = resumed
     assert (model, ver) == (7.0, 3)
     assert type(ver) is int
+
+
+def test_window_stream_event_time(rng):
+    from flink_ml_tpu.common.window import EventTimeTumblingWindows
+    from flink_ml_tpu.iteration.streaming import window_stream
+
+    ts = np.array([0, 100, 900, 1000, 1500, 2100, 2200], np.int64)
+    t = Table.from_columns(v=np.arange(7.0), ts=ts)
+    wins = list(window_stream(StreamTable.from_table(t, 3),
+                              EventTimeTumblingWindows.of(1000), "ts"))
+    assert [list(w["v"]) for w in wins] == [[0, 1, 2], [3, 4], [5, 6]]
+
+
+def test_online_scaler_event_time_windows(rng):
+    """One versioned model per event-time tumbling window; cumulative
+    moments across windows (reference OnlineStandardScaler semantics)."""
+    from flink_ml_tpu.common.window import EventTimeTumblingWindows
+    from flink_ml_tpu.models.online import OnlineStandardScaler
+
+    x = rng.normal(size=(60, 2)) * 3 + 2
+    ts = np.arange(60, dtype=np.int64) * 100  # 0..5900 → 6 windows of 1000ms
+    t = Table.from_columns(input=x, ts=ts)
+
+    est = OnlineStandardScaler(input_col="input", output_col="o")
+    est.set_windows(EventTimeTumblingWindows.of(1000))
+    model = est.fit(StreamTable.from_table(t, 25), timestamp_col="ts")
+    assert len(model.history) == 6          # one snapshot per window
+    assert model.model_version == 5
+    # window-end timestamps: the (timestamp, version, data) stream the
+    # model-delay join consumes
+    assert model.history_timestamps == [1000, 2000, 3000, 4000, 5000, 6000]
+    assert model.timestamp == 6000
+    np.testing.assert_allclose(model.mean, x.mean(axis=0), rtol=1e-8)
+    np.testing.assert_allclose(model.std, x.std(axis=0, ddof=1), rtol=1e-8)
+
+    with pytest.raises(ValueError, match="timestamp_col"):
+        OnlineStandardScaler(input_col="input", output_col="o") \
+            .set_windows(EventTimeTumblingWindows.of(1000)) \
+            .fit(StreamTable.from_table(t, 25))
+
+
+def test_online_scaler_count_windows_rechunk_stream(rng):
+    """CountTumblingWindows must re-group a pre-chunked stream to the
+    window size, not inherit the stream's chunking."""
+    from flink_ml_tpu.common.window import CountTumblingWindows
+    from flink_ml_tpu.models.online import OnlineStandardScaler
+
+    x = rng.normal(size=(200, 2))
+    t = Table.from_columns(input=x)
+    est = OnlineStandardScaler(input_col="input", output_col="o")
+    est.set_windows(CountTumblingWindows.of(100))
+    model = est.fit(StreamTable.from_table(t, 25))  # 25-row chunks
+    assert len(model.history) == 2  # 200 rows / 100-row windows
+
+
+def test_processing_time_windows_no_timestamp_col(rng):
+    """Processing-time windows bucket by arrival; no timestamp column."""
+    from flink_ml_tpu.common.window import ProcessingTimeTumblingWindows
+    from flink_ml_tpu.models.online import OnlineStandardScaler
+
+    x = rng.normal(size=(50, 2))
+    t = Table.from_columns(input=x)
+    est = OnlineStandardScaler(input_col="input", output_col="o")
+    est.set_windows(ProcessingTimeTumblingWindows.of(3_600_000))
+    model = est.fit(StreamTable.from_table(t, 10))
+    # all chunks arrive within one wall-clock hour window
+    assert len(model.history) == 1
+    np.testing.assert_allclose(model.mean, x.mean(axis=0), rtol=1e-8)
