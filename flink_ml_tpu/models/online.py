@@ -192,6 +192,8 @@ class OnlineLogisticRegressionModel(Model,
                              else np.asarray(col[0]))
         if "modelVersion" in model_data:
             self.model_version = int(model_data.column("modelVersion")[0])
+        from flink_ml_tpu.common.metrics import metrics
+        metrics.report_model(self.model_version)
         return self
 
     def get_model_data(self) -> Tuple[Table]:
@@ -406,6 +408,10 @@ class OnlineStandardScalerModel(Model, OnlineStandardScalerModelParams):
             self.model_version = int(model_data.column("modelVersion")[0])
         if "timestamp" in model_data:
             self.timestamp = int(model_data.column("timestamp")[0])
+        # ref OnlineStandardScalerModel.java:202-210: consuming model data
+        # publishes the ml.model version/timestamp gauges
+        from flink_ml_tpu.common.metrics import metrics
+        metrics.report_model(self.model_version, self.timestamp or None)
         return self
 
     def get_model_data(self) -> Tuple[Table]:

@@ -397,3 +397,19 @@ def test_processing_time_windows_no_timestamp_col(rng):
     # all chunks arrive within one wall-clock hour window
     assert len(model.history) == 1
     np.testing.assert_allclose(model.mean, x.mean(axis=0), rtol=1e-8)
+
+
+def test_online_models_publish_model_gauges(rng):
+    """Ref: consuming model data publishes ml.model version/timestamp
+    gauges (OnlineStandardScalerModel.java:202-210)."""
+    from flink_ml_tpu.common.metrics import metrics
+    from flink_ml_tpu.models.online import OnlineStandardScalerModel
+
+    md = Table.from_columns(
+        mean=np.zeros((1, 2)), std=np.ones((1, 2)),
+        modelVersion=np.asarray([7], np.int64),
+        timestamp=np.asarray([123456], np.int64))
+    OnlineStandardScalerModel(with_std=True).set_model_data(md)
+    g = metrics.group("ml", "model")
+    assert g.get_gauge("version") == 7
+    assert g.get_gauge("timestamp") == 123456
