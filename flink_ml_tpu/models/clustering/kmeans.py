@@ -33,7 +33,7 @@ from flink_ml_tpu.api.stage import Estimator, Model
 from flink_ml_tpu.common.table import Table, as_dense_vector_column
 from flink_ml_tpu.linalg.distance import DistanceMeasure
 from flink_ml_tpu.linalg.vectors import DenseVector
-from flink_ml_tpu.parallel.collective import shard_batch
+from flink_ml_tpu.parallel.collective import local_valid_mask, shard_batch
 from flink_ml_tpu.parallel.mesh import data_axes, data_pspec, default_mesh
 from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam
 from flink_ml_tpu.params.shared import (
@@ -104,8 +104,9 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
     round_step = _lloyd_round_math(
         DistanceMeasure.get_instance(measure_name), axes)
 
-    def per_shard(xl, vl, c0):
+    def per_shard(xl, n_valid, c0):
         k = c0.shape[0]
+        vl = local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
 
         def cond(state):
             _, _, epoch = state
@@ -122,7 +123,7 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(spec0, None), P(spec0), P()),
+        in_specs=(P(spec0, None), P(), P()),
         out_specs=(P(), P()), check_vma=False))
 
 
@@ -134,9 +135,14 @@ def _build_lloyd_round_program(mesh, measure_name: str):
     spec0 = data_pspec(mesh)
     round_step = _lloyd_round_math(
         DistanceMeasure.get_instance(measure_name), axes)
+
+    def per_shard(xl, n_valid, centroids):
+        vl = local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
+        return round_step(xl, vl, centroids)
+
     return jax.shard_map(
-        round_step, mesh=mesh,
-        in_specs=(P(spec0, None), P(spec0), P()),
+        per_shard, mesh=mesh,
+        in_specs=(P(spec0, None), P(), P()),
         out_specs=(P(), P()), check_vma=False)
 
 
@@ -215,9 +221,9 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
         mesh = default_mesh()
         axes = data_axes(mesh)
         xs, _ = shard_batch(mesh, np.asarray(x, np.float32), axes)
-        valid = np.zeros(xs.shape[0], np.float32)
-        valid[:n] = 1.0  # padded rows must not join any cluster
-        vs, _ = shard_batch(mesh, valid, axes)
+        # padded rows must not join any cluster: the validity mask is
+        # derived on-device from the scalar n (no (n,) mask transfer)
+        n_valid = jnp.int32(n)
 
         from flink_ml_tpu.iteration.iteration import (iterate_bounded,
                                                       needs_host_loop)
@@ -225,7 +231,7 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                                self._iteration_listeners):
             fit = _build_lloyd_program(mesh, self.distance_measure,
                                        self.max_iter)
-            centroids, counts = fit(xs, vs, jnp.asarray(init))
+            centroids, counts = fit(xs, n_valid, jnp.asarray(init))
         else:
 
             round_fn = _build_lloyd_round_program(mesh,
@@ -233,7 +239,7 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
 
             def body(carry, epoch):
                 centroids, _ = carry
-                return round_fn(xs, vs, centroids)
+                return round_fn(xs, n_valid, centroids)
 
             from jax.sharding import NamedSharding
             repl = NamedSharding(mesh, P())

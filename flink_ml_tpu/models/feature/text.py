@@ -67,6 +67,20 @@ def _materialize_token_cells(col):
     return col
 
 
+def _build_sparse_rows(n, size, sorted_row_ids, col_idx, values):
+    """Row-major (row, column, value) triples → object array of per-row
+    SparseVectors. ``sorted_row_ids`` must be ascending (the output of the
+    key-sorted np.unique aggregations here); slices are copied so a
+    retained row cannot pin the table-sized arrays."""
+    bounds = np.searchsorted(sorted_row_ids, np.arange(n + 1, dtype=np.int64))
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        lo, hi = bounds[i], bounds[i + 1]
+        out[i] = SparseVector._unchecked(size, col_idx[lo:hi].copy(),
+                                         values[lo:hi].copy())
+    return out
+
+
 class Tokenizer(Transformer, HasInputCol, HasOutputCol):
     """Lowercase + whitespace split (ref: feature/tokenizer/Tokenizer.java)."""
 
@@ -204,16 +218,9 @@ class HashingTF(Transformer, HasInputCol, HasOutputCol, HasNumFeatures):
                 k += 1
         rows = np.repeat(np.arange(n, dtype=np.int64), lengths)
         key, counts = np.unique(rows * m + flat_idx, return_counts=True)
-        buckets = key % m
         values = (np.ones(len(key)) if self.binary
                   else counts.astype(np.float64))
-        bounds = np.searchsorted(key // m, np.arange(n + 1, dtype=np.int64))
-        out = np.empty(n, dtype=object)
-        for i in range(n):
-            lo, hi = bounds[i], bounds[i + 1]
-            # copies: a slice view would pin the table-sized base arrays
-            out[i] = SparseVector._unchecked(m, buckets[lo:hi].copy(),
-                                             values[lo:hi].copy())
+        out = _build_sparse_rows(n, m, key // m, key % m, values)
         return (table.with_column(self.output_col, out),)
 
 
@@ -268,14 +275,7 @@ class FeatureHasher(Transformer, HasInputCols, HasOutputCol, HasNumFeatures,
         # sum values per (row, bucket): collisions within a row accumulate
         uniq, inverse = np.unique(keys, return_inverse=True)
         sums = np.bincount(inverse, weights=vals, minlength=len(uniq))
-        buckets = uniq % m
-        bounds = np.searchsorted(uniq // m, np.arange(n + 1, dtype=np.int64))
-        out = np.empty(n, dtype=object)
-        for i in range(n):
-            lo, hi = bounds[i], bounds[i + 1]
-            # copies: a slice view would pin the table-sized base arrays
-            out[i] = SparseVector._unchecked(m, buckets[lo:hi].copy(),
-                                             sums[lo:hi].copy())
+        out = _build_sparse_rows(n, m, uniq // m, uniq % m, sums)
         return (table.with_column(self.output_col, out),)
 
 
@@ -336,15 +336,9 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
                       else min_tf * lengths[row_of])
         keep = counts >= thresholds
         key, counts, row_of = key[keep], counts[keep], row_of[keep]
-        term = key % size
         values = np.ones(len(key)) if self.binary \
             else counts.astype(np.float64)
-        bounds = np.searchsorted(row_of, np.arange(n + 1, dtype=np.int64))
-        out = np.empty(n, dtype=object)
-        for i in range(n):
-            lo, hi = bounds[i], bounds[i + 1]
-            out[i] = SparseVector._unchecked(size, term[lo:hi].copy(),
-                                             values[lo:hi].copy())
+        out = _build_sparse_rows(n, size, row_of, key % size, values)
         return (table.with_column(self.output_col, out),)
 
     def set_model_data(self, model_data: Table):

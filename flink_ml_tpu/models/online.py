@@ -192,8 +192,11 @@ class OnlineLogisticRegressionModel(Model,
                              else np.asarray(col[0]))
         if "modelVersion" in model_data:
             self.model_version = int(model_data.column("modelVersion")[0])
+        # only the version gauge: LR model data carries no timestamp, and a
+        # wall-clock substitute would clobber other models' real timestamps
         from flink_ml_tpu.common.metrics import metrics
-        metrics.report_model(self.model_version)
+        from flink_ml_tpu.common.metrics import VERSION_GAUGE
+        metrics.model_group().gauge(VERSION_GAUGE, self.model_version)
         return self
 
     def get_model_data(self) -> Tuple[Table]:
@@ -411,7 +414,7 @@ class OnlineStandardScalerModel(Model, OnlineStandardScalerModelParams):
         # ref OnlineStandardScalerModel.java:202-210: consuming model data
         # publishes the ml.model version/timestamp gauges
         from flink_ml_tpu.common.metrics import metrics
-        metrics.report_model(self.model_version, self.timestamp or None)
+        metrics.report_model(self.model_version, self.timestamp)
         return self
 
     def get_model_data(self) -> Tuple[Table]:

@@ -47,6 +47,7 @@ from flink_ml_tpu.parallel.mesh import (
     data_pspec,
     data_shard_count,
     default_mesh,
+    model_axis_of,
 )
 from flink_ml_tpu.parallel.collective import shard_batch
 
@@ -136,7 +137,7 @@ def _build_sgd_program(loss_cls, mesh: Mesh, prm: SGDParams):
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     p = data_shard_count(mesh)
-    model_axis = MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None
+    model_axis = model_axis_of(mesh)
     wspec = P(model_axis) if model_axis else P()
     round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis)
     max_iter = prm.max_iter
@@ -173,7 +174,7 @@ def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams):
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     p = data_shard_count(mesh)
-    model_axis = MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None
+    model_axis = model_axis_of(mesh)
     wspec = P(model_axis) if model_axis else P()
     round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis)
 
@@ -217,7 +218,7 @@ class SGD:
         axes = data_axes(mesh)
         features = np.asarray(features, np.float32)
         init_coeffs = np.asarray(init_coeffs)
-        tp = MODEL_AXIS in mesh.axis_names
+        tp = model_axis_of(mesh) is not None
         if tp:
             # tensor parallelism: feature dim padded to the model-axis size
             # and sharded over it (padded coords stay exactly zero: zero

@@ -67,6 +67,15 @@ def termination_vote(local_count, axis_name: str = DATA_AXIS):
     return total == 0
 
 
+def local_valid_mask(axes, local_n: int, n_valid, dtype=jnp.float32):
+    """Inside shard_map: 1 for rows whose GLOBAL index is < ``n_valid`` —
+    the padding mask for ``shard_batch``'s zero-padded batches, derived
+    on-device from one scalar instead of shipping an (n,) mask array."""
+    shard = jax.lax.axis_index(axes)
+    global_idx = shard * local_n + jnp.arange(local_n)
+    return (global_idx < n_valid).astype(dtype)
+
+
 # -- host-level placement ----------------------------------------------------
 
 def shard_batch(mesh: Mesh, array, axis_name: str = DATA_AXIS):

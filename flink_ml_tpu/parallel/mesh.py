@@ -160,6 +160,11 @@ def data_pspec(mesh: Mesh):
     return axes[0] if len(axes) == 1 else axes
 
 
+def model_axis_of(mesh: Mesh) -> Optional[str]:
+    """The tensor-parallel axis name, or None on a DP-only mesh."""
+    return MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None
+
+
 def default_mesh() -> Mesh:
     """Process-wide default mesh (lazily: all devices on one data axis)."""
     global _default_mesh
