@@ -80,6 +80,77 @@ class Table:
         """From a servable DataFrame (flink_ml_tpu.servable)."""
         return Table({name: df.get(name).values for name in df.column_names})
 
+    @staticmethod
+    def from_csv(path: str, header: bool = True, delimiter: str = ",",
+                 names: Sequence[str] = None) -> "Table":
+        """Load a delimiter-separated file (the dataset-ingest role of the
+        reference's Flink connectors). All-numeric files take the native
+        C++ parse fast path; otherwise columns are inferred per column
+        (float64 when every cell parses, object/string otherwise).
+        ``names`` overrides the column names; with ``header=True`` the
+        header row is still skipped."""
+        import csv as _csv
+
+        with open(path, "rb") as f:
+            data = f.read()
+        first_nl = data.find(b"\n")
+        first_line = (data if first_nl < 0 else data[:first_nl]) \
+            .decode().rstrip("\r")
+        # quote-aware header parse (a quoted cell may contain the delimiter)
+        header_cells = next(_csv.reader([first_line], delimiter=delimiter),
+                            [])
+        n_cols = len(header_cells)
+        if header:
+            if names is None:
+                names = [c.strip() for c in header_cells]
+            data = b"" if first_nl < 0 else data[first_nl + 1:]
+        elif names is None:
+            names = [f"c{i}" for i in range(n_cols)]
+        names = list(names)
+        if len(names) != n_cols:
+            raise ValueError(f"{len(names)} names for {n_cols} columns")
+
+        from flink_ml_tpu import native
+        parsed = native.csv_parse_numeric(data, n_cols, delimiter) \
+            if data else np.empty((0, n_cols))
+        if parsed is not None:
+            return Table({name: parsed[:, i].copy()
+                          for i, name in enumerate(names)})
+
+        # general path: per-column dtype inference
+        import io as _io
+        rows = list(_csv.reader(_io.StringIO(data.decode()),
+                                delimiter=delimiter))
+        rows = [r for r in rows if r]
+        cols = {}
+        for i, name in enumerate(names):
+            raw = [r[i] if i < len(r) else "" for r in rows]
+            try:
+                cols[name] = np.asarray([float(v) for v in raw])
+            except ValueError:
+                cols[name] = np.asarray(raw, dtype=object)
+        return Table(cols)
+
+    def to_csv(self, path: str, header: bool = True,
+               delimiter: str = ",") -> None:
+        """Write scalar columns as delimiter-separated text (vector columns
+        are rejected — save/load model data keeps its binary format)."""
+        import csv as _csv
+        names = self.column_names
+        for name in names:
+            col = self._columns[name]
+            if col.ndim != 1 or (
+                    col.dtype == object and len(col)
+                    and isinstance(col[0], (Vector, list, tuple, np.ndarray))):
+                raise ValueError(
+                    f"column {name!r} is not scalar; to_csv writes scalar "
+                    "columns only")
+        with open(path, "w", newline="") as f:
+            writer = _csv.writer(f, delimiter=delimiter)
+            if header:
+                writer.writerow(names)
+            writer.writerows(zip(*(self._columns[n] for n in names)))
+
     # -- schema / access -----------------------------------------------------
     @property
     def column_names(self) -> List[str]:

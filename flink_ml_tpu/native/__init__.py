@@ -21,7 +21,8 @@ from typing import Optional
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "swing_kernel.cpp")
+_SOURCES = sorted(
+    os.path.join(_DIR, f) for f in os.listdir(_DIR) if f.endswith(".cpp"))
 _LIB = os.path.join(_DIR, "_native_kernels.so")
 
 _lock = threading.Lock()
@@ -31,16 +32,20 @@ _build_failed = False
 
 def _build() -> Optional[ctypes.CDLL]:
     global _build_failed
+    if not _SOURCES:  # sources stripped from the install: no native tier
+        _build_failed = True
+        return None
     try:
+        newest_src = max(os.path.getmtime(s) for s in _SOURCES)
         if (not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                or os.path.getmtime(_LIB) < newest_src):
             # per-process temp name: concurrent builders never share a file,
             # and os.replace publishes atomically
             tmp = f"{_LIB}.{os.getpid()}.tmp"
             try:
                 subprocess.run(
                     ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                     "-std=c++17", _SRC, "-o", tmp],
+                     "-std=c++17", *_SOURCES, "-o", tmp],
                     check=True, capture_output=True)
                 os.replace(tmp, _LIB)
             finally:
@@ -63,6 +68,10 @@ def _build() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_double),  # out_scores
             ctypes.POINTER(ctypes.c_int64),  # out_counts
         ]
+        lib.csv_parse_numeric.restype = ctypes.c_int64
+        lib.csv_parse_numeric.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
         return lib
     except (OSError, subprocess.CalledProcessError):
         # a concurrent builder may have published a valid library even if
@@ -70,7 +79,8 @@ def _build() -> Optional[ctypes.CDLL]:
         # source (a stale kernel is worse than the Python fallback)
         try:
             if (os.path.exists(_LIB)
-                    and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+                    and os.path.getmtime(_LIB) >= max(
+                        os.path.getmtime(s) for s in _SOURCES)):
                 return ctypes.CDLL(_LIB)
         except OSError:
             pass
@@ -127,3 +137,21 @@ def swing_similarity(user_items: np.ndarray, user_offsets: np.ndarray,
     if rc != 0:
         raise RuntimeError(f"swing_similarity failed with code {rc}")
     return out_items, out_scores, out_counts
+
+
+def csv_parse_numeric(data: bytes, n_cols: int, delimiter: str = ","):
+    """Native all-numeric CSV parse → (n_rows, n_cols) float64 array, or
+    None when the buffer isn't purely numeric (caller falls back) or the
+    native library is unavailable."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    max_rows = data.count(b"\n") + 1
+    out = np.empty((max_rows, n_cols), np.float64)
+    n = lib.csv_parse_numeric(
+        data, ctypes.c_int64(len(data)),
+        ctypes.c_char(delimiter.encode()), ctypes.c_int64(n_cols),
+        _ptr(out, ctypes.c_double), ctypes.c_int64(max_rows))
+    if n < 0:
+        return None
+    return out[:n]

@@ -71,3 +71,94 @@ def test_swing_transform_uses_native(rng):
             item, score = pair.split(",")
             int(item)
             float(score)
+
+
+def test_csv_kernel_numeric_fast_path(tmp_path):
+    from flink_ml_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    out = native.csv_parse_numeric(b"1,2\n3,4\n", 2)
+    np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+    assert native.csv_parse_numeric(b"1,x\n", 2) is None  # fallback signal
+    assert native.csv_parse_numeric(b"1\n", 2) is None    # short row
+
+
+def test_table_csv_round_trip(tmp_path):
+    from flink_ml_tpu.common.table import Table
+
+    t = Table.from_columns(a=np.array([1.0, 2.0, 3.5]),
+                           b=np.array([4.0, 5.0, 6.0]))
+    p = tmp_path / "t.csv"
+    t.to_csv(str(p))
+    back = Table.from_csv(str(p))
+    assert back.column_names == ["a", "b"]
+    np.testing.assert_allclose(back["a"], t["a"])
+    np.testing.assert_allclose(back["b"], t["b"])
+
+
+def test_table_csv_mixed_columns(tmp_path):
+    from flink_ml_tpu.common.table import Table
+
+    p = tmp_path / "m.csv"
+    p.write_text("x,label\n1.5,cat\n2.5,dog\n")
+    t = Table.from_csv(str(p))
+    np.testing.assert_allclose(t["x"], [1.5, 2.5])
+    assert list(t["label"]) == ["cat", "dog"]
+
+    # no-header variant with generated names
+    p2 = tmp_path / "n.csv"
+    p2.write_text("1,2\n3,4\n")
+    t2 = Table.from_csv(str(p2), header=False)
+    assert t2.column_names == ["c0", "c1"]
+    np.testing.assert_allclose(t2["c0"], [1, 3])
+
+
+def test_table_csv_end_to_end_fit(tmp_path, rng):
+    """The full user path: csv file → Table → VectorAssembler → fit."""
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.classification import LogisticRegression
+    from flink_ml_tpu.models.feature import VectorAssembler
+
+    x = rng.normal(size=(100, 2))
+    y = (x @ [1.0, -1.0] > 0).astype(np.float64)
+    Table.from_columns(f1=x[:, 0], f2=x[:, 1], label=y).to_csv(
+        str(tmp_path / "train.csv"))
+
+    t = Table.from_csv(str(tmp_path / "train.csv"))
+    t = VectorAssembler(input_cols=["f1", "f2"],
+                        output_col="features").transform(t)[0]
+    model = LogisticRegression(max_iter=10, global_batch_size=50).fit(t)
+    out = model.transform(t)[0]
+    assert np.mean(out["prediction"] == t["label"]) > 0.9
+
+
+def test_csv_edge_cases(tmp_path):
+    from flink_ml_tpu import native
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.linalg import Vectors
+
+    if native.available():
+        # whitespace-only line must defer to the general parser, not be
+        # silently skipped by the fast path
+        assert native.csv_parse_numeric(b" \n5\n", 1) is None
+
+    # vector columns are rejected by to_csv
+    col = np.empty(1, dtype=object)
+    col[0] = Vectors.dense([1.0, 2.0])
+    with pytest.raises(ValueError, match="scalar"):
+        Table.from_columns(v=col).to_csv(str(tmp_path / "v.csv"))
+
+    # quoted header cell containing the delimiter
+    p = tmp_path / "q.csv"
+    p.write_text('"last,first",age\n1,2\n')
+    t = Table.from_csv(str(p))
+    assert t.column_names == ["last,first", "age"]
+    np.testing.assert_allclose(t["age"], [2.0])
+
+    # explicit names with header=True: header skipped, names honored
+    p2 = tmp_path / "h.csv"
+    p2.write_text("a,b\n1,2\n")
+    t2 = Table.from_csv(str(p2), names=["x", "y"])
+    assert t2.column_names == ["x", "y"]
+    np.testing.assert_allclose(t2["x"], [1.0])
