@@ -1,0 +1,72 @@
+"""One-shot TPU profiling for the headline bench path.
+
+Run on the real chip to localize where the KMeans-demo milliseconds go:
+dispatch latency, H2D/D2H transfer, the compiled Lloyd program at 1 vs 20
+rounds, and the end-to-end benchmark. Prints a timing table, then the
+bench.py JSON line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def t(label, fn, repeat=5):
+    fn()  # warm
+    best = min(_timed(fn) for _ in range(repeat))
+    print(f"{label:42s} {best * 1e3:8.2f} ms")
+    return best
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn())
+    return time.perf_counter() - t0
+
+
+def main():
+    print("devices:", jax.devices())
+    x_small = jnp.zeros(8)
+    f_triv = jax.jit(lambda v: v + 1)
+    t("trivial jit dispatch", lambda: f_triv(x_small))
+
+    host = np.random.default_rng(0).random((10000, 10)).astype(np.float32)
+    t("H2D 10k x 10 f32", lambda: jax.device_put(host))
+    dev = jax.device_put(host)
+    t("D2H 10k x 10 f32", lambda: np.asarray(dev))
+
+    from flink_ml_tpu.models.clustering.kmeans import _build_lloyd_program
+    from flink_ml_tpu.parallel.collective import shard_batch
+    from flink_ml_tpu.parallel.mesh import default_mesh
+
+    mesh = default_mesh()
+    xs, n = shard_batch(mesh, host)
+    init = jnp.asarray(host[:2])
+    for iters in (1, 2, 5, 20):
+        fit = _build_lloyd_program(mesh, "euclidean", iters)
+        t(f"lloyd program, {iters:2d} round(s)",
+          lambda fit=fit: fit(xs, jnp.int32(n), init))
+
+    from flink_ml_tpu.ops.losses import BinaryLogisticLoss
+    from flink_ml_tpu.ops.optimizer import SGD, SGDParams
+
+    y = (host @ np.arange(10) > 4.5).astype(np.float32)
+    sgd = SGD(SGDParams(max_iter=20, global_batch_size=1000))
+    t("sgd optimize 10k x 10, 20 rounds",
+      lambda: sgd.optimize(BinaryLogisticLoss(), np.zeros(10, np.float32),
+                           host, y)[0], repeat=3)
+
+    import bench
+
+    print("\nbench.py:")
+    t0 = time.perf_counter()
+    bench.main()
+    print(f"bench total wall: {time.perf_counter() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()
