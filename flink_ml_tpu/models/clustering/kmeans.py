@@ -119,12 +119,13 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
 
         centroids, counts, _ = jax.lax.while_loop(
             cond, step, (c0, jnp.zeros((k,), xl.dtype), jnp.int32(0)))
-        return centroids, counts
+        # one packed output = one device->host fetch for the whole fit
+        return jnp.concatenate([centroids, counts[:, None]], axis=1)
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, None), P(), P()),
-        out_specs=(P(), P()), check_vma=False))
+        out_specs=P(), check_vma=False))
 
 
 @functools.lru_cache(maxsize=32)
@@ -231,7 +232,8 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                                self._iteration_listeners):
             fit = _build_lloyd_program(mesh, self.distance_measure,
                                        self.max_iter)
-            centroids, counts = fit(xs, n_valid, jnp.asarray(init))
+            packed = np.asarray(fit(xs, n_valid, jnp.asarray(init)))
+            centroids, counts = packed[:, :-1], packed[:, -1]
         else:
 
             round_fn = _build_lloyd_round_program(mesh,
