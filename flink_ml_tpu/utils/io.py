@@ -77,6 +77,13 @@ def load_stage_params(path: str):
 
 def save_model_arrays(path: str, name: str, arrays: Dict[str, np.ndarray]) -> None:
     """Numeric model data under <path>/data (ref: saveModelData:298)."""
+    missing = [k for k, v in arrays.items() if v is None]
+    if missing:
+        # a None would silently pickle into an unloadable object array —
+        # fail at save time with the real cause instead
+        raise ValueError(
+            f"model has no model data (missing: {', '.join(missing)}); "
+            "fit it or set_model_data first")
     data_dir = os.path.join(path, "data")
     os.makedirs(data_dir, exist_ok=True)
     np.savez(os.path.join(data_dir, name + ".npz"),
