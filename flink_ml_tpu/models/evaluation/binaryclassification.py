@@ -77,18 +77,14 @@ class BinaryClassificationEvaluator(AlgoOperator, HasLabelCol,
         pos_sorted = labels[order].astype(np.float64)
         w_sorted = weights[order]
         w_neg_sorted = w_sorted * (1.0 - pos_sorted)
-        cum_neg = np.concatenate([[0.0], np.cumsum(w_neg_sorted)])
-        auc_num = 0.0
-        i = 0
-        while i < n:
-            j = i
-            while j + 1 < n and s_sorted[j + 1] == s_sorted[i]:
-                j += 1
-            tied_neg = cum_neg[j + 1] - cum_neg[i]
-            tied_pos_w = float((w_sorted[i:j + 1]
-                                * pos_sorted[i:j + 1]).sum())
-            auc_num += tied_pos_w * (cum_neg[i] + 0.5 * tied_neg)
-            i = j + 1
+        # collapse tie groups in one pass: per distinct score, positives
+        # count every strictly-lower negative fully and tied negatives half
+        starts = np.flatnonzero(
+            np.concatenate([[True], s_sorted[1:] != s_sorted[:-1]]))
+        grp_pos = np.add.reduceat(w_sorted * pos_sorted, starts)
+        grp_neg = np.add.reduceat(w_neg_sorted, starts)
+        neg_below = np.concatenate([[0.0], np.cumsum(grp_neg)[:-1]])
+        auc_num = float(np.sum(grp_pos * (neg_below + 0.5 * grp_neg)))
         auc_roc = (auc_num / (pos_total * neg_total)
                    if pos_total > 0 and neg_total > 0 else float("nan"))
 

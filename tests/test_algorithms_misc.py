@@ -141,6 +141,27 @@ def test_evaluator_matches_sklearn(rng):
     assert 0.5 < out["areaUnderLorenz"][0] < 1.0
 
 
+def test_evaluator_tie_heavy_and_weighted(rng):
+    """Tie groups are collapsed vectorized (np.add.reduceat) — exercise
+    heavy ties plus sample weights against sklearn's weighted AUC."""
+    from sklearn.metrics import roc_auc_score
+    n = 5000
+    scores = np.round(rng.random(n), 2)  # ~100 distinct values: dense ties
+    labels = (rng.random(n) < scores).astype(np.float64)
+    weights = rng.random(n) + 0.5
+    t = Table.from_columns(label=labels, rawPrediction=scores,
+                           weight=weights)
+    ev = BinaryClassificationEvaluator(weight_col="weight")
+    out = ev.transform(t)[0]
+    np.testing.assert_allclose(
+        out["areaUnderROC"][0],
+        roc_auc_score(labels, scores, sample_weight=weights), atol=1e-9)
+    # all-tied degenerate input: AUC must be exactly 0.5
+    t2 = Table.from_columns(label=labels, rawPrediction=np.full(n, 0.7))
+    out2 = BinaryClassificationEvaluator().transform(t2)[0]
+    np.testing.assert_allclose(out2["areaUnderROC"][0], 0.5, atol=1e-12)
+
+
 def test_evaluator_vector_raw_prediction(rng):
     from flink_ml_tpu.common.table import as_dense_vector_column
     labels = np.array([1.0, 0.0, 1.0, 0.0])
