@@ -18,11 +18,21 @@ import numpy as np
 from flink_ml_tpu.linalg.vectors import DenseVector, Vector, stack_vectors
 
 
+def _is_device_column(values) -> bool:
+    """A jax.Array column (device-resident, possibly sharded) — kept as-is so
+    chained device stages hand buffers to each other without a host
+    round-trip (see flink_ml_tpu.ops.columnar). Duck-typed to avoid
+    importing jax here."""
+    return (not isinstance(values, np.ndarray)
+            and hasattr(values, "ndim") and hasattr(values, "dtype")
+            and hasattr(values, "__array__"))
+
+
 def _as_column(values) -> np.ndarray:
     """Normalize a column. Numeric 2-D arrays are kept as-is — a (n, d) array
     IS a vector column (row i = vector i); this is the fast path that avoids
     materializing n DenseVector objects for large tables."""
-    if isinstance(values, np.ndarray):
+    if isinstance(values, np.ndarray) or _is_device_column(values):
         return values
     values = list(values)
     if values and isinstance(values[0], (Vector,)):
@@ -138,7 +148,7 @@ class Table:
         import csv as _csv
         names = self.column_names
         for name in names:
-            col = self._columns[name]
+            col = self._host_column(name)
             if col.ndim != 1 or (
                     col.dtype == object and len(col)
                     and isinstance(col[0], (Vector, list, tuple, np.ndarray))):
@@ -149,7 +159,7 @@ class Table:
             writer = _csv.writer(f, delimiter=delimiter)
             if header:
                 writer.writerow(names)
-            writer.writerows(zip(*(self._columns[n] for n in names)))
+            writer.writerows(zip(*(self._host_column(n) for n in names)))
 
     # -- schema / access -----------------------------------------------------
     @property
@@ -178,14 +188,29 @@ class Table:
 
     def vectors(self, name: str, dtype=np.float32) -> np.ndarray:
         """Column of vectors stacked into one (n, dim) array — the device
-        on-ramp; equivalent of the reference's Table→DataStream map."""
+        on-ramp; equivalent of the reference's Table→DataStream map.
+
+        A device-array column whose dtype already matches is returned
+        as-is (residency preserved for chained device stages — though
+        those normally use columnar.input_vectors directly). A device
+        column requested at a DIFFERENT dtype — typically a float64 fit
+        path downstream of a float32 device transform — is off-ramped to
+        a mutable host array at the requested precision, so fit-time
+        statistics keep their float64 contract.
+        """
         col = self.column(name)
+        if _is_device_column(col):
+            if col.dtype == np.dtype(dtype):
+                return col if col.ndim == 2 else col[:, None]
+            arr = np.asarray(col, dtype=dtype)
+            return arr[:, None] if arr.ndim == 1 else arr
         if col.dtype != object:
             arr = np.asarray(col, dtype=dtype)
             return arr[:, None] if arr.ndim == 1 else arr
         return stack_vectors(col, dtype=dtype)
 
     def scalars(self, name: str, dtype=np.float32) -> np.ndarray:
+        """Always a host numpy array (the off-ramp for scalar columns)."""
         return np.asarray(self.column(name), dtype=dtype)
 
     # -- functional ops ------------------------------------------------------
@@ -221,13 +246,17 @@ class Table:
                       for n in self.column_names})
 
     # -- row view (collect parity with table.execute().collect()) -----------
+    def _host_column(self, name: str) -> np.ndarray:
+        col = self._columns[name]
+        return np.asarray(col) if _is_device_column(col) else col
+
     def rows(self) -> List[tuple]:
         names = self.column_names
-        return [tuple(self._columns[n][i] for n in names)
-                for i in range(self._num_rows)]
+        cols = [self._host_column(n) for n in names]
+        return [tuple(c[i] for c in cols) for i in range(self._num_rows)]
 
     def to_dict(self) -> Dict[str, list]:
-        return {n: list(c) for n, c in self._columns.items()}
+        return {n: list(self._host_column(n)) for n in self._columns}
 
     def __repr__(self):
         return f"Table({self.column_names}, num_rows={self._num_rows})"

@@ -22,8 +22,11 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from flink_ml_tpu.api.stage import Estimator, Model
 from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.ops import columnar
 from flink_ml_tpu.params.param import BooleanParam, FloatParam, ParamValidators
 from flink_ml_tpu.params.shared import (
     HasInputCol,
@@ -34,7 +37,15 @@ from flink_ml_tpu.utils import io as rw
 
 
 class _VectorStatModelBase(Model, HasInputCol, HasOutputCol):
-    """A model holding named per-dimension stat arrays + an affine apply."""
+    """A model holding named per-dimension stat arrays + an affine apply.
+
+    The apply runs on device through the shared columnar path
+    (ops/columnar.py): ``_kernel`` is a class-level pure jnp function, stats
+    are replicated operands, boolean params are static jit arguments. The
+    output stays a (sharded) device array inside the Table so chained
+    stages skip the host round-trip. Fit-side statistics stay float64 host
+    numpy (docs/deviations.md: dtype policy).
+    """
 
     STAT_NAMES: Tuple[str, ...] = ()
 
@@ -44,16 +55,21 @@ class _VectorStatModelBase(Model, HasInputCol, HasOutputCol):
         for name, val in stats.items():
             setattr(self, name, None if val is None else np.asarray(val, np.float64))
 
-    def _apply(self, x: np.ndarray) -> np.ndarray:
+    @staticmethod
+    def _kernel(x, *args):
+        raise NotImplementedError
+
+    def _kernel_args(self) -> Tuple[tuple, tuple]:
+        """→ (replicated stat operands, static jit args)."""
         raise NotImplementedError
 
     def transform(self, table: Table) -> Tuple[Table]:
         if getattr(self, self.STAT_NAMES[0]) is None:
             raise ValueError(f"{type(self).__name__} has no model data")
-        # float64 numpy: these are memory-bound elementwise maps where the
-        # reference's double precision matters (mean-centering cancellation)
-        x = table.vectors(self.input_col, np.float64)
-        return (table.with_column(self.output_col, self._apply(x)),)
+        x = columnar.input_vectors(table, self.input_col)
+        consts, static = self._kernel_args()
+        out = columnar.apply(type(self)._kernel, x, consts, static)
+        return (table.with_column(self.output_col, out),)
 
     def set_model_data(self, model_data: Table):
         for name in self.STAT_NAMES:
@@ -90,12 +106,17 @@ class StandardScalerParams(HasInputCol, HasOutputCol):
 class StandardScalerModel(_VectorStatModelBase, StandardScalerParams):
     STAT_NAMES = ("mean", "std")
 
-    def _apply(self, x):
-        if self.with_mean:
-            x = x - self.mean
-        if self.with_std:
-            x = x / np.where(self.std > 0, self.std, 1.0)
+    @staticmethod
+    def _kernel(x, mean, std, with_mean, with_std):
+        if with_mean:
+            x = x - mean
+        if with_std:
+            x = x / jnp.where(std > 0, std, 1.0)
         return x
+
+    def _kernel_args(self):
+        return ((self.mean, self.std),
+                (bool(self.with_mean), bool(self.with_std)))
 
 
 class StandardScaler(Estimator, StandardScalerParams):
@@ -125,15 +146,18 @@ class MinMaxScalerParams(HasInputCol, HasOutputCol):
 class MinMaxScalerModel(_VectorStatModelBase, MinMaxScalerParams):
     STAT_NAMES = ("data_min", "data_max")
 
-    def _apply(self, x):
-        lo, hi = self.data_min, self.data_max
+    @staticmethod
+    def _kernel(x, lo, hi, out_min, out_max):
         span = hi - lo
-        out_min, out_max = self.min, self.max
-        return np.where(
+        return jnp.where(
             span > 0,
-            (x - lo) / np.where(span > 0, span, 1.0) * (out_max - out_min)
+            (x - lo) / jnp.where(span > 0, span, 1.0) * (out_max - out_min)
             + out_min,
             (out_min + out_max) / 2.0)  # constant dims map to midpoint
+
+    def _kernel_args(self):
+        return ((self.data_min, self.data_max,
+                 np.float32(self.min), np.float32(self.max)), ())
 
 
 class MinMaxScaler(Estimator, MinMaxScalerParams):
@@ -155,8 +179,12 @@ class MaxAbsScalerParams(HasInputCol, HasOutputCol):
 class MaxAbsScalerModel(_VectorStatModelBase, MaxAbsScalerParams):
     STAT_NAMES = ("max_abs",)
 
-    def _apply(self, x):
-        return x / np.where(self.max_abs > 0, self.max_abs, 1.0)
+    @staticmethod
+    def _kernel(x, max_abs):
+        return x / jnp.where(max_abs > 0, max_abs, 1.0)
+
+    def _kernel_args(self):
+        return ((self.max_abs,), ())
 
 
 class MaxAbsScaler(Estimator, MaxAbsScalerParams):
@@ -185,12 +213,17 @@ class RobustScalerParams(HasInputCol, HasOutputCol, HasRelativeError):
 class RobustScalerModel(_VectorStatModelBase, RobustScalerParams):
     STAT_NAMES = ("medians", "ranges")
 
-    def _apply(self, x):
-        if self.with_centering:
-            x = x - self.medians
-        if self.with_scaling:
-            x = x / np.where(self.ranges > 0, self.ranges, 1.0)
+    @staticmethod
+    def _kernel(x, medians, ranges, with_centering, with_scaling):
+        if with_centering:
+            x = x - medians
+        if with_scaling:
+            x = x / jnp.where(ranges > 0, ranges, 1.0)
         return x
+
+    def _kernel_args(self):
+        return ((self.medians, self.ranges),
+                (bool(self.with_centering), bool(self.with_scaling)))
 
 
 class RobustScaler(Estimator, RobustScalerParams):

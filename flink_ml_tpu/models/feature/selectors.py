@@ -47,8 +47,16 @@ class _IndexSelectorModelBase(Model):
     def transform(self, table: Table) -> Tuple[Table]:
         if self.indices is None:
             raise ValueError(f"{type(self).__name__} has no model data")
-        x = table.vectors(self._in_col, np.float64)
-        return (table.with_column(self._out_col, x[:, self.indices]),)
+        from flink_ml_tpu.models.feature.vectorops import _gather_cols_kernel
+        from flink_ml_tpu.ops import columnar
+        x = columnar.input_vectors(table, self._in_col)
+        if len(self.indices) and int(self.indices[-1]) >= x.shape[1]:
+            raise IndexError(  # device gather clamps instead of raising
+                f"selected index {int(self.indices[-1])} out of range for "
+                f"vectors of size {x.shape[1]}")
+        out = columnar.apply(_gather_cols_kernel, x, (),
+                             (tuple(int(i) for i in self.indices),))
+        return (table.with_column(self._out_col, out),)
 
     def set_model_data(self, model_data: Table):
         self.indices = np.asarray(

@@ -398,6 +398,10 @@ class IDFParams(IDFModelParams):
         "appear for filtering.", 0, ParamValidators.gt_eq(0))
 
 
+def _idf_kernel(x, idf):
+    return x * idf[None, :]
+
+
 class IDFModel(Model, IDFModelParams):
     def __init__(self, idf=None, doc_freq=None, num_docs=0, **kwargs):
         super().__init__(**kwargs)
@@ -409,8 +413,10 @@ class IDFModel(Model, IDFModelParams):
     def transform(self, table: Table) -> Tuple[Table]:
         if self.idf is None:
             raise ValueError("IDFModel has no model data")
-        x = table.vectors(self.input_col, np.float64)
-        return (table.with_column(self.output_col, x * self.idf[None, :]),)
+        from flink_ml_tpu.ops import columnar
+        x = columnar.input_vectors(table, self.input_col)
+        out = columnar.apply(_idf_kernel, x, (self.idf,))
+        return (table.with_column(self.output_col, out),)
 
     def set_model_data(self, model_data: Table):
         self.idf = model_data.vectors("idf", np.float64)[0]

@@ -2,7 +2,11 @@
 
 Ref parity: flink-ml-lib feature/{normalizer,elementwiseproduct,
 polynomialexpansion,dct,interaction,vectorassembler,vectorslicer,binarizer,
-bucketizer}/ — record-wise transforms, vectorized over the whole column.
+bucketizer}/ — record-wise transforms in the reference, here one jitted
+device program per op over the whole column (ops/columnar.py), outputs left
+device-resident for chained stages. VectorAssembler and the skip/error
+handle-invalid paths stay host-side (ragged checks and row drops are
+data-dependent shapes, hostile to XLA).
 """
 
 from __future__ import annotations
@@ -11,10 +15,12 @@ import itertools
 from typing import Tuple
 
 import numpy as np
-import scipy.fft
+
+import jax.numpy as jnp
 
 from flink_ml_tpu.api.stage import Transformer
 from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.ops import columnar
 from flink_ml_tpu.params.param import (
     BooleanParam,
     FloatArrayArrayParam,
@@ -35,19 +41,31 @@ from flink_ml_tpu.params.shared import (
 )
 
 
+def _normalizer_kernel(x, p):
+    if np.isinf(p):
+        norms = jnp.abs(x).max(axis=1)
+    elif p == 2.0:
+        norms = jnp.sqrt((x * x).sum(axis=1))
+    elif p == 1.0:
+        norms = jnp.abs(x).sum(axis=1)
+    else:
+        norms = (jnp.abs(x) ** p).sum(axis=1) ** (1.0 / p)
+    return x / jnp.where(norms > 0, norms, 1.0)[:, None]
+
+
 class Normalizer(Transformer, HasInputCol, HasOutputCol):
     """v → v/‖v‖_p (ref: feature/normalizer/Normalizer.java; p ≥ 1, default 2)."""
 
     P = FloatParam("p", "The p norm value.", 2.0, ParamValidators.gt_eq(1.0))
 
     def transform(self, table: Table) -> Tuple[Table]:
-        x = table.vectors(self.input_col, np.float64)
-        if np.isinf(self.p):
-            norms = np.abs(x).max(axis=1)
-        else:
-            norms = (np.abs(x) ** self.p).sum(axis=1) ** (1.0 / self.p)
-        out = x / np.where(norms > 0, norms, 1.0)[:, None]
+        x = columnar.input_vectors(table, self.input_col)
+        out = columnar.apply(_normalizer_kernel, x, (), (float(self.p),))
         return (table.with_column(self.output_col, out),)
+
+
+def _scale_kernel(x, s):
+    return x * s[None, :]
 
 
 class ElementwiseProduct(Transformer, HasInputCol, HasOutputCol):
@@ -58,9 +76,28 @@ class ElementwiseProduct(Transformer, HasInputCol, HasOutputCol):
     def transform(self, table: Table) -> Tuple[Table]:
         if self.scaling_vec is None:
             raise ValueError("scalingVec must be set")
-        x = table.vectors(self.input_col, np.float64)
-        s = self.scaling_vec.to_array()
-        return (table.with_column(self.output_col, x * s[None, :]),)
+        x = columnar.input_vectors(table, self.input_col)
+        out = columnar.apply(_scale_kernel, x,
+                             (self.scaling_vec.to_array(),), ())
+        return (table.with_column(self.output_col, out),)
+
+
+def _poly_kernel(x, degree):
+    """All monomials up to ``degree``, ordered by total degree then by
+    combination order. One gather + one multiply per degree LEVEL (each
+    level-k monomial = its level-(k-1) prefix times one feature), so the
+    traced program has O(degree) ops regardless of output width."""
+    d = x.shape[1]
+    level_combos = [list(itertools.combinations_with_replacement(range(d), 1))]
+    levels = [x]
+    for deg in range(2, degree + 1):
+        combos = list(itertools.combinations_with_replacement(range(d), deg))
+        prev_pos = {c: i for i, c in enumerate(level_combos[-1])}
+        prefix_idx = np.asarray([prev_pos[c[:-1]] for c in combos], np.int32)
+        feat_idx = np.asarray([c[-1] for c in combos], np.int32)
+        levels.append(levels[-1][:, prefix_idx] * x[:, feat_idx])
+        level_combos.append(combos)
+    return jnp.concatenate(levels, axis=1) if len(levels) > 1 else levels[0]
 
 
 class PolynomialExpansion(Transformer, HasInputCol, HasOutputCol):
@@ -72,25 +109,15 @@ class PolynomialExpansion(Transformer, HasInputCol, HasOutputCol):
                       ParamValidators.gt_eq(1))
 
     def transform(self, table: Table) -> Tuple[Table]:
-        x = table.vectors(self.input_col, np.float64)
-        n, d = x.shape
-        xT = np.ascontiguousarray(x.T)
-        combos = [c for deg in range(1, self.degree + 1)
-                  for c in itertools.combinations_with_replacement(
-                      range(d), deg)]
-        # each monomial = its degree-(k-1) prefix times one feature: one
-        # contiguous multiply per output column instead of rebuilding the
-        # product from scratch
-        out = np.empty((len(combos), n))
-        pos = {}
-        for k, combo in enumerate(combos):
-            if len(combo) == 1:
-                out[k] = xT[combo[0]]
-            else:
-                np.multiply(out[pos[combo[:-1]]], xT[combo[-1]], out=out[k])
-            pos[combo] = k
-        return (table.with_column(self.output_col,
-                                  np.ascontiguousarray(out.T)),)
+        x = columnar.input_vectors(table, self.input_col)
+        out = columnar.apply(_poly_kernel, x, (), (int(self.degree),))
+        return (table.with_column(self.output_col, out),)
+
+
+def _dct_kernel(x, inverse):
+    import jax.scipy.fft as jfft
+    fn = jfft.idct if inverse else jfft.dct
+    return fn(x, type=2, norm="ortho", axis=1)
 
 
 class DCT(Transformer, HasInputCol, HasOutputCol):
@@ -101,10 +128,16 @@ class DCT(Transformer, HasInputCol, HasOutputCol):
         "DCT (false).", False)
 
     def transform(self, table: Table) -> Tuple[Table]:
-        x = table.vectors(self.input_col, np.float64)
-        fn = scipy.fft.idct if self.inverse else scipy.fft.dct
-        out = fn(x, type=2, norm="ortho", axis=1)
+        x = columnar.input_vectors(table, self.input_col)
+        out = columnar.apply(_dct_kernel, x, (), (bool(self.inverse),))
         return (table.with_column(self.output_col, out),)
+
+
+def _interaction_kernel(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, :, None] * m[:, None, :]).reshape(out.shape[0], -1)
+    return out
 
 
 class Interaction(Transformer, HasInputCols, HasOutputCol):
@@ -115,12 +148,13 @@ class Interaction(Transformer, HasInputCols, HasOutputCol):
         mats = []
         for name in self.input_cols:
             col = table.column(name)
-            mats.append(table.vectors(name, np.float64)
-                        if col.dtype == object or col.ndim == 2
-                        else np.asarray(col, np.float64)[:, None])
-        out = mats[0]
-        for m in mats[1:]:
-            out = (out[:, :, None] * m[:, None, :]).reshape(out.shape[0], -1)
+            if columnar.is_device_array(col):
+                mats.append(col if col.ndim == 2 else col[:, None])
+            elif col.dtype == object or col.ndim == 2:
+                mats.append(table.vectors(name, np.float32))
+            else:
+                mats.append(np.asarray(col, np.float32)[:, None])
+        out = columnar.apply_multi(_interaction_kernel, mats)
         return (table.with_column(self.output_col, out),)
 
 
@@ -204,6 +238,10 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol,
         return (table.with_column(self.output_col, out),)
 
 
+def _gather_cols_kernel(x, idx):
+    return x[:, np.asarray(idx, np.int32)]
+
+
 class VectorSlicer(Transformer, HasInputCol, HasOutputCol):
     """Select sub-vector by indices (ref: feature/vectorslicer/)."""
 
@@ -215,8 +253,18 @@ class VectorSlicer(Transformer, HasInputCol, HasOutputCol):
         idx = np.asarray(self.indices, np.int64)
         if (idx < 0).any():
             raise ValueError("indices must be non-negative")
-        x = table.vectors(self.input_col, np.float64)
-        return (table.with_column(self.output_col, x[:, idx]),)
+        x = columnar.input_vectors(table, self.input_col)
+        if (idx >= x.shape[1]).any():  # device gather clamps; check on host
+            raise IndexError(
+                f"indices {idx[idx >= x.shape[1]].tolist()} out of range "
+                f"for vectors of size {x.shape[1]}")
+        out = columnar.apply(_gather_cols_kernel, x, (),
+                             (tuple(int(i) for i in idx),))
+        return (table.with_column(self.output_col, out),)
+
+
+def _binarize_kernel(x, thr):
+    return (x > thr).astype(jnp.float32)
 
 
 class Binarizer(Transformer, HasInputCols, HasOutputCols):
@@ -235,13 +283,25 @@ class Binarizer(Transformer, HasInputCols, HasOutputCols):
         for name, out_name, thr in zip(self.input_cols, self.output_cols,
                                        self.thresholds):
             col = table.column(name)
-            if col.dtype == object or col.ndim == 2:
-                out[out_name] = (table.vectors(name, np.float64)
-                                 > thr).astype(np.float64)
+            if columnar.is_device_array(col):
+                x = col  # keep its rank: scalar columns stay 1-D
+            elif col.dtype == object or col.ndim == 2:
+                x = columnar.input_vectors(table, name)
             else:
-                out[out_name] = (np.asarray(col, np.float64)
-                                 > thr).astype(np.float64)
+                x = columnar.input_scalars(table, name)
+            out[out_name] = columnar.apply(_binarize_kernel, x, (),
+                                           (float(thr),))
         return (table.with_columns(**out),)
+
+
+def _bucketize_kernel(x, splits):
+    n_splits = splits.shape[0]
+    bucket = jnp.searchsorted(splits, x, side="right") - 1
+    # the top boundary belongs to the last bucket
+    bucket = jnp.where(x == splits[-1], n_splits - 2, bucket)
+    invalid = (x < splits[0]) | (x > splits[-1]) | jnp.isnan(x)
+    bucket = jnp.where(invalid, n_splits - 1, bucket)
+    return bucket.astype(jnp.float32), invalid
 
 
 class Bucketizer(Transformer, HasInputCols, HasOutputCols, HasHandleInvalid):
@@ -259,7 +319,7 @@ class Bucketizer(Transformer, HasInputCols, HasOutputCols, HasHandleInvalid):
         splits_array = self.splits_array
         if splits_array is None or len(splits_array) != len(self.input_cols):
             raise ValueError("splitsArray must match inputCols length")
-        outs, invalid_any = {}, np.zeros(table.num_rows, bool)
+        outs, invalids = {}, []
         for name, out_name, splits in zip(self.input_cols, self.output_cols,
                                           splits_array):
             splits = np.asarray(splits, np.float64)
@@ -267,20 +327,26 @@ class Bucketizer(Transformer, HasInputCols, HasOutputCols, HasHandleInvalid):
                 raise ValueError(
                     f"splits for {name!r} must be strictly increasing with "
                     f"at least 3 points")
-            v = np.asarray(table.column(name), np.float64)
-            bucket = np.searchsorted(splits, v, side="right") - 1
-            # the top boundary belongs to the last bucket
-            bucket = np.where(v == splits[-1], len(splits) - 2, bucket)
-            invalid = (v < splits[0]) | (v > splits[-1]) | np.isnan(v)
-            bucket = np.where(invalid, len(splits) - 1, bucket)
-            invalid_any |= invalid
-            outs[out_name] = bucket.astype(np.float64)
-        if invalid_any.any():
-            if self.handle_invalid == self.ERROR_INVALID:
-                raise ValueError("invalid values encountered in Bucketizer "
-                                 "(handleInvalid=error)")
-            if self.handle_invalid == self.SKIP_INVALID:
+            if not (np.diff(splits.astype(np.float32)) > 0).all():
+                raise ValueError(
+                    f"splits for {name!r} collapse at float32 precision; "
+                    "the device bucketize computes in float32 (see "
+                    "docs/deviations.md) — widen the split gaps")
+            v = columnar.input_scalars(table, name)
+            bucket, invalid = columnar.apply(_bucketize_kernel, v, (splits,))
+            outs[out_name] = bucket
+            invalids.append(invalid)
+        if self.handle_invalid != self.KEEP_INVALID:
+            # skip/error need data-dependent row drops — host off-ramp
+            invalid_any = np.zeros(table.num_rows, bool)
+            for inv in invalids:
+                invalid_any |= np.asarray(inv)
+            if invalid_any.any():
+                if self.handle_invalid == self.ERROR_INVALID:
+                    raise ValueError(
+                        "invalid values encountered in Bucketizer "
+                        "(handleInvalid=error)")
                 keep = np.nonzero(~invalid_any)[0]
-                kept = {k: v[keep] for k, v in outs.items()}
+                kept = {k: np.asarray(v)[keep] for k, v in outs.items()}
                 return (table.take(keep).with_columns(**kept),)
         return (table.with_columns(**outs),)

@@ -1,0 +1,128 @@
+"""Shared device-side columnar transform path for dense feature ops.
+
+The ⚙ "compiled XLA" tier of SURVEY.md §2.1/§2.4: dense numeric feature
+transforms (scalers, IDF, Normalizer, ElementwiseProduct, PolynomialExpansion,
+DCT, Binarizer, Bucketizer, Interaction, slicers/selectors) run as one jitted
+elementwise/reduce program per op, with the (n, d) column sharded over the
+mesh's data axis and model statistics replicated. The reference runs these as
+per-record Java map functions (e.g. feature/standardscaler/
+StandardScalerModel.java); here one XLA program handles the whole column and
+fuses the elementwise chain.
+
+Residency: outputs are left as device arrays inside the Table, so chained
+Pipeline stages (scale → normalize → ...) hand sharded device buffers to one
+another with no host round-trip. The host off-ramp happens only when a
+consumer reads rows / converts to numpy.
+
+Dtype policy (documented deviation, docs/deviations.md): device transforms
+compute in float32 (TPU-native width; the MXU/VPU have no fast float64),
+while fit-time statistics stay float64 on host. The reference computes both
+in Java double.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from flink_ml_tpu.parallel.mesh import data_pspec, default_mesh
+
+
+def is_device_array(x) -> bool:
+    return isinstance(x, jax.Array)
+
+
+def to_device(x, mesh=None) -> jax.Array:
+    """Device on-ramp: shard dim 0 (rows) over the mesh's data axis.
+
+    Already-device arrays pass through untouched (chained stages keep their
+    residency and sharding). Host arrays are cast to float32 — see the
+    module dtype policy. Row counts that don't divide the shard count are
+    zero-padded for the transfer and sliced back on device (same recipe as
+    parallel.collective.shard_batch; elementwise transforms are unaffected
+    by padding rows, and the slice keeps the user-visible length exact).
+    """
+    if is_device_array(x):
+        return x
+    mesh = mesh or default_mesh()
+    x = np.asarray(x)
+    if x.dtype.kind == "f" and x.dtype != np.float32:
+        x = x.astype(np.float32)
+    from flink_ml_tpu.parallel.mesh import data_shard_count
+
+    n = x.shape[0]
+    pad = (-n) % data_shard_count(mesh)
+    if pad:
+        x = np.concatenate(
+            [x, np.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
+    spec = P(data_pspec(mesh), *([None] * (x.ndim - 1)))
+    arr = jax.device_put(x, NamedSharding(mesh, spec))
+    # A divisible row count (every benchmark shape, and always on a single
+    # chip) takes the clean path: sharded transfer, no slice. Uneven rows
+    # pay one on-device slice whose result XLA may replicate — correct but
+    # not bandwidth-optimal; acceptable for the odd-sized case.
+    return arr[:n] if pad else arr
+
+
+def replicated(c, mesh=None) -> jax.Array:
+    """Model statistics / constants: replicated on every device."""
+    mesh = mesh or default_mesh()
+    c = np.asarray(c)
+    if c.dtype.kind == "f" and c.dtype != np.float32:
+        c = c.astype(np.float32)
+    return jax.device_put(c, NamedSharding(mesh, P()))
+
+
+@lru_cache(maxsize=None)
+def _jitted(fn, n_static: int, n_args: int):
+    static = tuple(range(n_args - n_static, n_args))
+    return jax.jit(fn, static_argnums=static)
+
+
+def apply(fn, x, consts: Sequence = (), static: Tuple = ()):
+    """Run ``fn(x, *consts, *static)`` as one jitted program on device.
+
+    ``fn`` must be a module/class-level function of jnp ops (stable object
+    identity keys the jit cache). ``consts`` are replicated device operands
+    (model stats); ``static`` are hashable compile-time arguments (flags,
+    dims) that select the traced program.
+    """
+    return apply_multi(fn, (x,), consts, static)
+
+
+def apply_multi(fn, xs: Sequence, consts: Sequence = (), static: Tuple = ()):
+    """Like :func:`apply` but with several row-sharded inputs (e.g. the
+    Interaction op's input columns): ``fn(*xs, *consts, *static)``."""
+    mesh = default_mesh()
+    xs_d = tuple(to_device(x, mesh) for x in xs)
+    consts_d = tuple(replicated(c, mesh) for c in consts)
+    n_args = len(xs_d) + len(consts_d) + len(static)
+    return _jitted(fn, len(static), n_args)(*xs_d, *consts_d, *static)
+
+
+def input_vectors(table, col: str) -> jax.Array:
+    """Table → sharded (n, d) device array (the device on-ramp for vector
+    columns; passthrough when a previous stage already left the column on
+    device)."""
+    raw = table.column(col)
+    if is_device_array(raw):
+        return raw if raw.ndim == 2 else raw[:, None]
+    return to_device(table.vectors(col, np.float32))
+
+
+def input_scalars(table, col: str) -> jax.Array:
+    raw = table.column(col)
+    if is_device_array(raw):
+        return raw
+    return to_device(table.scalars(col, np.float32))
+
+
+def to_host(x) -> np.ndarray:
+    """Explicit off-ramp (one D2H transfer)."""
+    return np.asarray(x)

@@ -1,0 +1,166 @@
+"""Device columnar transform path (flink_ml_tpu.ops.columnar).
+
+The ⚙ compiled-XLA tier of SURVEY.md §2.1/§2.4 for dense feature ops: one
+jitted program per op, rows sharded over the data axis, outputs left
+device-resident so chained stages skip the host round-trip.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from flink_ml_tpu.common.table import Table
+from flink_ml_tpu.models.feature import (
+    Binarizer,
+    Bucketizer,
+    MinMaxScaler,
+    Normalizer,
+    PolynomialExpansion,
+    StandardScaler,
+)
+from flink_ml_tpu.ops import columnar
+
+
+def test_apply_uneven_rows_shard_and_slice(rng):
+    """Row counts not divisible by the shard count still produce exact
+    results (padded transfer + on-device slice)."""
+    x = rng.random((1001, 5))
+
+    def double(v):
+        return v * 2.0
+
+    out = columnar.apply(double, x)
+    assert isinstance(out, jax.Array)
+    assert out.shape == (1001, 5)
+    np.testing.assert_allclose(np.asarray(out), x * 2.0, rtol=1e-6)
+
+
+def test_chained_stages_stay_on_device(rng):
+    """scale → normalize: the intermediate column is a device array and the
+    second stage consumes it without converting to numpy."""
+    x = rng.random((64, 6))
+    t = Table.from_columns(features=x)
+    model = StandardScaler(input_col="features", output_col="scaled") \
+        .fit(t)
+    t2 = model.transform(t)[0]
+    assert columnar.is_device_array(t2.column("scaled"))
+
+    t3 = Normalizer(input_col="scaled", output_col="normed").transform(t2)[0]
+    assert columnar.is_device_array(t3.column("normed"))
+    out = np.asarray(t3.column("normed"))
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+
+    # reference math end-to-end in one go
+    ref = x / x.std(axis=0, ddof=1)
+    ref = ref / np.linalg.norm(ref, axis=1, keepdims=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_device_columns_roundtrip_through_table(rng):
+    """rows()/to_dict()/take()/concat keep working when a column is a
+    device array."""
+    x = rng.random((10, 3))
+    t = Table.from_columns(features=x)
+    t2 = MinMaxScaler(input_col="features", output_col="out") \
+        .fit(t).transform(t)[0]
+    col = t2.column("out")
+    assert columnar.is_device_array(col)
+    assert len(t2.rows()) == 10
+    assert len(t2.to_dict()["out"]) == 10
+    taken = t2.take(np.asarray([1, 3, 5]))
+    assert taken.num_rows == 3
+    both = t2.concat(t2)
+    assert both.num_rows == 20
+    np.testing.assert_allclose(np.asarray(both.column("out"))[:10],
+                               np.asarray(col), rtol=1e-6)
+
+
+def test_polynomial_expansion_device_matches_host_ordering(rng):
+    """The level-wise device expansion preserves the reference monomial
+    ordering (by total degree, then combination order)."""
+    import itertools
+    x = rng.random((7, 3))
+    out = np.asarray(PolynomialExpansion(
+        input_col="v", output_col="o", degree=3).transform(
+            Table.from_columns(v=x))[0]["o"])
+    combos = [c for deg in range(1, 4)
+              for c in itertools.combinations_with_replacement(range(3), deg)]
+    expected = np.stack([np.prod(x[:, list(c)], axis=1) for c in combos],
+                        axis=1)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_binarizer_scalar_and_vector_device(rng):
+    t = Table.from_columns(s=np.asarray([0.1, 0.9, 0.5]),
+                           v=rng.random((3, 4)))
+    out = Binarizer(input_cols=["s", "v"], output_cols=["so", "vo"],
+                    thresholds=[0.5, 0.5]).transform(t)[0]
+    assert columnar.is_device_array(out.column("so"))
+    np.testing.assert_array_equal(np.asarray(out["so"]), [0.0, 1.0, 0.0])
+    assert np.asarray(out["vo"]).shape == (3, 4)
+
+
+def test_float64_fit_downstream_of_device_stage(rng):
+    """A float32 device column flowing into a float64 fit path is widened
+    on the host off-ramp, keeping cancellation-prone statistics exact
+    (large-mean data would collapse std to 0 in float32)."""
+    from flink_ml_tpu.linalg.vectors import DenseVector
+    from flink_ml_tpu.models.feature import ElementwiseProduct
+    x = rng.normal(30000.0, 1.0, (2000, 3))
+    t = Table.from_columns(v=x)
+    t2 = ElementwiseProduct(input_col="v", output_col="w",
+                            scaling_vec=DenseVector(np.ones(3))) \
+        .transform(t)[0]
+    m = StandardScaler(input_col="w", output_col="o").fit(t2)
+    assert np.all(m.std > 0.5)
+
+
+def test_host_ops_survive_device_input(rng):
+    """Host-side ops that mutate their input (VectorIndexer) get a mutable
+    host copy from vectors(), not the immutable device array."""
+    from flink_ml_tpu.models.feature import VectorIndexer
+    x = np.round(rng.random((20, 3)) * 3)
+    t = Table.from_columns(v=x)
+    t2 = Normalizer(input_col="v", output_col="w").transform(t)[0]
+    model = VectorIndexer(input_col="w", output_col="idx",
+                          max_categories=50).fit(t2)
+    out = model.transform(t2)[0]
+    assert out.column("idx") is not None
+
+
+def test_slicer_out_of_range_raises(rng):
+    from flink_ml_tpu.models.feature import VectorSlicer
+    t = Table.from_columns(v=rng.random((4, 3)))
+    with pytest.raises(IndexError):
+        VectorSlicer(input_col="v", output_col="s",
+                     indices=[0, 5]).transform(t)
+
+
+def test_binarizer_scalar_rank_stable_after_device_stage():
+    """A 1-D device scalar column keeps rank 1 through Binarizer (no
+    silent (n,1) promotion depending on pipeline placement)."""
+    t = Table.from_columns(a=np.asarray([-0.5, 0.1, 1.5, 0.7]))
+    b1 = Bucketizer(input_cols=["a"], output_cols=["bk"],
+                    splits_array=[[0.0, 0.5, 1.0]],
+                    handle_invalid="keep").transform(t)[0]
+    assert columnar.is_device_array(b1.column("bk"))
+    out = Binarizer(input_cols=["bk"], output_cols=["bin"],
+                    thresholds=[0.5]).transform(b1)[0]
+    assert np.asarray(out["bin"]).shape == (4,)
+
+
+def test_bucketizer_device_keep_and_skip():
+    t = Table.from_columns(a=np.asarray([-0.5, 0.1, 1.5, np.nan]))
+    keep = Bucketizer(input_cols=["a"], output_cols=["b"],
+                      splits_array=[[0.0, 0.5, 1.0]],
+                      handle_invalid="keep").transform(t)[0]
+    np.testing.assert_array_equal(np.asarray(keep["b"]), [2, 0, 2, 2])
+    skip = Bucketizer(input_cols=["a"], output_cols=["b"],
+                      splits_array=[[0.0, 0.5, 1.0]],
+                      handle_invalid="skip").transform(t)[0]
+    assert skip.num_rows == 1
+    with pytest.raises(ValueError):
+        Bucketizer(input_cols=["a"], output_cols=["b"],
+                   splits_array=[[0.0, 0.5, 1.0]],
+                   handle_invalid="error").transform(t)

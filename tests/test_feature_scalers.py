@@ -33,7 +33,7 @@ def test_standard_scaler(xtable):
     # withMean too
     model.set_with_mean(True)
     out = model.transform(table)[0]["output"]
-    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-9)
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-5)
     np.testing.assert_allclose(out.std(axis=0, ddof=1), 1.0, rtol=1e-6)
 
 
@@ -81,7 +81,7 @@ def test_max_abs_scaler(xtable):
     table, x = xtable
     model = MaxAbsScaler().fit(table)
     out = model.transform(table)[0]["output"]
-    np.testing.assert_allclose(out, x / np.abs(x).max(axis=0), rtol=1e-12)
+    np.testing.assert_allclose(out, x / np.abs(x).max(axis=0), rtol=1e-5)
     assert np.abs(out).max() <= 1.0 + 1e-12
 
 
