@@ -1,0 +1,112 @@
+"""CSR batching for sparse vector columns.
+
+Ref parity: the reference trains/predicts on `SparseVector` input without
+densifying — BLAS.hDot (flink-ml-servable-core/.../linalg/BLAS.java:78)
+and the sparse gradient branch of FTRL
+(OnlineLogisticRegression.java:364-388). A HashingTF/FeatureHasher column
+at the default 2^18 dims would blow up memory if stacked dense
+(10M rows × 262144 × 8B ≈ 20 TB); this module keeps such columns in host
+CSR form end-to-end: one matrix for the whole column, matvecs through
+scipy's C kernels, per-coordinate scatters via np.bincount.
+
+Device offload note: the FTRL/SGD math on CSR is host-side by design
+(SURVEY.md §7 "Ragged/sparse ETL ops") — XLA wants static shapes and these
+batches' nnz varies per round; the dense model-update vector (d ≤ a few
+hundred thousand) is cheap on host. docs/deviations.md is not affected:
+sparse semantics match the reference exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.linalg.vectors import SparseVector, Vector
+
+
+def is_sparse_column(col) -> bool:
+    """True for an object column holding at least one SparseVector row.
+
+    The reference dispatches per row (``instanceof SparseVector``,
+    OnlineLogisticRegression.java:375); a column with any sparse row takes
+    the CSR path here — the scan short-circuits at the first sparse row.
+    """
+    return (getattr(col, "dtype", None) == object and len(col) > 0
+            and isinstance(col[0], Vector)
+            and any(isinstance(v, SparseVector) for v in col))
+
+
+def _row_parts(v):
+    if isinstance(v, SparseVector):
+        return v.indices, v.values
+    arr = v.to_array() if isinstance(v, Vector) else np.asarray(v)
+    return np.arange(arr.shape[0], dtype=np.int64), arr
+
+
+def column_to_csr(col, dtype=np.float64):
+    """Object column of Vectors → one scipy CSR matrix (n, size).
+
+    One concatenate over the per-row index/value arrays; no per-element
+    Python beyond the row loop the column already implies. Dense rows in a
+    mixed column become fully-present sparse rows (every coordinate
+    listed), so their gradient contribution matches the reference's dense
+    branch; their FTRL weightSum contribution uses the row weight at every
+    coordinate (the reference adds 1.0 — see docs/deviations.md only if a
+    weighted mixed column ever matters; unweighted they coincide). Row
+    sizes must agree; a mismatch raises instead of silently scattering out
+    of bounds.
+    """
+    import scipy.sparse as sp
+
+    n = len(col)
+    parts = [_row_parts(v) for v in col]
+    size = int(col[0].size if isinstance(col[0], Vector)
+               else len(parts[0][1]))
+    for i, v in enumerate(col):
+        vsize = int(v.size if isinstance(v, Vector) else len(parts[i][1]))
+        if vsize != size:
+            raise ValueError(
+                f"row {i} has size {vsize}, expected {size} (ragged vector "
+                "column cannot form a CSR batch)")
+    nnz = np.fromiter((len(p[0]) for p in parts), np.int64, n)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(nnz, out=indptr[1:])
+    if indptr[-1]:
+        indices = np.concatenate([p[0] for p in parts])
+        data = np.concatenate([p[1] for p in parts]).astype(dtype)
+    else:
+        indices = np.zeros(0, np.int64)
+        data = np.zeros(0, dtype)
+    return sp.csr_matrix((data, indices, indptr), shape=(n, size))
+
+
+def csr_to_column(matrix) -> np.ndarray:
+    """CSR matrix → object column of SparseVectors (the inverse off-ramp)."""
+    m = matrix.tocsr()
+    n, size = m.shape
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        lo, hi = m.indptr[i], m.indptr[i + 1]
+        out[i] = SparseVector._unchecked(
+            size, m.indices[lo:hi].astype(np.int64),
+            m.data[lo:hi].astype(np.float64))
+    return out
+
+
+def features_matrix(table, col_name: str, dtype=np.float32):
+    """Table column → dense (n, d) array OR scipy CSR, preserving sparsity.
+
+    The shared Table→trainer boundary for fits/predicts that support both
+    representations (linear models, FTRL). ``dtype`` applies to the dense
+    branch only; the CSR branch is always float64 — its math runs on host
+    where float64 is free and matches the reference's double precision.
+    """
+    col = table.column(col_name)
+    if is_sparse_column(col):
+        return column_to_csr(col, dtype=np.float64)
+    return table.vectors(col_name, dtype)
+
+
+def is_csr(x) -> bool:
+    import scipy.sparse as sp
+
+    return sp.issparse(x)

@@ -37,9 +37,12 @@ from flink_ml_tpu.utils import io as rw
 
 def extract_labeled_points(stage, table: Table
                            ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
-    """Table → (features (n,d), labels (n,), weights (n,)|None) — the
-    reference's Table→LabeledPointWithWeight map (LogisticRegression.java:72-99)."""
-    x = table.vectors(stage.features_col)
+    """Table → (features (n,d) dense or CSR, labels (n,), weights (n,)|None)
+    — the reference's Table→LabeledPointWithWeight map
+    (LogisticRegression.java:72-99). A SparseVector column stays CSR so
+    wide hashed features (2^18 dims) never densify (ref BLAS.java:78)."""
+    from flink_ml_tpu.linalg import sparse
+    x = sparse.features_matrix(table, stage.features_col)
     y = table.scalars(stage.label_col)
     w = None
     if stage.weight_col is not None and stage.weight_col in table:
@@ -77,10 +80,16 @@ class LinearModelBase(Model, LinearTrainParams):
     def transform(self, table: Table) -> Tuple[Table]:
         if self.coefficients is None:
             raise ValueError(f"{type(self).__name__} has no model data")
-        x = table.vectors(self.features_col)
-        dots = np.asarray(_dots(jnp.asarray(x),
-                                jnp.asarray(self.coefficients, jnp.float32)),
-                          np.float64)
+        from flink_ml_tpu.linalg import sparse
+        x = sparse.features_matrix(table, self.features_col)
+        if sparse.is_csr(x):
+            # sparse predict stays host CSR (ref BLAS.hDot): one matvec
+            dots = np.asarray(x @ np.asarray(self.coefficients, np.float64))
+        else:
+            dots = np.asarray(
+                _dots(jnp.asarray(x),
+                      jnp.asarray(self.coefficients, jnp.float32)),
+                np.float64)
         return (table.with_columns(**self._predict_columns(dots)),)
 
     # -- model data as a Table (ref: XxxModelData POJO + table) -------------
@@ -127,6 +136,7 @@ class LinearEstimatorBase(Estimator, LinearTrainParams,
     model_class = None
 
     def fit(self, table: Table):
+        from flink_ml_tpu.linalg import sparse
         x, y, w = extract_labeled_points(self, table)
         params = SGDParams(
             learning_rate=self.learning_rate,
@@ -134,10 +144,19 @@ class LinearEstimatorBase(Estimator, LinearTrainParams,
             max_iter=self.max_iter, tol=self.tol, reg=self.reg,
             elastic_net=self.elastic_net)
         init = np.zeros(x.shape[1], np.float32)
-        coeffs, _ = SGD(params).optimize(
-            self.loss, init, x, y, w,
-            config=self._iteration_config,
-            listeners=self._iteration_listeners)
+        if sparse.is_csr(x):
+            if self._iteration_config is not None or \
+                    self._iteration_listeners:
+                raise NotImplementedError(
+                    "host-mode iteration (checkpointing/listeners) is not "
+                    "supported on the sparse CSR training path; densify "
+                    "the features or drop the iteration config")
+            coeffs, _ = SGD(params).optimize_csr(self.loss, init, x, y, w)
+        else:
+            coeffs, _ = SGD(params).optimize(
+                self.loss, init, x, y, w,
+                config=self._iteration_config,
+                listeners=self._iteration_listeners)
         model = self.model_class(coefficients=coeffs)
         return self.copy_params_to(model)
 

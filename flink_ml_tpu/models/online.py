@@ -107,8 +107,9 @@ class OnlineLogisticRegressionModel(Model,
         if self.coefficients is None:
             raise ValueError(
                 "OnlineLogisticRegressionModel has no model data")
-        x = table.vectors(self.features_col, np.float64)
-        dots = x @ self.coefficients
+        from flink_ml_tpu.linalg import sparse
+        x = sparse.features_matrix(table, self.features_col, np.float64)
+        dots = np.asarray(x @ self.coefficients)
         prob = 1.0 / (1.0 + np.exp(-dots))
         return (table.with_columns(**{
             self.prediction_col: (dots >= 0).astype(np.float64),
@@ -264,14 +265,38 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
             version = int(version)
             history[:] = [(int(v), c) for v, c in zip(hv, hc)]
 
+        from flink_ml_tpu.linalg import sparse
+
         for batch in _as_stream(data, self.global_batch_size):
-            x = batch.vectors(self.features_col, np.float64)
+            x = sparse.features_matrix(batch, self.features_col, np.float64)
             y = batch.scalars(self.label_col, np.float64)
-            p = 1.0 / (1.0 + np.exp(-(x @ coeffs)))
-            # dense-path reference semantics: unweighted per-coordinate
-            # gradient, weight sum counts every sample at every coordinate
-            grad = ((p - y)[:, None] * x).sum(axis=0)
-            weight_sum = np.full_like(grad, len(y), np.float64)
+            if sparse.is_csr(x):
+                # sparse branch (ref CalculateLocalGradient:364-388): the
+                # gradient and the weight sum accumulate ONLY at a sample's
+                # non-zero coordinates; weightSum adds the sample weight
+                # there (dense adds 1.0 everywhere). Never densifies: CSR
+                # matvec + bincount scatter at 2^18 dims stays O(nnz).
+                w_col = (batch.scalars(self.weight_col, np.float64)
+                         if self.weight_col is not None
+                         and self.weight_col in batch
+                         else np.ones(x.shape[0], np.float64))
+                p = 1.0 / (1.0 + np.exp(-(x @ coeffs)))
+                row_nnz = np.diff(x.indptr)
+                d = x.shape[1]
+                grad = np.bincount(
+                    x.indices,
+                    weights=x.data * np.repeat(p - y, row_nnz),
+                    minlength=d)
+                weight_sum = np.bincount(
+                    x.indices, weights=np.repeat(w_col, row_nnz),
+                    minlength=d)
+            else:
+                p = 1.0 / (1.0 + np.exp(-(x @ coeffs)))
+                # dense-path reference semantics: unweighted per-coordinate
+                # gradient, weight sum counts every sample at every
+                # coordinate (CalculateLocalGradient:376-380)
+                grad = ((p - y)[:, None] * x).sum(axis=0)
+                weight_sum = np.full_like(grad, len(y), np.float64)
             g = np.where(weight_sum != 0, grad / np.where(weight_sum != 0,
                                                           weight_sum, 1), 0)
             sigma = (np.sqrt(n + g * g) - np.sqrt(n)) / alpha

@@ -31,8 +31,10 @@ class LossFunc:
 
     NAME = None
 
-    def terms(self, dots, labels, weights):
-        """(b,) margins → (scalar loss sum, (b,) gradient multipliers)."""
+    def terms(self, dots, labels, weights, xp=jnp):
+        """(b,) margins → (scalar loss sum, (b,) gradient multipliers).
+        ``xp`` picks the array backend: jnp inside compiled programs
+        (default), np for the float64 host CSR fallback."""
         raise NotImplementedError
 
     def loss_and_gradient(self, coeffs, features, labels, weights):
@@ -55,12 +57,12 @@ class BinaryLogisticLoss(LossFunc):
 
     NAME = "logistic"
 
-    def terms(self, dots, labels, weights):
+    def terms(self, dots, labels, weights, xp=jnp):
         label_scaled = 2.0 * labels - 1.0
         margins = dots * label_scaled
         # log1p(exp(-m)) with the standard overflow-safe rewrite
-        loss = jnp.sum(weights * (jnp.logaddexp(0.0, -margins)))
-        multipliers = weights * (-label_scaled / (jnp.exp(margins) + 1.0))
+        loss = xp.sum(weights * (xp.logaddexp(0.0, -margins)))
+        multipliers = weights * (-label_scaled / (xp.exp(margins) + 1.0))
         return loss, multipliers
 
 
@@ -70,10 +72,10 @@ class HingeLoss(LossFunc):
 
     NAME = "hinge"
 
-    def terms(self, dots, labels, weights):
+    def terms(self, dots, labels, weights, xp=jnp):
         label_scaled = 2.0 * labels - 1.0
         hinge = 1.0 - label_scaled * dots
-        loss = jnp.sum(weights * jnp.maximum(hinge, 0.0))
+        loss = xp.sum(weights * xp.maximum(hinge, 0.0))
         active = (hinge > 0.0).astype(dots.dtype)
         multipliers = -label_scaled * weights * active
         return loss, multipliers
@@ -84,7 +86,7 @@ class LeastSquareLoss(LossFunc):
 
     NAME = "least_square"
 
-    def terms(self, dots, labels, weights):
+    def terms(self, dots, labels, weights, xp=jnp):
         err = dots - labels
-        loss = jnp.sum(weights * 0.5 * err * err)
+        loss = xp.sum(weights * 0.5 * err * err)
         return loss, weights * err

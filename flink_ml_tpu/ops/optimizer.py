@@ -196,6 +196,63 @@ class SGD:
     def __init__(self, params: SGDParams):
         self.params = params
 
+    def optimize_csr(self, loss_func: LossFunc, init_coeffs: np.ndarray,
+                     features_csr, labels: np.ndarray,
+                     weights: Optional[np.ndarray] = None,
+                     mesh: Optional[Mesh] = None):
+        """Host CSR fallback for wide sparse input (HashingTF at 2^18 dims
+        would need terabytes dense — ref trains SparseVector natively,
+        OnlineLogisticRegression.java:364-388 / BLAS.java:78).
+
+        Mirrors ``_sgd_round_math`` exactly — the same contiguous-chunk
+        sharding as ``shard_batch`` (p padded shards of length ⌈n/p⌉), the
+        same per-task batch share/clip/wrap (SGD.java:206-213,262-284) and
+        the same update/termination — so sparse and dense fits agree on
+        small dims (parity-tested). Math in float64 on host; gradients via
+        scipy's CSR matvec kernels.
+        """
+        prm = self.params
+        mesh = mesh or default_mesh()
+        p = data_shard_count(mesh)
+        n, d = features_csr.shape
+        ls = -(-n // p) if n else 1  # padded local length (shard_batch)
+        lb_base, lb_rem = prm.global_batch_size // p, \
+            prm.global_batch_size % p
+        y = np.asarray(labels, np.float64)
+        w = (np.ones(n, np.float64) if weights is None
+             else np.asarray(weights, np.float64))
+        coeffs = np.asarray(init_coeffs, np.float64).copy()
+        offsets = np.zeros(p, np.int64)
+        mean_loss = np.inf
+        X = features_csr.tocsr()
+
+        for _ in range(prm.max_iter):
+            row_parts = []
+            for s in range(p):
+                lb = min(lb_base + (1 if s < lb_rem else 0), ls)
+                rel = np.arange(lb)
+                idx = offsets[s] + rel
+                gidx = s * ls + idx[idx < ls]  # clip at shard end
+                row_parts.append(gidx[gidx < n])  # padding rows weigh 0
+                offsets[s] = 0 if offsets[s] + lb >= ls else offsets[s] + lb
+            rows = np.concatenate(row_parts)
+            Xb, yb, wb = X[rows], y[rows], w[rows]
+            dots = Xb @ coeffs
+            loss_sum, multipliers = loss_func.terms(dots, yb, wb, xp=np)
+            loss_sum = float(loss_sum)
+            grad = Xb.T @ np.asarray(multipliers, np.float64)
+            total_w = float(wb.sum())
+            if total_w > 0:
+                updated = coeffs - (prm.learning_rate
+                                    / max(total_w, 1e-30)) * grad
+                updated, _ = regularize(updated, prm.reg, prm.elastic_net,
+                                        prm.learning_rate, xp=np)
+                coeffs = np.asarray(updated, np.float64)
+            mean_loss = loss_sum / max(total_w, 1e-30)
+            if mean_loss < prm.tol:
+                break
+        return coeffs, float(mean_loss)
+
     def optimize(self, loss_func: LossFunc, init_coeffs: np.ndarray,
                  features: np.ndarray, labels: np.ndarray,
                  weights: Optional[np.ndarray] = None,

@@ -12,24 +12,27 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def regularize(coeffs, reg: float, elastic_net: float, learning_rate: float):
+def regularize(coeffs, reg: float, elastic_net: float, learning_rate: float,
+               xp=jnp):
     """Returns (new_coeffs, reg_loss). Pure function of the coefficient
-    vector; all branches are trace-time Python on static params."""
+    vector; all branches are trace-time Python on static params. ``xp``
+    selects the array backend: jnp inside compiled programs (default), np
+    for the float64 host CSR fallback (jnp would downcast to float32)."""
     if reg == 0.0:
-        return coeffs, jnp.zeros((), coeffs.dtype)
+        return coeffs, xp.zeros((), coeffs.dtype)
     if elastic_net == 0.0:
         # pure L2 (ref lines 55-59)
-        loss = reg / 2.0 * jnp.linalg.norm(coeffs)
+        loss = reg / 2.0 * xp.linalg.norm(coeffs)
         return coeffs * (1.0 - learning_rate * reg), loss
     if elastic_net == 1.0:
         # pure L1 (ref lines 60-73): skip exact zeros
-        sign = jnp.sign(coeffs)
-        loss = jnp.sum(elastic_net * reg * sign)
+        sign = xp.sign(coeffs)
+        loss = xp.sum(elastic_net * reg * sign)
         new = coeffs - learning_rate * elastic_net * reg * sign
         return new, loss
     # elastic net (ref lines 74-90)
-    sign = jnp.sign(coeffs)
-    loss = jnp.sum(elastic_net * reg * sign
+    sign = xp.sign(coeffs)
+    loss = xp.sum(elastic_net * reg * sign
                    + (1.0 - elastic_net) * (reg / 2.0) * coeffs * coeffs)
     new = coeffs - learning_rate * (elastic_net * reg * sign
                                     + (1.0 - elastic_net) * reg * coeffs)
