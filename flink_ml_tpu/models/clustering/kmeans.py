@@ -33,7 +33,7 @@ from flink_ml_tpu.api.stage import Estimator, Model
 from flink_ml_tpu.common.table import Table, as_dense_vector_column
 from flink_ml_tpu.linalg.distance import DistanceMeasure
 from flink_ml_tpu.linalg.vectors import DenseVector
-from flink_ml_tpu.parallel.collective import local_valid_mask, shard_batch
+from flink_ml_tpu.parallel.collective import ensure_on_mesh, local_valid_mask
 from flink_ml_tpu.parallel.mesh import data_axes, data_pspec, default_mesh
 from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam
 from flink_ml_tpu.params.shared import (
@@ -221,7 +221,9 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
 
         mesh = default_mesh()
         axes = data_axes(mesh)
-        xs, _ = shard_batch(mesh, np.asarray(x, np.float32), axes)
+        # device-resident input (device datagen / upstream device stage)
+        # never leaves HBM; host input is cast+placed once
+        xs, _ = ensure_on_mesh(mesh, x, axes, jnp.float32)
         # padded rows must not join any cluster: the validity mask is
         # derived on-device from the scalar n (no (n,) mask transfer)
         n_valid = jnp.int32(n)

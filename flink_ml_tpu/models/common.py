@@ -42,11 +42,18 @@ def extract_labeled_points(stage, table: Table
     (LogisticRegression.java:72-99). A SparseVector column stays CSR so
     wide hashed features (2^18 dims) never densify (ref BLAS.java:78)."""
     from flink_ml_tpu.linalg import sparse
+
+    def scalar_col(name):
+        # a device-resident scalar column (device datagen / upstream device
+        # stage) keeps its residency; the trainer reshards it in place
+        col = table.column(name)
+        return col if isinstance(col, jax.Array) else table.scalars(name)
+
     x = sparse.features_matrix(table, stage.features_col)
-    y = table.scalars(stage.label_col)
+    y = scalar_col(stage.label_col)
     w = None
     if stage.weight_col is not None and stage.weight_col in table:
-        w = table.scalars(stage.weight_col)
+        w = scalar_col(stage.weight_col)
     return x, y, w
 
 

@@ -49,7 +49,7 @@ from flink_ml_tpu.parallel.mesh import (
     default_mesh,
     model_axis_of,
 )
-from flink_ml_tpu.parallel.collective import shard_batch
+from flink_ml_tpu.parallel.collective import ensure_on_mesh, ones_on_mesh
 
 
 @dataclasses.dataclass(frozen=True)
@@ -269,24 +269,21 @@ class SGD:
         mesh = mesh or default_mesh()
         n = features.shape[0]
         d = features.shape[1]
-        if weights is None:
-            weights = np.ones(n, dtype=np.float32)
 
         axes = data_axes(mesh)
-        features = np.asarray(features, np.float32)
         init_coeffs = np.asarray(init_coeffs)
         tp = model_axis_of(mesh) is not None
+        from jax.sharding import NamedSharding
         if tp:
             # tensor parallelism: feature dim padded to the model-axis size
             # and sharded over it (padded coords stay exactly zero: zero
             # features → zero grad → soft-threshold(0) = 0)
+            features = np.asarray(features, np.float32)
             tp_size = int(mesh.shape[MODEL_AXIS])
             pad = (-d) % tp_size
             if pad:
                 features = np.pad(features, ((0, 0), (0, pad)))
                 init_coeffs = np.pad(init_coeffs, (0, pad))
-        from jax.sharding import NamedSharding
-        if tp:
             spec0 = data_pspec(mesh)
             rem = (-n) % data_shard_count(mesh)
             if rem:
@@ -295,10 +292,15 @@ class SGD:
                                 NamedSharding(mesh, P(spec0, MODEL_AXIS)))
             w_sharding = NamedSharding(mesh, P(MODEL_AXIS))
         else:
-            xs, _ = shard_batch(mesh, features, axes)
+            # device-resident features/labels (device datagen or a previous
+            # device stage) stay on device end-to-end — no host round-trip
+            xs, _ = ensure_on_mesh(mesh, features, axes, jnp.float32)
             w_sharding = NamedSharding(mesh, P())
-        ys, _ = shard_batch(mesh, np.asarray(labels, np.float32), axes)
-        ws, _ = shard_batch(mesh, np.asarray(weights, np.float32), axes)
+        ys, _ = ensure_on_mesh(mesh, labels, axes, jnp.float32)
+        if weights is None:
+            ws = ones_on_mesh(mesh, n, axes, jnp.float32)
+        else:
+            ws, _ = ensure_on_mesh(mesh, weights, axes, jnp.float32)
         w0 = jax.device_put(jnp.asarray(init_coeffs, dtype), w_sharding)
 
         from flink_ml_tpu.iteration.iteration import needs_host_loop

@@ -17,6 +17,8 @@ host-level helpers (``shard_batch``) place host arrays onto the mesh.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -78,6 +80,16 @@ def local_valid_mask(axes, local_n: int, n_valid, dtype=jnp.float32):
 
 # -- host-level placement ----------------------------------------------------
 
+def _dim0_layout(mesh: Mesh, axis_name, ndim: int):
+    """The shared dim-0-sharded placement recipe: (shard count, sharding)
+    for an ndim-rank array row-sharded over the given data axes."""
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    n_shards = int(np.prod([mesh.shape[a] for a in axes]))
+    dim0 = axes[0] if len(axes) == 1 else axes
+    sharding = NamedSharding(mesh, P(dim0, *([None] * (ndim - 1))))
+    return n_shards, sharding
+
+
 def shard_batch(mesh: Mesh, array, axis_name: str = DATA_AXIS):
     """Place a host array on the mesh, sharded on dim 0 (the batch dim).
 
@@ -88,16 +100,12 @@ def shard_batch(mesh: Mesh, array, axis_name: str = DATA_AXIS):
     Returns (device_array, original_length).
     """
     array = np.asarray(array)
-    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
-    n_shards = int(np.prod([mesh.shape[a] for a in axes]))
+    n_shards, sharding = _dim0_layout(mesh, axis_name, array.ndim)
     n = array.shape[0]
     rem = (-n) % n_shards
     if rem:
         pad = np.zeros((rem,) + array.shape[1:], dtype=array.dtype)
         array = np.concatenate([array, pad], axis=0)
-    dim0 = axes[0] if len(axes) == 1 else axes
-    spec = P(dim0, *([None] * (array.ndim - 1)))
-    sharding = NamedSharding(mesh, spec)
     return jax.device_put(array, sharding), n
 
 
@@ -105,3 +113,63 @@ def replicate(mesh: Mesh, tree):
     """Replicate a pytree across the whole mesh (broadcast-variable parity)."""
     sharding = NamedSharding(mesh, P())
     return jax.device_put(tree, sharding)
+
+
+@functools.lru_cache(maxsize=128)
+def _prepare_program(rem: int, dtype_name: str, sharding):
+    """Compiled cast+pad+reshard for device-resident inputs — keyed so
+    repeated fits at the same shapes reuse one program."""
+    dtype = jnp.dtype(dtype_name)
+
+    def prep(a):
+        a = a.astype(dtype)
+        if rem:
+            a = jnp.pad(a, ((0, rem),) + ((0, 0),) * (a.ndim - 1))
+        return a
+
+    return jax.jit(prep, out_shardings=sharding)
+
+
+def ensure_on_mesh(mesh: Mesh, array, axis_name=DATA_AXIS, dtype=None):
+    """Device-aware :func:`shard_batch`: a host array is cast and placed via
+    ``shard_batch``; an already-device ``jax.Array`` is cast/padded/resharded
+    ON device (no host round-trip). This is the residency contract that makes
+    datagen→fit chains and repeated fits transfer-free — the data-cache role
+    of the reference (ListStateWithCache.java:54) where the cached shard
+    simply stays in HBM. Returns (device_array, original_row_count)."""
+    if not isinstance(array, jax.Array):
+        arr = np.asarray(array)
+        if dtype is not None and arr.dtype != np.dtype(dtype):
+            arr = arr.astype(dtype)
+        return shard_batch(mesh, arr, axis_name)
+    n = array.shape[0]
+    n_shards, sharding = _dim0_layout(mesh, axis_name, array.ndim)
+    rem = (-n) % n_shards
+    want = jnp.dtype(dtype) if dtype is not None else array.dtype
+    if rem == 0 and array.dtype == want:
+        # device_put with a matching placement is a no-op; a mismatched one
+        # is a device-to-device reshard — still no PCIe leg
+        return jax.device_put(array, sharding), n
+    return _prepare_program(rem, want.name, sharding)(array), n
+
+
+@functools.lru_cache(maxsize=128)
+def _ones_program(padded: int, dtype_name: str, sharding):
+    dtype = jnp.dtype(dtype_name)
+
+    def make(n):
+        return (jnp.arange(padded) < n).astype(dtype)
+
+    return jax.jit(make, out_shardings=sharding)
+
+
+def ones_on_mesh(mesh: Mesh, n: int, axis_name=DATA_AXIS,
+                 dtype=jnp.float32):
+    """A length-``n`` ones vector (zero-padded to the shard multiple),
+    generated directly sharded ON device — the default sample-weight column
+    without a host allocation or transfer. ``n`` is a traced argument, so
+    one compiled program per padded length serves all true counts."""
+    n_shards, sharding = _dim0_layout(mesh, axis_name, 1)
+    padded = n + ((-n) % n_shards)
+    return _ones_program(padded, jnp.dtype(dtype).name, sharding)(
+        jnp.int32(n))

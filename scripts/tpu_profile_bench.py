@@ -39,6 +39,24 @@ def main():
     dev = jax.device_put(host)
     t("D2H 10k x 10 f32", lambda: np.asarray(dev))
 
+    # transfer at benchmark scale: 500k x 100 f32 = 200 MB. Round 2's 4 MB
+    # probe hid a 60x variance on identical 200 MB puts through the tunnel;
+    # print each sample, not just the best.
+    big = np.random.default_rng(1).random((500_000, 100)).astype(np.float32)
+    for i in range(5):
+        dt = _timed(lambda: jax.device_put(big))
+        print(f"H2D 500k x 100 f32 (200 MB) sample {i}     {dt * 1e3:8.2f} ms"
+              f"  ({big.nbytes / dt / 1e9:6.2f} GB/s)")
+    big_dev = jax.device_put(big)
+    dt = _timed(lambda: np.asarray(big_dev))
+    print(f"D2H 500k x 100 f32 (200 MB)               {dt * 1e3:8.2f} ms"
+          f"  ({big.nbytes / dt / 1e9:6.2f} GB/s)")
+
+    # device datagen at the same scale: the transfer-free on-ramp
+    from flink_ml_tpu.benchmark.datagen import _device_random
+    t("device datagen 500k x 100 f32", lambda: _device_random(0, (500_000, 100)))
+    del big, big_dev
+
     from flink_ml_tpu.models.clustering.kmeans import _build_lloyd_program
     from flink_ml_tpu.parallel.collective import shard_batch
     from flink_ml_tpu.parallel.mesh import default_mesh

@@ -29,6 +29,42 @@ def test_generator_determinism():
                                   g2.get_data().vectors("features"))
 
 
+def test_device_datagen_path(monkeypatch):
+    """Above the size threshold, numeric generators produce sharded device
+    columns that flow into fit without a host round-trip."""
+    import jax
+
+    from flink_ml_tpu.benchmark import datagen
+
+    monkeypatch.setattr(datagen, "_DEVICE_DATAGEN_MIN_BYTES", 0)
+    g1 = DenseVectorGenerator(seed=5, col_names=[["features"]],
+                              num_values=16, vector_dim=3)
+    col = g1.get_data().column("features")
+    assert isinstance(col, jax.Array) and col.dtype == "float32"
+    g2 = DenseVectorGenerator(seed=5, col_names=[["features"]],
+                              num_values=16, vector_dim=3)
+    np.testing.assert_array_equal(np.asarray(col),
+                                  np.asarray(g2.get_data().column("features")))
+
+    g = LabeledPointWithWeightGenerator(
+        seed=1, col_names=[["f", "l", "w"]], num_values=16, vector_dim=4,
+        feature_arity=3, label_arity=2)
+    t = g.get_data()
+    assert isinstance(t.column("f"), jax.Array)
+    assert set(np.unique(t.vectors("f"))) <= {0.0, 1.0, 2.0}
+    assert set(np.unique(t["l"])) <= {0.0, 1.0}
+    assert ((np.asarray(t["w"]) >= 0) & (np.asarray(t["w"]) < 1)).all()
+
+    # device table → fit consumes it without densifying/off-ramping
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression,
+    )
+    model = LogisticRegression(
+        features_col="f", label_col="l", weight_col="w",
+        global_batch_size=8, max_iter=2).fit(t)
+    assert model.coefficients.shape == (4,)
+
+
 def test_labeled_point_generator_arities():
     g = LabeledPointWithWeightGenerator(
         seed=1, col_names=[["f", "l", "w"]], num_values=100, vector_dim=4,
