@@ -14,11 +14,35 @@ compute inside concrete stages is jitted XLA.
 
 from __future__ import annotations
 
+import functools
+import os
 from typing import Tuple
 
 from flink_ml_tpu.common.table import Table
 from flink_ml_tpu.params.param import WithParams
 from flink_ml_tpu.utils import io as rw
+
+
+def _profiled(method, kind: str):
+    """Wrap a fit/transform implementation with the profiler hook (SURVEY.md
+    §5: profiling is the reference's gap we close). Active only when
+    ``FLINK_ML_TPU_PROFILE_DIR`` is set — one env check of overhead
+    otherwise. Traces nest safely: a Pipeline's stages inside the pipeline
+    trace record wall-time gauges only."""
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        from flink_ml_tpu.common.metrics import PROFILE_DIR_ENV, profile
+
+        trace_dir = os.environ.get(PROFILE_DIR_ENV)
+        if not trace_dir:
+            return method(self, *args, **kwargs)
+        region = f"{type(self).__name__}.{kind}"
+        with profile(os.path.join(trace_dir, region), name=region):
+            return method(self, *args, **kwargs)
+
+    wrapper._profiled = True
+    return wrapper
 
 
 class Stage(WithParams):
@@ -47,6 +71,12 @@ class Stage(WithParams):
 class AlgoOperator(Stage):
     """A Stage computing output tables from input tables (ref: AlgoOperator.java)."""
 
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        impl = cls.__dict__.get("transform")
+        if impl is not None and not getattr(impl, "_profiled", False):
+            cls.transform = _profiled(impl, "transform")
+
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         raise NotImplementedError
 
@@ -67,6 +97,12 @@ class Model(Transformer):
 
 class Estimator(Stage):
     """fit(*tables) -> Model (ref: Estimator.java)."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        impl = cls.__dict__.get("fit")
+        if impl is not None and not getattr(impl, "_profiled", False):
+            cls.fit = _profiled(impl, "fit")
 
     def fit(self, *inputs: Table) -> Model:
         raise NotImplementedError

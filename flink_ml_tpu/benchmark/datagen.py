@@ -50,15 +50,14 @@ def _device_random(seed: int, shape, arity: int = 0, stream: int = 0):
     generated directly sharded on the default mesh. ``stream`` decorrelates
     multiple columns drawn from one generator seed."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from flink_ml_tpu.parallel.mesh import data_pspec, default_mesh
+    from flink_ml_tpu.parallel.collective import _dim0_layout
+    from flink_ml_tpu.parallel.mesh import data_axes, default_mesh
 
     mesh = default_mesh()
-    spec = P(data_pspec(mesh), *([None] * (len(shape) - 1)))
+    _, sharding = _dim0_layout(mesh, data_axes(mesh), len(shape))
     key = jax.random.fold_in(jax.random.key(seed), stream)
-    return _rand_program(tuple(shape), int(arity),
-                         NamedSharding(mesh, spec))(key)
+    return _rand_program(tuple(shape), int(arity), sharding)(key)
 
 
 # Below this table size host generation + one put wins: a tiny table is

@@ -74,19 +74,36 @@ class MetricsRegistry:
 metrics = MetricsRegistry()
 
 
+#: env var holding a directory; when set, every Estimator.fit /
+#: AlgoOperator.transform records a jax.profiler trace there (api/stage.py)
+PROFILE_DIR_ENV = "FLINK_ML_TPU_PROFILE_DIR"
+
+_trace_active = False  # jax.profiler allows one trace at a time
+
+
 @contextlib.contextmanager
-def profile(trace_dir: str = None):
+def profile(trace_dir: str = None, name: str = None):
     """Profile a region: wall-time gauge always; a jax.profiler trace when
-    ``trace_dir`` is given (view with TensorBoard / xprof)."""
+    ``trace_dir`` is given (view with TensorBoard / xprof). Reentrant —
+    a region inside an already-active trace (a Pipeline stage inside the
+    pipeline's own trace) records only its wall-time gauge. ``name`` keys a
+    per-region gauge in ``ml.profile`` alongside the generic one."""
+    global _trace_active
     import jax
 
     start = time.perf_counter()
-    if trace_dir:
+    tracing = bool(trace_dir) and not _trace_active
+    if tracing:
         jax.profiler.start_trace(trace_dir)
+        _trace_active = True
     try:
         yield
     finally:
-        if trace_dir:
+        if tracing:
             jax.profiler.stop_trace()
-        metrics.group(ML_GROUP).gauge(
-            "lastProfiledRegionMs", (time.perf_counter() - start) * 1000.0)
+            _trace_active = False
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+        metrics.group(ML_GROUP).gauge("lastProfiledRegionMs", elapsed_ms)
+        if name:
+            metrics.group(ML_GROUP, "profile").gauge(f"{name}LastMs",
+                                                     elapsed_ms)

@@ -190,6 +190,21 @@ def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams):
         out_specs=(wspec, P(spec0), P()), check_vma=False)
 
 
+@functools.lru_cache(maxsize=128)
+def _tp_prepare_program(rem: int, pad_d: int, sharding):
+    """Compiled cast+pad for a device-resident feature matrix entering the
+    tensor-parallel layout (rows to the data axes, features to the model
+    axis) — no host round-trip."""
+
+    def prep(a):
+        a = a.astype(jnp.float32)
+        if rem or pad_d:
+            a = jnp.pad(a, ((0, rem), (0, pad_d)))
+        return a
+
+    return jax.jit(prep, out_shardings=sharding)
+
+
 class SGD:
     """Ref: Optimizer/SGD — optimize(initModel, trainData) → fitted coeffs."""
 
@@ -278,18 +293,25 @@ class SGD:
             # tensor parallelism: feature dim padded to the model-axis size
             # and sharded over it (padded coords stay exactly zero: zero
             # features → zero grad → soft-threshold(0) = 0)
-            features = np.asarray(features, np.float32)
             tp_size = int(mesh.shape[MODEL_AXIS])
             pad = (-d) % tp_size
             if pad:
-                features = np.pad(features, ((0, 0), (0, pad)))
                 init_coeffs = np.pad(init_coeffs, (0, pad))
             spec0 = data_pspec(mesh)
             rem = (-n) % data_shard_count(mesh)
-            if rem:
-                features = np.pad(features, ((0, rem), (0, 0)))
-            xs = jax.device_put(features,
-                                NamedSharding(mesh, P(spec0, MODEL_AXIS)))
+            x_sharding = NamedSharding(mesh, P(spec0, MODEL_AXIS))
+            if isinstance(features, jax.Array):
+                # device-resident input: cast/pad/reshard on device — the
+                # same residency contract as the DP branch
+                if pad or rem or features.dtype != jnp.float32:
+                    features = _tp_prepare_program(
+                        rem, pad, x_sharding)(features)
+                xs = jax.device_put(features, x_sharding)
+            else:
+                features = np.asarray(features, np.float32)
+                if pad or rem:
+                    features = np.pad(features, ((0, rem), (0, pad)))
+                xs = jax.device_put(features, x_sharding)
             w_sharding = NamedSharding(mesh, P(MODEL_AXIS))
         else:
             # device-resident features/labels (device datagen or a previous

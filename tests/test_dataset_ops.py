@@ -129,6 +129,36 @@ def test_profile_context():
     assert metrics.group("ml").get_gauge("lastProfiledRegionMs") >= 0
 
 
+def test_profile_env_wires_into_fit_and_transform(tmp_path, monkeypatch):
+    """With FLINK_ML_TPU_PROFILE_DIR set, every fit/transform records a
+    jax.profiler trace + a per-region gauge (SURVEY §5: the profiling gap
+    we close); nested stages inside a Pipeline trace don't double-start."""
+    import os
+
+    import numpy as np
+
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.common.metrics import PROFILE_DIR_ENV
+    from flink_ml_tpu.models.clustering.kmeans import KMeans
+
+    monkeypatch.setenv(PROFILE_DIR_ENV, str(tmp_path))
+    from flink_ml_tpu.common.table import Table
+
+    table = Table.from_columns(
+        features=np.random.default_rng(0).random((64, 4)))
+    model = KMeans(k=2, max_iter=2).fit(table)
+    model.transform(table)
+    fit_dir = tmp_path / "KMeans.fit"
+    assert fit_dir.exists() and any(fit_dir.rglob("*"))
+    prof = metrics.group("ml", "profile")
+    assert prof.get_gauge("KMeans.fitLastMs") > 0
+    assert prof.get_gauge("KMeansModel.transformLastMs") > 0
+
+    # nested: Pipeline.fit traces once; inner stages record gauges only
+    Pipeline([KMeans(k=2, max_iter=1)]).fit(table)
+    assert prof.get_gauge("Pipeline.fitLastMs") > 0
+
+
 def test_vector_udfs_roundtrip():
     """Functions.java:39-71 parity: vectorToArray / arrayToVector."""
     import numpy as np
