@@ -66,18 +66,24 @@ def iterate_bounded(initial_carry: Carry,
                     max_iter: int,
                     terminate: Optional[Terminate] = None,
                     config: IterationConfig = None,
-                    listeners: Sequence[IterationListener] = ()) -> Carry:
+                    listeners: Sequence[IterationListener] = (),
+                    jit_round: bool = True) -> Carry:
     """Run ``body`` for up to ``max_iter`` epochs; stop early when
     ``terminate(carry, epoch)`` is True. Returns the final carry.
 
     The carry is an arbitrary pytree and may contain device arrays with any
     sharding — cached training data sharded over the data axis rides along
     exactly like the reference's in-loop data cache.
+
+    ``jit_round=False`` runs the body as plain host code per round (no
+    tracing) — for bodies whose math lives on host (the CSR sparse trainer:
+    scipy matvecs have no XLA form). Such bodies always use the host loop.
     """
     config = config or IterationConfig()
-    if not needs_host_loop(config, listeners):
+    if jit_round and not needs_host_loop(config, listeners):
         return _device_loop(initial_carry, body, max_iter, terminate)
-    return _host_loop(initial_carry, body, max_iter, terminate, config, listeners)
+    return _host_loop(initial_carry, body, max_iter, terminate, config,
+                      listeners, jit_round)
 
 
 def needs_host_loop(config: Optional[IterationConfig],
@@ -121,20 +127,23 @@ def _device_loop(initial_carry, body, max_iter, terminate):
     return run(initial_carry)
 
 
-def _host_loop(initial_carry, body, max_iter, terminate, config, listeners):
+def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
+               jit_round: bool = True):
     """Host-driven rounds with listener/checkpoint hooks.
 
     The jitted round returns (carry, stop) so the only host sync per round is
     one scalar — the same single-bit exchange as the reference's
-    GloballyAlignedEvent, minus the RPC.
+    GloballyAlignedEvent, minus the RPC. With ``jit_round=False`` the body
+    runs as plain host code (CSR math); the stop bit is then immediate.
     """
 
-    @jax.jit
-    def round_fn(carry, epoch):
+    def round_impl(carry, epoch):
         new_carry = body(carry, epoch)
         stop = (jnp.asarray(terminate(new_carry, epoch), dtype=bool)
                 if terminate is not None else jnp.asarray(False))
         return new_carry, stop
+
+    round_fn = jax.jit(round_impl) if jit_round else round_impl
 
     from flink_ml_tpu.common.metrics import ML_GROUP, metrics
     iter_group = metrics.group(ML_GROUP, "iteration")

@@ -74,18 +74,23 @@ def generate_batches(stream: StreamTable, global_batch_size: int,
 def window_stream(stream: StreamTable, windows,
                   timestamp_col: Optional[str] = None,
                   with_end_ts: bool = False) -> Iterator:
-    """Regroup a stream's rows into tumbling time windows.
+    """Regroup a stream's rows into tumbling or session time windows.
 
     Ref: the Windows param consumed by OnlineStandardScaler (
     feature/standardscaler/OnlineStandardScaler.java — per-window model
-    emission).
+    emission); session specs per common/window/SessionWindows.java.
 
-    - Event-time windows bucket rows by ``timestamp_col // size_ms``; a
-      window is emitted when a later window's first row arrives (in-order
-      streams — the reference's watermark generator with zero
-      out-of-orderness), the trailing window at end-of-stream.
-    - Processing-time windows bucket whole chunks by wall-clock arrival
-      time; no timestamp column is involved (reference semantics).
+    - Event-time tumbling windows bucket rows by ``timestamp_col //
+      size_ms``; a window is emitted when a later window's first row
+      arrives (in-order streams — the reference's watermark generator with
+      zero out-of-orderness), the trailing window at end-of-stream.
+    - Processing-time tumbling windows bucket whole chunks by wall-clock
+      arrival time; no timestamp column is involved (reference semantics).
+    - Session windows close when the time gap to the next row (event time)
+      or next chunk arrival (processing time) exceeds ``gap_ms``, or when
+      the stream ends (docs/deviations.md: Flink instead holds the final
+      session until a watermark passes gap-end). A session's end timestamp
+      is last-element-time + gap, matching Flink's session merge rule.
 
     Yields Tables, or ``(window_end_ms, Table)`` with ``with_end_ts=True``
     (the timestamp the reference stamps on each per-window model).
@@ -93,28 +98,34 @@ def window_stream(stream: StreamTable, windows,
     import time as _time
 
     from flink_ml_tpu.common.window import (
+        EventTimeSessionWindows,
         EventTimeTumblingWindows,
+        ProcessingTimeSessionWindows,
         ProcessingTimeTumblingWindows,
     )
 
-    if isinstance(windows, EventTimeTumblingWindows):
-        if timestamp_col is None:
-            raise ValueError(
-                "event-time windows need timestamp_col to assign rows to "
-                "windows")
-        event_time = True
-    elif isinstance(windows, ProcessingTimeTumblingWindows):
-        event_time = False
-    else:
-        raise ValueError(f"window_stream supports tumbling time windows, "
-                         f"got {type(windows).__name__}")
+    event_time = isinstance(windows, (EventTimeTumblingWindows,
+                                      EventTimeSessionWindows))
+    session = isinstance(windows, (EventTimeSessionWindows,
+                                   ProcessingTimeSessionWindows))
+    if not (event_time or isinstance(windows, (
+            ProcessingTimeTumblingWindows, ProcessingTimeSessionWindows))):
+        raise ValueError(f"window_stream supports tumbling and session time "
+                         f"windows, got {type(windows).__name__}")
+    if event_time and timestamp_col is None:
+        raise ValueError(
+            "event-time windows need timestamp_col to assign rows to "
+            "windows")
+
+    def emit(end_ms, table):
+        return (int(end_ms), table) if with_end_ts else table
+
+    if session:
+        yield from _session_windows(stream, windows.gap_ms, event_time,
+                                    timestamp_col, emit, _time)
+        return
+
     size_ms = windows.size_ms
-
-    def emit(window_id, table):
-        if with_end_ts:
-            return (int(window_id + 1) * size_ms, table)
-        return table
-
     pending: Optional[Table] = None
     pending_window = None
     for chunk in stream:
@@ -130,10 +141,47 @@ def window_stream(stream: StreamTable, windows,
                 pending = rows if pending is None else pending.concat(rows)
                 pending_window = window_id
             else:
-                yield emit(pending_window, pending)
+                yield emit((pending_window + 1) * size_ms, pending)
                 pending, pending_window = rows, window_id
     if pending is not None and pending.num_rows:
-        yield emit(pending_window, pending)
+        yield emit((pending_window + 1) * size_ms, pending)
+
+
+def _session_windows(stream, gap_ms, event_time, timestamp_col, emit, _time):
+    """Gap-based session assignment over an in-order stream. Event time:
+    a gap between consecutive row timestamps > gap_ms closes the session;
+    processing time: a gap between chunk arrivals does. The final partial
+    session is emitted at end-of-stream (documented deviation)."""
+    pending: Optional[Table] = None
+    last_ts = None  # last event timestamp / last chunk arrival, ms
+    for chunk in stream:
+        if chunk.num_rows == 0:
+            continue
+        if event_time:
+            ts = np.asarray(chunk.column(timestamp_col), np.int64)
+            # split the chunk at internal gaps; prepend the pending session
+            starts = np.nonzero(np.diff(ts) > gap_ms)[0] + 1
+            bounds = [0, *starts.tolist(), len(ts)]
+            for i in range(len(bounds) - 1):
+                # gap-free chunk (the common case): no copy
+                seg = chunk if len(bounds) == 2 else chunk.take(
+                    np.arange(bounds[i], bounds[i + 1]))
+                seg_first, seg_last = int(ts[bounds[i]]), \
+                    int(ts[bounds[i + 1] - 1])
+                if pending is not None and seg_first - last_ts > gap_ms:
+                    yield emit(last_ts + gap_ms, pending)
+                    pending = None
+                pending = seg if pending is None else pending.concat(seg)
+                last_ts = seg_last
+        else:
+            now = int(_time.time() * 1000)
+            if pending is not None and now - last_ts > gap_ms:
+                yield emit(last_ts + gap_ms, pending)
+                pending = None
+            pending = chunk if pending is None else pending.concat(chunk)
+            last_ts = now
+    if pending is not None and pending.num_rows:
+        yield emit(last_ts + gap_ms, pending)
 
 
 class StreamCheckpointer:

@@ -9,7 +9,11 @@ from __future__ import annotations
 
 import numpy as np
 
-from flink_ml_tpu.models.common import LinearEstimatorBase, LinearModelBase
+from flink_ml_tpu.models.common import (
+    LinearEstimatorBase,
+    LinearModelBase,
+    prediction_dtype,
+)
 from flink_ml_tpu.ops.losses import HingeLoss
 from flink_ml_tpu.params.param import FloatParam, WithParams
 
@@ -22,9 +26,10 @@ class HasThreshold(WithParams):
 
 
 class LinearSVCModel(LinearModelBase, HasThreshold):
-    def _predict_columns(self, dots: np.ndarray) -> dict:
+    def _predict_columns(self, dots, xp) -> dict:
         return {
-            self.prediction_col: (dots >= self.threshold).astype(np.float64),
+            self.prediction_col: (dots >= self.threshold).astype(
+                prediction_dtype(xp)),
             self.raw_prediction_col: dots,
         }
 

@@ -14,19 +14,20 @@ import numpy as np
 from flink_ml_tpu.models.common import (
     LinearEstimatorBase,
     LinearModelBase,
-    raw_prediction_vectors,
+    prediction_dtype,
 )
 from flink_ml_tpu.ops.losses import BinaryLogisticLoss
 from flink_ml_tpu.params.shared import HasMultiClass
 
 
 class LogisticRegressionModel(LinearModelBase, HasMultiClass):
-    def _predict_columns(self, dots: np.ndarray) -> dict:
-        prob = 1.0 - 1.0 / (1.0 + np.exp(dots))
+    def _predict_columns(self, dots, xp) -> dict:
+        prob = 1.0 - 1.0 / (1.0 + xp.exp(dots))
+        # rawPrediction is a columnar (n, 2) vector column — device-resident
+        # on the dense path (one vector per row, [1-p, p])
         return {
-            self.prediction_col: (dots >= 0).astype(np.float64),
-            self.raw_prediction_col: raw_prediction_vectors(
-                np.stack([1.0 - prob, prob], axis=1)),
+            self.prediction_col: (dots >= 0).astype(prediction_dtype(xp)),
+            self.raw_prediction_col: xp.stack([1.0 - prob, prob], axis=1),
         }
 
 

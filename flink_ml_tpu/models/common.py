@@ -62,6 +62,34 @@ def _dots(features, coeffs):
     return features @ coeffs
 
 
+def prediction_dtype(xp):
+    """Label-column dtype per prediction path: float64 on the host (sparse)
+    path — the reference's Java double — float32 on device (TPU-native
+    width, docs/deviations.md dtype policy). Owned here, next to
+    :func:`predict_dots`, so every linear/online model agrees."""
+    return np.float64 if xp is np else jnp.float32
+
+
+def predict_dots(x, coefficients):
+    """Margins for a feature batch: dense input runs on device through the
+    columnar path (sharded rows, replicated coefficients — the ⚙ predict
+    tier of SURVEY §2.1; ref LogisticRegressionModelServable.java:106 dot),
+    returning a device array so derived prediction columns stay resident;
+    CSR input stays a host matvec (ref BLAS.hDot sparse path).
+
+    Returns (dots, xp) where xp is the array namespace (jnp or np) the
+    caller should derive its prediction columns with."""
+    from flink_ml_tpu.linalg import sparse
+
+    if sparse.is_csr(x):
+        return np.asarray(x @ np.asarray(coefficients, np.float64)), np
+    from flink_ml_tpu.ops import columnar
+
+    xd = columnar.to_device(x)
+    cd = columnar.replicated(np.asarray(coefficients, np.float32))
+    return _dots(xd, cd), jnp
+
+
 class LinearModelParams(HasFeaturesCol, HasPredictionCol):
     pass
 
@@ -81,7 +109,10 @@ class LinearModelBase(Model, LinearTrainParams):
                              else np.asarray(coefficients, np.float64))
 
     # -- prediction rule, overridden per algorithm ---------------------------
-    def _predict_columns(self, dots: np.ndarray) -> dict:
+    def _predict_columns(self, dots, xp) -> dict:
+        """Derive the prediction columns from the margins using the ``xp``
+        namespace (jnp on the device path, np on the sparse host path) so
+        dense outputs stay device-resident columns in the result Table."""
         raise NotImplementedError
 
     def transform(self, table: Table) -> Tuple[Table]:
@@ -89,15 +120,8 @@ class LinearModelBase(Model, LinearTrainParams):
             raise ValueError(f"{type(self).__name__} has no model data")
         from flink_ml_tpu.linalg import sparse
         x = sparse.features_matrix(table, self.features_col)
-        if sparse.is_csr(x):
-            # sparse predict stays host CSR (ref BLAS.hDot): one matvec
-            dots = np.asarray(x @ np.asarray(self.coefficients, np.float64))
-        else:
-            dots = np.asarray(
-                _dots(jnp.asarray(x),
-                      jnp.asarray(self.coefficients, jnp.float32)),
-                np.float64)
-        return (table.with_columns(**self._predict_columns(dots)),)
+        dots, xp = predict_dots(x, self.coefficients)
+        return (table.with_columns(**self._predict_columns(dots, xp)),)
 
     # -- model data as a Table (ref: XxxModelData POJO + table) -------------
     def set_model_data(self, model_data: Table):
@@ -152,13 +176,10 @@ class LinearEstimatorBase(Estimator, LinearTrainParams,
             elastic_net=self.elastic_net)
         init = np.zeros(x.shape[1], np.float32)
         if sparse.is_csr(x):
-            if self._iteration_config is not None or \
-                    self._iteration_listeners:
-                raise NotImplementedError(
-                    "host-mode iteration (checkpointing/listeners) is not "
-                    "supported on the sparse CSR training path; densify "
-                    "the features or drop the iteration config")
-            coeffs, _ = SGD(params).optimize_csr(self.loss, init, x, y, w)
+            coeffs, _ = SGD(params).optimize_csr(
+                self.loss, init, x, y, w,
+                config=self._iteration_config,
+                listeners=self._iteration_listeners)
         else:
             coeffs, _ = SGD(params).optimize(
                 self.loss, init, x, y, w,
@@ -173,5 +194,9 @@ def prediction_output(table: Table, name: str, values: np.ndarray) -> Table:
 
 
 def raw_prediction_vectors(pairs: np.ndarray) -> np.ndarray:
-    """(n, k) float array → object column of DenseVectors for rawPrediction."""
+    """(n, k) float array → object column of DenseVectors for rawPrediction.
+
+    Row-oriented consumers (the servable path) use this off-ramp; the batch
+    transform path keeps rawPrediction as a columnar (n, k) vector column
+    instead — same logical schema (a vector per row), device-resident."""
     return as_dense_vector_column(pairs)

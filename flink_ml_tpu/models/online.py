@@ -108,15 +108,18 @@ class OnlineLogisticRegressionModel(Model,
             raise ValueError(
                 "OnlineLogisticRegressionModel has no model data")
         from flink_ml_tpu.linalg import sparse
-        x = sparse.features_matrix(table, self.features_col, np.float64)
-        dots = np.asarray(x @ self.coefficients)
-        prob = 1.0 / (1.0 + np.exp(-dots))
+        from flink_ml_tpu.models.common import predict_dots, prediction_dtype
+        x = sparse.features_matrix(table, self.features_col)
+        # dense batches score on device through the columnar path (ref
+        # predict of OnlineLogisticRegressionModel.java:67-95); CSR stays
+        # a host matvec
+        dots, xp = predict_dots(x, self.coefficients)
+        prob = 1.0 / (1.0 + xp.exp(-dots))
         return (table.with_columns(**{
-            self.prediction_col: (dots >= 0).astype(np.float64),
-            self.raw_prediction_col: as_dense_vector_column(
-                np.stack([1 - prob, prob], axis=1)),
-            self.model_version_col: np.full(len(dots), self.model_version,
-                                            np.int64)}),)
+            self.prediction_col: (dots >= 0).astype(prediction_dtype(xp)),
+            self.raw_prediction_col: xp.stack([1 - prob, prob], axis=1),
+            self.model_version_col: np.full(table.num_rows,
+                                            self.model_version, np.int64)}),)
 
     def transform_stream(self, stream: StreamTable, model_stream=None,
                          timestamp_col: Optional[str] = None):
@@ -471,12 +474,16 @@ class OnlineStandardScaler(Estimator, OnlineStandardScalerParams,
             ) -> OnlineStandardScalerModel:
         from flink_ml_tpu.common.window import (
             CountTumblingWindows,
+            EventTimeSessionWindows,
             EventTimeTumblingWindows,
+            ProcessingTimeSessionWindows,
             ProcessingTimeTumblingWindows,
         )
         windows = self.windows
         timed = isinstance(windows, (EventTimeTumblingWindows,
-                                     ProcessingTimeTumblingWindows))
+                                     ProcessingTimeTumblingWindows,
+                                     EventTimeSessionWindows,
+                                     ProcessingTimeSessionWindows))
         if isinstance(windows, CountTumblingWindows):
             batch_size = windows.size
         if isinstance(data, Table):

@@ -13,7 +13,7 @@ from flink_ml_tpu.ops.losses import LeastSquareLoss
 
 
 class LinearRegressionModel(LinearModelBase):
-    def _predict_columns(self, dots: np.ndarray) -> dict:
+    def _predict_columns(self, dots, xp) -> dict:
         return {self.prediction_col: dots}
 
 

@@ -214,7 +214,8 @@ class SGD:
     def optimize_csr(self, loss_func: LossFunc, init_coeffs: np.ndarray,
                      features_csr, labels: np.ndarray,
                      weights: Optional[np.ndarray] = None,
-                     mesh: Optional[Mesh] = None):
+                     mesh: Optional[Mesh] = None,
+                     config=None, listeners=()):
         """Host CSR fallback for wide sparse input (HashingTF at 2^18 dims
         would need terabytes dense — ref trains SparseVector natively,
         OnlineLogisticRegression.java:364-388 / BLAS.java:78).
@@ -225,6 +226,12 @@ class SGD:
         the same update/termination — so sparse and dense fits agree on
         small dims (parity-tested). Math in float64 on host; gradients via
         scipy's CSR matvec kernels.
+
+        ``config``/``listeners`` run the rounds through ``iterate_bounded``
+        with an un-jitted host body (jit_round=False): the sparse fit
+        checkpoints/resumes mid-iteration exactly like the dense path — the
+        reference's state persistence is representation-agnostic
+        (SGD.java:308-360) and so is ours.
         """
         prm = self.params
         mesh = mesh or default_mesh()
@@ -236,12 +243,11 @@ class SGD:
         y = np.asarray(labels, np.float64)
         w = (np.ones(n, np.float64) if weights is None
              else np.asarray(weights, np.float64))
-        coeffs = np.asarray(init_coeffs, np.float64).copy()
-        offsets = np.zeros(p, np.int64)
-        mean_loss = np.inf
         X = features_csr.tocsr()
 
-        for _ in range(prm.max_iter):
+        def round_body(carry, epoch):
+            coeffs, offsets, _ = carry
+            offsets = offsets.copy()  # carry is functional (checkpointable)
             row_parts = []
             for s in range(p):
                 lb = min(lb_base + (1 if s < lb_rem else 0), ls)
@@ -264,8 +270,16 @@ class SGD:
                                         prm.learning_rate, xp=np)
                 coeffs = np.asarray(updated, np.float64)
             mean_loss = loss_sum / max(total_w, 1e-30)
-            if mean_loss < prm.tol:
-                break
+            return coeffs, offsets, np.float64(mean_loss)
+
+        from flink_ml_tpu.iteration.iteration import iterate_bounded
+
+        init = (np.asarray(init_coeffs, np.float64).copy(),
+                np.zeros(p, np.int64), np.float64(np.inf))
+        coeffs, _, mean_loss = iterate_bounded(
+            init, round_body, max_iter=prm.max_iter,
+            terminate=lambda carry, epoch: carry[2] < prm.tol,
+            config=config, listeners=listeners, jit_round=False)
         return coeffs, float(mean_loss)
 
     def optimize(self, loss_func: LossFunc, init_coeffs: np.ndarray,

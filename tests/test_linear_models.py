@@ -53,10 +53,11 @@ def test_lr_fit_transform(rng):
     pred = out["prediction"]
     acc = np.mean(pred == table["label"])
     assert acc > 0.95, f"accuracy {acc}"
-    # rawPrediction = [1-p, p] summing to 1
-    raw = out["rawPrediction"][0].to_array()
-    assert raw.shape == (2,)
-    assert raw.sum() == pytest.approx(1.0)
+    # rawPrediction = [1-p, p] summing to 1 — a columnar (n, 2) vector
+    # column, device-resident on the dense path
+    raw = out.vectors("rawPrediction")
+    assert raw.shape == (table.num_rows, 2)
+    assert np.asarray(raw[0]).sum() == pytest.approx(1.0)
     # params propagated to the model (ref updateExistingParams)
     assert model.max_iter == 60
 

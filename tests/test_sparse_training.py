@@ -205,13 +205,62 @@ def test_sparse_predict_matches_dense(rng):
                                   np.asarray(sparse_pred))
 
 
-def test_sparse_fit_rejects_iteration_config(rng):
+def test_sparse_fit_host_mode_matches_plain(rng):
+    """CSR fit through the host iteration driver (listeners/checkpoint
+    hooks) must equal the plain CSR loop (SGD.java:308-360 parity: state
+    persistence is representation-agnostic)."""
     from flink_ml_tpu.iteration.iteration import IterationConfig
     from flink_ml_tpu.models.classification import LogisticRegression
-    x = rng.normal(size=(20, 3))
+    x = rng.normal(size=(60, 4))
     y = (x[:, 0] > 0).astype(np.float64)
-    est = LogisticRegression(features_col="f", label_col="l")
-    est.set_iteration_config(IterationConfig(mode="host"))
-    with pytest.raises(NotImplementedError):
-        est.fit(Table.from_columns(
-            f=_sparse_column_from_dense(x), l=y))
+    t = Table.from_columns(f=_sparse_column_from_dense(x), l=y)
+
+    def est():
+        return LogisticRegression(features_col="f", label_col="l",
+                                  global_batch_size=16, max_iter=9)
+
+    expected = est().fit(t).coefficients
+    host = est().set_iteration_config(IterationConfig(mode="host")) \
+        .fit(t).coefficients
+    np.testing.assert_allclose(host, expected, rtol=1e-12)
+
+
+def test_sparse_fit_crash_resume_identical_result(rng, tmp_path):
+    """Mid-fit crash + resume on the CSR path reproduces the uninterrupted
+    result exactly (the BoundedAllRoundCheckpointITCase bar, now for
+    wide-sparse training — VERDICT r2 ask #8)."""
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.iteration.iteration import (IterationConfig,
+                                                  IterationListener)
+    from flink_ml_tpu.models.classification import LogisticRegression
+
+    class _Crash(Exception):
+        pass
+
+    class _CrashAt(IterationListener):
+        def __init__(self, at):
+            self.at = at
+
+        def on_epoch_watermark_incremented(self, epoch, carry):
+            if epoch == self.at:
+                raise _Crash()
+
+    x = rng.normal(size=(80, 6))
+    y = (x @ rng.normal(size=6) > 0).astype(np.float64)
+    t = Table.from_columns(f=_sparse_column_from_dense(x), l=y)
+
+    def est():
+        return LogisticRegression(features_col="f", label_col="l",
+                                  global_batch_size=32, max_iter=10)
+
+    expected = est().fit(t).coefficients
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=2,
+                          checkpoint_manager=mgr)
+    with pytest.raises(_Crash):
+        est().set_iteration_config(cfg, listeners=[_CrashAt(6)]).fit(t)
+    assert mgr.list_checkpoints()
+
+    resumed = est().set_iteration_config(cfg).fit(t).coefficients
+    np.testing.assert_allclose(resumed, expected, rtol=1e-12)
