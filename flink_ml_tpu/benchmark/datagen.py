@@ -67,6 +67,19 @@ def _device_random(seed: int, shape, arity: int = 0, stream: int = 0):
 _DEVICE_DATAGEN_MIN_BYTES = 8 << 20
 
 
+def _codes_to_strings(ints: np.ndarray, k: int) -> np.ndarray:
+    """Integer codes → fixed-width '<U' string array: one str() per
+    DISTINCT value then one vectorized gather — a 10M-row column never
+    pays 10M Python str() calls, and a sparse draw from a huge domain
+    (k >> draws) only materializes the codes actually drawn."""
+    if k > ints.size:
+        uniq = np.unique(ints)
+        strs = np.array([str(v) for v in uniq])
+        return strs[np.searchsorted(uniq, ints)]
+    tokens = np.array([str(v) for v in range(k)])
+    return tokens[ints]
+
+
 def _use_device_gen(n: int, total_elems: int) -> bool:
     from flink_ml_tpu.parallel.mesh import data_shard_count, default_mesh
 
@@ -198,10 +211,10 @@ class RandomStringGenerator(InputTableGenerator, HasNumDistinctValues):
 
     def get_data(self) -> Table:
         rng = self._rng()
-        cols = {}
-        for name in self._col_names():
-            ints = rng.integers(0, self.num_distinct_values, self.num_values)
-            cols[name] = np.array([str(v) for v in ints], dtype=object)
+        k = self.num_distinct_values
+        cols = {name: _codes_to_strings(
+                    rng.integers(0, k, self.num_values), k)
+                for name in self._col_names()}
         return Table.from_columns(**cols)
 
 
@@ -210,13 +223,15 @@ class RandomStringArrayGenerator(InputTableGenerator, HasNumDistinctValues,
                                  HasArraySize):
     def get_data(self) -> Table:
         rng = self._rng()
-        cols = {}
-        for name in self._col_names():
-            col = np.empty(self.num_values, dtype=object)
-            for i in range(self.num_values):
-                col[i] = [str(v) for v in rng.integers(
-                    0, self.num_distinct_values, self.array_size)]
-            cols[name] = col
+        k = self.num_distinct_values
+        # token-matrix representation: an (n, arraySize) fixed-width string
+        # array IS a token-array column (row i = document i) — the
+        # vectorized form the text ops' fast paths consume; the reference's
+        # String[] rows stay available as the ragged object-column form
+        cols = {name: _codes_to_strings(
+                    rng.integers(0, k, (self.num_values, self.array_size)),
+                    k)
+                for name in self._col_names()}
         return Table.from_columns(**cols)
 
 

@@ -70,6 +70,20 @@ class StringIndexerModel(Model, StringIndexerModelParams):
                                          self.string_arrays):
             index = {v: i for i, v in enumerate(vocab)}
             col = table.column(name)
+            if isinstance(col, np.ndarray) and col.dtype != object:
+                # homogeneous column: one lookup per DISTINCT value, then
+                # a gather — 100M rows cost one np.unique, not 100M dict
+                # probes
+                uniq, inv = np.unique(col, return_inverse=True)
+                ids = np.fromiter(
+                    (index.get(str(v), -1) for v in uniq), np.int64,
+                    len(uniq))
+                mapped = ids[inv.reshape(-1)]
+                miss = mapped < 0
+                invalid_any |= miss
+                outs[out_name] = np.where(miss, len(vocab),
+                                          mapped).astype(np.float64)
+                continue
             vals = np.empty(len(col), np.float64)
             for i, v in enumerate(col):
                 j = index.get(str(v))
@@ -117,6 +131,23 @@ class StringIndexer(Estimator, StringIndexerParams):
         order = self.string_order_type
         for name in self.input_cols:
             col = table.column(name)
+            if isinstance(col, np.ndarray) and col.dtype != object:
+                # homogeneous column: count/order once per DISTINCT value
+                uniq, first_idx, cnts = np.unique(
+                    col, return_index=True, return_counts=True)
+                svals = np.array([str(v) for v in uniq])
+                if order == self.FREQUENCY_DESC_ORDER:
+                    pick = np.lexsort((svals, -cnts))
+                elif order == self.FREQUENCY_ASC_ORDER:
+                    pick = np.lexsort((svals, cnts))
+                elif order == self.ALPHABET_DESC_ORDER:
+                    pick = np.argsort(svals)[::-1]
+                elif order == self.ALPHABET_ASC_ORDER:
+                    pick = np.argsort(svals)
+                else:  # arbitrary: first-seen order
+                    pick = np.argsort(first_idx)
+                arrays.append([str(v) for v in svals[pick]])
+                continue
             counts = {}
             first_seen = {}
             for i, v in enumerate(col):
@@ -157,10 +188,7 @@ class IndexToStringModel(Model, StringIndexerModelParams):
             col = np.asarray(table.column(name), np.int64)
             if (col < 0).any() or (col >= len(vocab)).any():
                 raise ValueError(f"index out of range for column {name!r}")
-            out = np.empty(len(col), dtype=object)
-            for i, j in enumerate(col):
-                out[i] = vocab[j]
-            outs[out_name] = out
+            outs[out_name] = np.asarray(vocab, dtype=object)[col]
         return (table.with_columns(**outs),)
 
     set_model_data = StringIndexerModel.set_model_data

@@ -224,3 +224,93 @@ def test_hashing_tf_and_cv_accept_generator_cells():
     cv = CountVectorizer(input_col="tokens", output_col="cv").fit(lists)
     out2 = cv.transform(Table.from_columns(tokens=cells()))[0]["cv"]
     assert out2[0].values.sum() == 3.0 and out2[1].values.sum() == 1.0
+
+
+def test_token_matrix_parity_with_object_columns(rng):
+    """A (n, size) fixed-width string array (the vectorized token-array
+    form from RandomStringArrayGenerator) must produce IDENTICAL results
+    to the same data as an object column of per-row token lists, for every
+    op with a token-matrix fast path."""
+    from flink_ml_tpu.models.feature import (
+        CountVectorizer,
+        NGram,
+        StopWordsRemover,
+    )
+
+    tokens = np.array(["the", "cat", "sat", "on", "mat", "dog"])
+    matrix = tokens[rng.integers(0, len(tokens), (50, 5))]
+    as_obj = np.empty(50, dtype=object)
+    for i in range(50):
+        as_obj[i] = [str(t) for t in matrix[i]]
+    t_mat = Table.from_columns(tokens=matrix)
+    t_obj = Table.from_columns(tokens=as_obj)
+
+    # HashingTF
+    htf = HashingTF(input_col="tokens", output_col="o", num_features=64)
+    for a, b in zip(htf.transform(t_mat)[0]["o"],
+                    htf.transform(t_obj)[0]["o"]):
+        np.testing.assert_array_equal(a.to_array(), b.to_array())
+
+    # CountVectorizer fit (vocabulary order incl. frequency ties) + model
+    cv_m = CountVectorizer(input_col="tokens", output_col="o").fit(t_mat)
+    cv_o = CountVectorizer(input_col="tokens", output_col="o").fit(t_obj)
+    assert cv_m.vocabulary == cv_o.vocabulary
+    for a, b in zip(cv_m.transform(t_mat)[0]["o"],
+                    cv_o.transform(t_obj)[0]["o"]):
+        np.testing.assert_array_equal(a.to_array(), b.to_array())
+
+    # StopWordsRemover (default English list removes "the"/"on")
+    sw = StopWordsRemover(input_cols=["tokens"], output_cols=["o"])
+    for a, b in zip(sw.transform(t_mat)[0]["o"],
+                    sw.transform(t_obj)[0]["o"]):
+        assert [str(x) for x in a] == [str(x) for x in b]
+
+    # NGram: token-matrix output must carry the same grams
+    ng = NGram(input_col="tokens", output_col="o", n=2)
+    out_m = ng.transform(t_mat)[0]["o"]
+    out_o = ng.transform(t_obj)[0]["o"]
+    assert out_m.shape == (50, 4)
+    for a, b in zip(out_m, out_o):
+        assert [str(x) for x in a] == list(b)
+
+
+def test_tokenizer_single_token_fast_path():
+    """U-dtype input without whitespace tokenizes to an (n, 1) matrix;
+    with whitespace it falls back to ragged lists — same tokens."""
+    from flink_ml_tpu.models.feature import Tokenizer
+
+    t = Table.from_columns(s=np.array(["AbC", "dEf"]))
+    out = Tokenizer(input_col="s", output_col="o").transform(t)[0]["o"]
+    assert out.shape == (2, 1) and out[0][0] == "abc" and out[1][0] == "def"
+
+    t2 = Table.from_columns(s=np.array(["A b", "c"]))
+    out2 = Tokenizer(input_col="s", output_col="o").transform(t2)[0]["o"]
+    assert list(out2[0]) == ["a", "b"] and list(out2[1]) == ["c"]
+
+
+def test_string_indexer_vectorized_matches_object(rng):
+    """U-dtype columns take the unique+gather path; results must equal the
+    object-column path for every order type, incl. handleInvalid."""
+    from flink_ml_tpu.models.feature import StringIndexer
+
+    vals = np.array(["b", "a", "b", "c", "a", "b"])
+    as_obj = np.array([str(v) for v in vals], dtype=object)
+    for order in ("arbitrary", "frequencyDesc", "frequencyAsc",
+                  "alphabetDesc", "alphabetAsc"):
+        m_u = StringIndexer(input_cols=["s"], output_cols=["i"],
+                            string_order_type=order).fit(
+            Table.from_columns(s=vals))
+        m_o = StringIndexer(input_cols=["s"], output_cols=["i"],
+                            string_order_type=order).fit(
+            Table.from_columns(s=as_obj))
+        assert m_u.string_arrays == m_o.string_arrays
+        np.testing.assert_array_equal(
+            m_u.transform(Table.from_columns(s=vals))[0]["i"],
+            m_o.transform(Table.from_columns(s=as_obj))[0]["i"])
+
+    # unseen value via the vectorized path honors handleInvalid=keep
+    m = StringIndexer(input_cols=["s"], output_cols=["i"],
+                      string_order_type="alphabetAsc",
+                      handle_invalid="keep").fit(Table.from_columns(s=vals))
+    out = m.transform(Table.from_columns(s=np.array(["a", "zz"])))[0]["i"]
+    np.testing.assert_array_equal(out, [0.0, 3.0])
