@@ -41,6 +41,14 @@ class KnnParams(KnnModelParams, HasLabelCol):
     pass
 
 
+def _vote(idx, label_idx, num_classes):
+    """Majority vote over neighbor indices; argmax → smallest label on
+    ties (the reference's hash-map iteration order is unspecified there).
+    The single tie-break rule shared by the XLA and pallas paths."""
+    votes = jax.nn.one_hot(label_idx[idx], num_classes).sum(axis=1)
+    return jnp.argmax(votes, axis=1)
+
+
 @functools.lru_cache(maxsize=8)
 def _build_knn_program(k: int, num_classes: int):
     @jax.jit
@@ -51,9 +59,25 @@ def _build_knn_program(k: int, num_classes: int):
               - 2.0 * cross + norms_train[None, :])
         kk = min(k, x_train.shape[0])
         _, idx = jax.lax.top_k(-d2, kk)
-        votes = jax.nn.one_hot(label_idx[idx], num_classes).sum(axis=1)
-        return jnp.argmax(votes, axis=1)  # argmax → smallest label on ties
+        return _vote(idx, label_idx, num_classes)
     return predict
+
+
+@functools.lru_cache(maxsize=8)
+def _build_vote_program(num_classes: int):
+    @jax.jit
+    def vote(idx, label_idx):
+        return _vote(idx, label_idx, num_classes)
+    return vote
+
+
+#: bound on the (chunk, n_train) distance block a single XLA predict call
+#: may materialize in HBM (the pallas path never materializes it at all)
+_MAX_DIST_ELEMS = 64 << 20
+
+# set on the first pallas lowering failure so later transforms skip straight
+# to the XLA path instead of re-tracing the kernel to the same exception
+_pallas_knn_broken = False
 
 
 class KnnModel(Model, KnnModelParams):
@@ -68,12 +92,61 @@ class KnnModel(Model, KnnModelParams):
             raise ValueError("KnnModel has no model data")
         x = table.vectors(self.features_col)
         classes, label_idx = np.unique(self.labels, return_inverse=True)
-        predict = _build_knn_program(self.k, len(classes))
+        n, n_train = x.shape[0], self.features.shape[0]
         train = jnp.asarray(self.features, jnp.float32)
-        norms = jnp.sum(train * train, axis=1)
-        pred_idx = np.asarray(predict(jnp.asarray(x, jnp.float32), train,
-                                      norms, jnp.asarray(label_idx)))
+        label_idx_d = jnp.asarray(label_idx)
+
+        pred_idx = self._predict_pallas(x, train, label_idx_d, len(classes))
+        if pred_idx is None:
+            # XLA fallback, memory-bounded: test rows in chunks so no
+            # (chunk, n_train) block exceeds _MAX_DIST_ELEMS
+            predict = _build_knn_program(self.k, len(classes))
+            norms = jnp.sum(train * train, axis=1)
+            chunk = max(1, min(n, _MAX_DIST_ELEMS // max(n_train, 1)))
+            parts = []
+            for s in range(0, n, chunk):
+                xc = jnp.asarray(x[s:s + chunk], jnp.float32)
+                parts.append(np.asarray(predict(xc, train, norms,
+                                                label_idx_d)))
+            pred_idx = np.concatenate(parts) if parts else np.zeros(0, int)
         return (table.with_column(self.prediction_col, classes[pred_idx]),)
+
+    def _predict_pallas(self, x, train, label_idx_d, num_classes):
+        """Fused distance+top-k kernel path: the (n, n_train) matrix never
+        exists, even tile-wise, outside VMEM. None = not applicable."""
+        from flink_ml_tpu.ops.pallas_kernels import (
+            KNN_TILE_N,
+            KNN_VMEM_BUDGET_BYTES,
+            knn_topk_indices,
+            pallas_supported,
+        )
+        global _pallas_knn_broken
+        nt, d = train.shape
+        vmem_bytes = nt * (d + KNN_TILE_N) * 4  # train block + dist block
+        if (_pallas_knn_broken or not pallas_supported()
+                or vmem_bytes > KNN_VMEM_BUDGET_BYTES):
+            return None
+        try:
+            idx = knn_topk_indices(jnp.asarray(x, jnp.float32), train,
+                                   self.k)
+            vote = _build_vote_program(num_classes)
+            return np.asarray(vote(idx, label_idx_d))
+        except Exception as e:
+            # only a lowering/compile failure disables the kernel for the
+            # process; anything else (transient OOM, bad input) propagates
+            # so the cause stays visible
+            msg = f"{type(e).__name__}: {e}"
+            if not any(s in msg for s in ("Mosaic", "lower", "Lower",
+                                          "NotImplemented", "Unimplemented",
+                                          "pallas", "Pallas")):
+                raise
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pallas KNN kernel failed to lower; falling back to XLA "
+                "for this process: %s", msg)
+            _pallas_knn_broken = True
+            return None
 
     def set_model_data(self, model_data: Table):
         self.features = model_data.vectors("packedFeatures", np.float64)

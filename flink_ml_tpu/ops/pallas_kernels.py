@@ -71,3 +71,73 @@ def assign_nearest(x, centroids, interpret: bool = False):
 def pallas_supported() -> bool:
     """True when the default backend can run compiled pallas kernels."""
     return jax.default_backend() == "tpu"
+
+
+# -- fused distance + top-k (KNN) -------------------------------------------
+
+KNN_TILE_N = 256
+#: VMEM the kernel may claim for the train block plus one (KNN_TILE_N,
+#: n_train) distance block — n_train*(d+KNN_TILE_N)*4 bytes must fit under
+#: it (callers gate on this); past it the chunked XLA path runs
+KNN_VMEM_BUDGET_BYTES = 8 << 20
+
+
+def _knn_kernel(k: int, x_ref, t_ref, tsq_ref, idx_ref):
+    """One test tile vs the FULL train block, entirely in VMEM: the
+    (tile_n, n_train) distance block never reaches HBM; only the (tile_n,
+    k) neighbor indices are written out. Top-k as k argmin+mask passes —
+    k is small (default 5) and Mosaic has no native top_k."""
+    x = x_ref[:]                        # (tile_n, d)
+    t = t_ref[:]                        # (n_train, d)
+    cross = jnp.dot(x, t.T, preferred_element_type=jnp.float32)
+    # ‖x−t‖² up to the per-row constant ‖x‖² (rank-invariant)
+    d2 = tsq_ref[:][None, :] - 2.0 * cross
+    n_train = d2.shape[1]
+
+    def pick(j, carry):
+        d2, best = carry
+        idx = jnp.argmin(d2, axis=1).astype(jnp.int32)
+        best = jax.lax.dynamic_update_slice(best, idx[:, None], (0, j))
+        taken = jax.nn.one_hot(idx, n_train, dtype=jnp.bool_)
+        d2 = jnp.where(taken, jnp.inf, d2)
+        return d2, best
+
+    best0 = jnp.zeros((x.shape[0], k), jnp.int32)
+    _, best = jax.lax.fori_loop(0, k, pick, (d2, best0))
+    idx_ref[:] = best
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def _knn_padded(x, train, k, interpret=False):
+    n, d = x.shape
+    nt = train.shape[0]
+    tsq = jnp.sum(train * train, axis=1)
+    kernel = functools.partial(_knn_kernel, k)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, k), jnp.int32),
+        grid=(n // KNN_TILE_N,),
+        in_specs=[
+            pl.BlockSpec((KNN_TILE_N, d), lambda i: (i, 0)),
+            pl.BlockSpec((nt, d), lambda i: (0, 0)),
+            pl.BlockSpec((nt,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((KNN_TILE_N, k), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x, train, tsq)
+
+
+def knn_topk_indices(x, train, k: int, interpret: bool = False):
+    """Indices of the k nearest train rows per test row — fused
+    distance+top-k; the (n_test, n_train) matrix exists only tile-wise in
+    VMEM. x: (n, d); train: (n_train, d) with n_train*(d+KNN_TILE_N)*4
+    within KNN_VMEM_BUDGET_BYTES (callers gate on it) → (n, k) int32.
+    Ties resolve to the lowest index (argmin), matching lax.top_k."""
+    x = jnp.asarray(x, jnp.float32)
+    train = jnp.asarray(train, jnp.float32)
+    k = min(k, train.shape[0])
+    n = x.shape[0]
+    pad = (-n) % KNN_TILE_N
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    return _knn_padded(x, train, k, interpret=interpret)[:n]

@@ -23,3 +23,48 @@ def test_assign_nearest_exact_tile_boundary(rng):
     got = np.asarray(assign_nearest(x, c, interpret=True))
     want = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1).argmin(1)
     np.testing.assert_array_equal(got, want)
+
+
+def test_knn_topk_matches_xla(rng):
+    from flink_ml_tpu.ops.pallas_kernels import knn_topk_indices
+
+    x = rng.normal(size=(300, 8)).astype(np.float32)
+    train = rng.normal(size=(37, 8)).astype(np.float32)
+    got = np.asarray(knn_topk_indices(x, train, 5, interpret=True))
+    d2 = ((x[:, None, :] - train[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d2, axis=1, kind="stable")[:, :5]
+    np.testing.assert_array_equal(np.sort(got, axis=1),
+                                  np.sort(want, axis=1))
+    # nearest-first ordering (argmin passes pick ascending distance)
+    np.testing.assert_array_equal(got[:, 0], d2.argmin(1))
+
+
+def test_knn_topk_k_exceeds_train(rng):
+    from flink_ml_tpu.ops.pallas_kernels import knn_topk_indices
+
+    x = rng.normal(size=(10, 4)).astype(np.float32)
+    train = rng.normal(size=(3, 4)).astype(np.float32)
+    got = np.asarray(knn_topk_indices(x, train, 5, interpret=True))
+    assert got.shape == (10, 3)  # k clamps to n_train
+
+
+def test_knn_chunked_fallback_matches_single_shot(rng, monkeypatch):
+    """The memory-bounded XLA path (forced by a tiny chunk budget) must
+    equal the one-shot program."""
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.classification import knn as knn_mod
+    from flink_ml_tpu.models.classification.knn import Knn
+
+    x = rng.normal(size=(200, 6))
+    yl = rng.integers(0, 3, 200).astype(np.float64)
+    t = Table.from_columns(features=x, label=yl)
+    model = Knn(k=5).fit(t)
+
+    expected = model.transform(t)[0]["prediction"]
+    # force the XLA fallback even on a TPU backend, else both transforms
+    # would take the pallas path and the chunk loop would go untested
+    from flink_ml_tpu.ops import pallas_kernels
+    monkeypatch.setattr(pallas_kernels, "pallas_supported", lambda: False)
+    monkeypatch.setattr(knn_mod, "_MAX_DIST_ELEMS", 6 * 200)  # ~6-row chunks
+    chunked = model.transform(t)[0]["prediction"]
+    np.testing.assert_array_equal(np.asarray(expected), np.asarray(chunked))
