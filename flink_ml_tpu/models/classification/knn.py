@@ -132,19 +132,15 @@ class KnnModel(Model, KnnModelParams):
             vote = _build_vote_program(num_classes)
             return np.asarray(vote(idx, label_idx_d))
         except Exception as e:
-            # only a lowering/compile failure disables the kernel for the
-            # process; anything else (transient OOM, bad input) propagates
-            # so the cause stays visible
-            msg = f"{type(e).__name__}: {e}"
-            if not any(s in msg for s in ("Mosaic", "lower", "Lower",
-                                          "NotImplemented", "Unimplemented",
-                                          "pallas", "Pallas")):
-                raise
+            # any kernel failure falls back to the (correct, slower) XLA
+            # path rather than crashing predict; the process flag stops
+            # re-tracing the same failure each call, and the warning keeps
+            # the cause visible (same policy as the KMeans assign kernel)
             import logging
 
             logging.getLogger(__name__).warning(
-                "pallas KNN kernel failed to lower; falling back to XLA "
-                "for this process: %s", msg)
+                "pallas KNN kernel failed; using the XLA path for the "
+                "rest of this process: %s: %s", type(e).__name__, e)
             _pallas_knn_broken = True
             return None
 

@@ -119,17 +119,40 @@ class StandardScalerModel(_VectorStatModelBase, StandardScalerParams):
                 (bool(self.with_mean), bool(self.with_std)))
 
 
+def _mean_varsum_kernel(x):
+    """(2, d): per-dim mean and centered sum of squares — the two-pass
+    form of the reference's Σx²−n·mean² (identical in exact arithmetic,
+    stable in float32)."""
+    mean = jnp.mean(x, axis=0)
+    return jnp.stack([mean, jnp.sum((x - mean[None, :]) ** 2, axis=0)])
+
+
+def mean_and_std(table, input_col):
+    """Per-dimension (mean, unbiased std) — ON device for device-resident
+    columns (no table off-ramp); the float64 host branch keeps the
+    reference's exact Σx²−n·mean² formula (StandardScaler.java:119-131)."""
+    x, xp = columnar.fit_vectors(table, input_col)
+    n = x.shape[0]
+    if xp is jnp:
+        stats = np.asarray(columnar.apply(_mean_varsum_kernel, x),
+                           np.float64)
+        mean, varsum = stats[0], stats[1]
+        std = (np.sqrt(varsum / (n - 1)) if n > 1
+               else np.zeros_like(mean))
+        return mean, std
+    mean = x.mean(axis=0)
+    if n > 1:
+        # ref formula: sqrt((Σx² − n·mean²)/(n−1))
+        std = np.sqrt(np.maximum(
+            ((x * x).sum(axis=0) - n * mean * mean) / (n - 1), 0.0))
+    else:
+        std = np.zeros_like(mean)
+    return mean, std
+
+
 class StandardScaler(Estimator, StandardScalerParams):
     def fit(self, table: Table) -> StandardScalerModel:
-        x = table.vectors(self.input_col, np.float64)
-        n = x.shape[0]
-        mean = x.mean(axis=0)
-        if n > 1:
-            # ref formula: sqrt((Σx² − n·mean²)/(n−1))
-            std = np.sqrt(np.maximum(
-                ((x * x).sum(axis=0) - n * mean * mean) / (n - 1), 0.0))
-        else:
-            std = np.zeros_like(mean)
+        mean, std = mean_and_std(table, self.input_col)
         model = StandardScalerModel(mean=mean, std=std)
         return self.copy_params_to(model)
 
@@ -160,11 +183,20 @@ class MinMaxScalerModel(_VectorStatModelBase, MinMaxScalerParams):
                  np.float32(self.min), np.float32(self.max)), ())
 
 
+def _minmax_kernel(x):
+    return jnp.stack([jnp.min(x, axis=0), jnp.max(x, axis=0)])
+
+
 class MinMaxScaler(Estimator, MinMaxScalerParams):
     def fit(self, table: Table) -> MinMaxScalerModel:
-        x = table.vectors(self.input_col, np.float64)
-        model = MinMaxScalerModel(data_min=x.min(axis=0),
-                                  data_max=x.max(axis=0))
+        x, xp = columnar.fit_vectors(table, self.input_col)
+        if xp is jnp:
+            lo_hi = np.asarray(columnar.apply(_minmax_kernel, x),
+                               np.float64)
+            lo, hi = lo_hi[0], lo_hi[1]
+        else:
+            lo, hi = x.min(axis=0), x.max(axis=0)
+        model = MinMaxScalerModel(data_min=lo, data_max=hi)
         return self.copy_params_to(model)
 
 
@@ -187,10 +219,16 @@ class MaxAbsScalerModel(_VectorStatModelBase, MaxAbsScalerParams):
         return ((self.max_abs,), ())
 
 
+def _maxabs_kernel(x):
+    return jnp.max(jnp.abs(x), axis=0)
+
+
 class MaxAbsScaler(Estimator, MaxAbsScalerParams):
     def fit(self, table: Table) -> MaxAbsScalerModel:
-        x = table.vectors(self.input_col, np.float64)
-        model = MaxAbsScalerModel(max_abs=np.abs(x).max(axis=0))
+        x, xp = columnar.fit_vectors(table, self.input_col)
+        max_abs = (np.asarray(columnar.apply(_maxabs_kernel, x), np.float64)
+                   if xp is jnp else np.abs(x).max(axis=0))
+        model = MaxAbsScalerModel(max_abs=max_abs)
         return self.copy_params_to(model)
 
 
@@ -226,13 +264,26 @@ class RobustScalerModel(_VectorStatModelBase, RobustScalerParams):
                 (bool(self.with_centering), bool(self.with_scaling)))
 
 
+def _quantile3_kernel(x, qs):
+    return jnp.quantile(x, qs, axis=0)
+
+
 class RobustScaler(Estimator, RobustScalerParams):
     def fit(self, table: Table) -> RobustScalerModel:
-        from flink_ml_tpu.ops.quantile import approx_quantiles
-        x = table.vectors(self.input_col, np.float64)
-        qs = approx_quantiles(
-            x, [self.lower, 0.5, self.upper],
-            relative_error=self.relative_error)
+        x, xp = columnar.fit_vectors(table, self.input_col)
+        if xp is jnp:
+            # device-resident input: EXACT quantiles via a device sort —
+            # exact ⊇ the ε-approximate contract of relativeError (same
+            # argument as the Imputer median, docs/deviations.md)
+            qs = np.asarray(columnar.apply(
+                _quantile3_kernel, x,
+                (np.asarray([self.lower, 0.5, self.upper], np.float32),)),
+                np.float64)
+        else:
+            from flink_ml_tpu.ops.quantile import approx_quantiles
+            qs = approx_quantiles(
+                x, [self.lower, 0.5, self.upper],
+                relative_error=self.relative_error)
         lo, med, hi = qs[0], qs[1], qs[2]
         model = RobustScalerModel(medians=med, ranges=hi - lo)
         return self.copy_params_to(model)

@@ -191,9 +191,21 @@ class VarianceThresholdSelector(Estimator, VarianceThresholdSelectorParams):
     (ref: feature/variancethresholdselector/)."""
 
     def fit(self, table: Table) -> VarianceThresholdSelectorModel:
-        x = table.vectors(self.input_col, np.float64)
-        variances = x.var(axis=0, ddof=1) if x.shape[0] > 1 \
-            else np.zeros(x.shape[1])
+        from flink_ml_tpu.models.feature.scalers import _mean_varsum_kernel
+        from flink_ml_tpu.ops import columnar
+
+        # two-pass variance on BOTH paths (cancellation-stable; the host
+        # Σx²−n·mean² form belongs to StandardScaler's reference-formula
+        # parity only); device-resident input never off-ramps
+        x, xp = columnar.fit_vectors(table, self.input_col)
+        n = x.shape[0]
+        if xp is np:
+            variances = x.var(axis=0, ddof=1) if n > 1 \
+                else np.zeros(x.shape[1])
+        else:
+            varsum = np.asarray(
+                columnar.apply(_mean_varsum_kernel, x), np.float64)[1]
+            variances = varsum / (n - 1) if n > 1 else np.zeros(x.shape[1])
         indices = np.nonzero(variances > self.variance_threshold)[0]
         model = VarianceThresholdSelectorModel(indices=indices)
         return self.copy_params_to(model)

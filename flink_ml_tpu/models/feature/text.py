@@ -22,6 +22,8 @@ from typing import Tuple
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from flink_ml_tpu.api.stage import Estimator, Model, Transformer
 from flink_ml_tpu.common.table import Table
 from flink_ml_tpu.linalg.vectors import SparseVector
@@ -537,6 +539,10 @@ def _idf_kernel(x, idf):
     return x * idf[None, :]
 
 
+def _df_kernel(x):
+    return jnp.sum(x != 0, axis=0)
+
+
 class IDFModel(Model, IDFModelParams):
     def __init__(self, idf=None, doc_freq=None, num_docs=0, **kwargs):
         super().__init__(**kwargs)
@@ -582,9 +588,14 @@ class IDF(Estimator, IDFParams):
     df < minDocFreq get idf 0 (ref: feature/idf/IDF.java)."""
 
     def fit(self, table: Table) -> IDFModel:
-        x = table.vectors(self.input_col, np.float64)
+        from flink_ml_tpu.ops import columnar
+
+        x, xp = columnar.fit_vectors(table, self.input_col)
         m = x.shape[0]
-        df = (x != 0).sum(axis=0)
+        if xp is not np:  # device-resident: df reduction stays on device
+            df = np.asarray(columnar.apply(_df_kernel, x), np.float64)
+        else:
+            df = (x != 0).sum(axis=0)
         idf = np.log((m + 1.0) / (df + 1.0))
         idf = np.where(df >= self.min_doc_freq, idf, 0.0)
         model = IDFModel(idf=idf, doc_freq=df.astype(np.int64), num_docs=m)

@@ -71,6 +71,48 @@ def _as_stream(data: Union[Table, StreamTable], batch_size: int):
     return generate_batches(data, batch_size)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float):
+    """ONE FTRL global-batch update as a compiled SPMD program: batch
+    sharded over the mesh's data axes, (w, z, n) replicated, the gradient
+    reduction one psum — the dense-branch math of CalculateLocalGradient:
+    364-388 + UpdateModel:295-319 with the TPU doing the batch matmul
+    instead of a host numpy loop (the round-2 'online fits leave the
+    device idle' gap)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from flink_ml_tpu.parallel.collective import local_valid_mask
+    from flink_ml_tpu.parallel.mesh import data_axes, data_pspec
+
+    axes = data_axes(mesh)
+    spec0 = data_pspec(mesh)
+
+    def per_shard(xl, yl, n_valid, coeffs, z, n):
+        vl = local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
+        p = 1.0 / (1.0 + jnp.exp(-(xl @ coeffs)))
+        grad = jax.lax.psum(((p - yl) * vl) @ xl, axes)
+        # dense-path reference semantics: weight sum = batch row count at
+        # every coordinate
+        g = grad / jnp.maximum(n_valid.astype(grad.dtype), 1.0)
+        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / alpha
+        z = z + g - sigma * coeffs
+        n = n + g * g
+        coeffs = jnp.where(
+            jnp.abs(z) <= l1, 0.0,
+            (jnp.sign(z) * l1 - z) / ((beta + jnp.sqrt(n)) / alpha + l2))
+        return coeffs, z, n
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(spec0, None), P(spec0), P(), P(), P(), P()),
+        out_specs=(P(), P(), P()), check_vma=False))
+
+
 # ---------------------------------------------------------------------------
 # OnlineLogisticRegression (FTRL)
 # ---------------------------------------------------------------------------
@@ -269,37 +311,61 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
             history[:] = [(int(v), c) for v, c in zip(hv, hc)]
 
         from flink_ml_tpu.linalg import sparse
+        from flink_ml_tpu.parallel.collective import ensure_on_mesh
+        from flink_ml_tpu.parallel.mesh import data_axes, default_mesh
+
+        mesh = default_mesh()
+        axes = data_axes(mesh)
 
         for batch in _as_stream(data, self.global_batch_size):
-            x = sparse.features_matrix(batch, self.features_col, np.float64)
+            # float32 request: a device-resident dense column passes
+            # through untouched (no D2H off-ramp); the CSR branch is
+            # always float64 regardless (features_matrix contract)
+            x = sparse.features_matrix(batch, self.features_col, np.float32)
+            if not sparse.is_csr(x):
+                # dense batches update on device: one compiled SPMD step
+                # per batch (state round-trips as three (d,) vectors —
+                # negligible next to the batch matmul)
+                import jax.numpy as jnp
+
+                program = _ftrl_program(mesh, alpha, beta, l1, l2)
+                xb, n_rows = ensure_on_mesh(mesh, x, axes, jnp.float32)
+                ycol = batch.column(self.label_col)  # device col stays put
+                if isinstance(ycol, np.ndarray):
+                    ycol = batch.scalars(self.label_col)
+                yb, _ = ensure_on_mesh(mesh, ycol, axes, jnp.float32)
+                coeffs_d, z_d, n_d = program(
+                    xb, yb, jnp.float32(n_rows),
+                    jnp.asarray(coeffs, jnp.float32),
+                    jnp.asarray(z, jnp.float32),
+                    jnp.asarray(n, jnp.float32))
+                coeffs = np.asarray(coeffs_d, np.float64)
+                z = np.asarray(z_d, np.float64)
+                n = np.asarray(n_d, np.float64)
+                version += 1
+                history.append((version, coeffs.copy()))
+                ckpt.after_batch(pack)
+                continue
             y = batch.scalars(self.label_col, np.float64)
-            if sparse.is_csr(x):
-                # sparse branch (ref CalculateLocalGradient:364-388): the
-                # gradient and the weight sum accumulate ONLY at a sample's
-                # non-zero coordinates; weightSum adds the sample weight
-                # there (dense adds 1.0 everywhere). Never densifies: CSR
-                # matvec + bincount scatter at 2^18 dims stays O(nnz).
-                w_col = (batch.scalars(self.weight_col, np.float64)
-                         if self.weight_col is not None
-                         and self.weight_col in batch
-                         else np.ones(x.shape[0], np.float64))
-                p = 1.0 / (1.0 + np.exp(-(x @ coeffs)))
-                row_nnz = np.diff(x.indptr)
-                d = x.shape[1]
-                grad = np.bincount(
-                    x.indices,
-                    weights=x.data * np.repeat(p - y, row_nnz),
-                    minlength=d)
-                weight_sum = np.bincount(
-                    x.indices, weights=np.repeat(w_col, row_nnz),
-                    minlength=d)
-            else:
-                p = 1.0 / (1.0 + np.exp(-(x @ coeffs)))
-                # dense-path reference semantics: unweighted per-coordinate
-                # gradient, weight sum counts every sample at every
-                # coordinate (CalculateLocalGradient:376-380)
-                grad = ((p - y)[:, None] * x).sum(axis=0)
-                weight_sum = np.full_like(grad, len(y), np.float64)
+            # sparse branch (ref CalculateLocalGradient:364-388): the
+            # gradient and the weight sum accumulate ONLY at a sample's
+            # non-zero coordinates; weightSum adds the sample weight
+            # there (dense adds 1.0 everywhere). Never densifies: CSR
+            # matvec + bincount scatter at 2^18 dims stays O(nnz).
+            w_col = (batch.scalars(self.weight_col, np.float64)
+                     if self.weight_col is not None
+                     and self.weight_col in batch
+                     else np.ones(x.shape[0], np.float64))
+            p = 1.0 / (1.0 + np.exp(-(x @ coeffs)))
+            row_nnz = np.diff(x.indptr)
+            d = x.shape[1]
+            grad = np.bincount(
+                x.indices,
+                weights=x.data * np.repeat(p - y, row_nnz),
+                minlength=d)
+            weight_sum = np.bincount(
+                x.indices, weights=np.repeat(w_col, row_nnz),
+                minlength=d)
             g = np.where(weight_sum != 0, grad / np.where(weight_sum != 0,
                                                           weight_sum, 1), 0)
             sigma = (np.sqrt(n + g * g) - np.sqrt(n)) / alpha

@@ -106,6 +106,20 @@ def apply_multi(fn, xs: Sequence, consts: Sequence = (), static: Tuple = ()):
     return _jitted(fn, len(static), n_args)(*xs_d, *consts_d, *static)
 
 
+def fit_vectors(table, col: str):
+    """Fit-statistics on-ramp: returns ``(x, xp)``. A device-resident
+    column keeps its residency — fit statistics then compute ON device in
+    float32 (the module dtype policy) instead of off-ramping the whole
+    table; a host column keeps the float64 host contract. The xp namespace
+    (jnp vs np) tells the caller which path it got."""
+    import numpy as np
+
+    raw = table.column(col)
+    if is_device_array(raw):
+        return (raw if raw.ndim == 2 else raw[:, None]), jnp
+    return table.vectors(col, np.float64), np
+
+
 def input_vectors(table, col: str) -> jax.Array:
     """Table → sharded (n, d) device array (the device on-ramp for vector
     columns; passthrough when a previous stage already left the column on

@@ -141,3 +141,65 @@ def test_univariate_selector_fpr_modes(rng):
     f_sk, p_sk = f_regression(x, y)
     np.testing.assert_allclose(f_ours, f_sk, rtol=1e-8)
     np.testing.assert_allclose(p_ours, p_sk, rtol=1e-8, atol=1e-12)
+
+
+def test_device_resident_fit_stats_match_host(rng):
+    """A device-resident input column computes fit statistics ON device;
+    results must match the float64 host path within float32 tolerance
+    (the dtype policy), for every stat-fitting estimator with a device
+    branch."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import (
+        IDF,
+        MaxAbsScaler,
+        MinMaxScaler,
+        RobustScaler,
+        StandardScaler,
+        VarianceThresholdSelector,
+    )
+    from flink_ml_tpu.ops import columnar
+
+    x = rng.normal(size=(500, 6)) * [1, 2, 3, 4, 5, 6] + 10
+    t_host = Table.from_columns(input=x)
+    t_dev = Table.from_columns(input=columnar.to_device(
+        x.astype(np.float32)))
+
+    pairs = [
+        (StandardScaler(input_col="input", output_col="o"),
+         lambda m: (m.mean, m.std)),
+        (MinMaxScaler(input_col="input", output_col="o"),
+         lambda m: (m.data_min, m.data_max)),
+        (MaxAbsScaler(input_col="input", output_col="o"),
+         lambda m: (m.max_abs,)),
+        (IDF(input_col="input", output_col="o"),
+         lambda m: (m.idf, m.doc_freq)),
+    ]
+    for est, stats in pairs:
+        m_h = est.fit(t_host)
+        m_d = est.fit(t_dev)
+        for a, b in zip(stats(m_h), stats(m_d)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=2e-4, atol=1e-4,
+                                       err_msg=type(est).__name__)
+
+    # RobustScaler's device path is EXACT quantiles (the host path is the
+    # GK ε-approximation, so host-vs-device differs by design within ε);
+    # the device result must match the exact numpy oracle
+    rs_d = RobustScaler(input_col="input", output_col="o").fit(t_dev)
+    x32 = x.astype(np.float32)
+    np.testing.assert_allclose(
+        rs_d.medians, np.quantile(x32, 0.5, axis=0), rtol=2e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        rs_d.ranges,
+        np.quantile(x32, 0.75, axis=0) - np.quantile(x32, 0.25, axis=0),
+        rtol=2e-3, atol=1e-3)
+
+    sel_h = VarianceThresholdSelector(
+        input_col="input", output_col="o",
+        variance_threshold=4.0).fit(t_host)
+    sel_d = VarianceThresholdSelector(
+        input_col="input", output_col="o",
+        variance_threshold=4.0).fit(t_dev)
+    np.testing.assert_array_equal(sel_h.indices, sel_d.indices)

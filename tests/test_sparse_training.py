@@ -67,8 +67,10 @@ def test_mixed_dense_sparse_column_and_ragged_raise(rng):
 
 def test_ftrl_sparse_full_pattern_matches_dense(rng):
     """With every coordinate present in each SparseVector, the sparse
-    branch reduces exactly to the dense branch — coefficients must agree
-    bit-for-bit (both paths are float64 host)."""
+    branch reduces to the dense branch. The dense branch now runs as a
+    compiled float32 device program (docs/deviations.md dtype policy)
+    while sparse stays float64 host, so agreement is allclose, not
+    bit-for-bit."""
     from flink_ml_tpu.models.online import OnlineLogisticRegression
     n, d = 400, 6
     x = rng.normal(size=(n, d))
@@ -85,8 +87,9 @@ def test_ftrl_sparse_full_pattern_matches_dense(rng):
 
     dense_model = fit(x)
     sparse_model = fit(_sparse_column_from_dense(x, keep_all=True))
-    np.testing.assert_array_equal(sparse_model.coefficients,
-                                  dense_model.coefficients)
+    np.testing.assert_allclose(sparse_model.coefficients,
+                               dense_model.coefficients,
+                               rtol=1e-5, atol=1e-7)
     assert sparse_model.model_version == dense_model.model_version
 
 
