@@ -61,12 +61,16 @@ class NaiveBayesModel(Model, NaiveBayesModelParams):
         n, d = x.shape
         num_labels = len(self.labels)
         probs = np.tile(self.pi, (n, 1))
-        for li in range(num_labels):
-            for j in range(d):
+        # vectorized: one unique per feature column, then per-label lookup
+        # tables over the DISTINCT values + one gather — not n dict probes
+        for j in range(d):
+            vals, codes = np.unique(x[:, j], return_inverse=True)
+            lut = np.empty((num_labels, len(vals)))
+            for li in range(num_labels):
                 mapping = self.theta[li][j]
                 floor = self.floors[li][j]
-                probs[:, li] += np.asarray(
-                    [mapping.get(v, floor) for v in x[:, j]])
+                lut[li] = [mapping.get(v, floor) for v in vals.tolist()]
+            probs += lut[:, codes].T
         pred = self.labels[np.argmax(probs, axis=1)]
         return (table.with_column(self.prediction_col, pred),)
 
@@ -109,27 +113,28 @@ class NaiveBayes(Estimator, NaiveBayesParams):
         y = table.scalars(self.label_col, np.float64)
         smoothing = self.smoothing
         n, d = x.shape
-        labels = np.unique(y)
+        labels, y_idx = np.unique(y, return_inverse=True)
         num_labels = len(labels)
 
-        # per-(label, feature): value → doc count; per-feature category sets
-        categories = [set(np.unique(x[:, j]).tolist()) for j in range(d)]
-        doc_counts = np.asarray([(y == label).sum() for label in labels],
-                                np.float64)
-        theta, floors = [], np.zeros((num_labels, d))
-        for li, label in enumerate(labels):
-            rows = x[y == label]
-            per_feature = []
-            for j in range(d):
-                vals, counts = np.unique(rows[:, j], return_counts=True)
-                counts_map = dict(zip(vals.tolist(), counts.tolist()))
-                denom = np.log(doc_counts[li] + smoothing * len(categories[j]))
-                per_feature.append({
-                    v: np.log(counts_map.get(v, 0.0) + smoothing) - denom
-                    for v in categories[j]})
-                floors[li, j] = (np.log(smoothing) - denom if smoothing > 0
-                                 else -np.inf)
-            theta.append(per_feature)
+        # vectorized counting: one unique per feature column, then one
+        # (label, value) bincount — L·d sub-array uniques become d passes
+        doc_counts = np.bincount(y_idx, minlength=num_labels).astype(
+            np.float64)
+        theta = [[] for _ in range(num_labels)]
+        floors = np.zeros((num_labels, d))
+        for j in range(d):
+            vals, codes = np.unique(x[:, j], return_inverse=True)
+            nv = len(vals)
+            counts = np.bincount(y_idx * nv + codes,
+                                 minlength=num_labels * nv) \
+                .reshape(num_labels, nv)
+            denom = np.log(doc_counts + smoothing * nv)  # (L,)
+            logp = np.log(counts + smoothing) - denom[:, None]
+            val_list = vals.tolist()
+            floors[:, j] = (np.log(smoothing) - denom if smoothing > 0
+                            else -np.inf)
+            for li in range(num_labels):
+                theta[li].append(dict(zip(val_list, logp[li].tolist())))
 
         pi_log = np.log(n * d + num_labels * smoothing)
         pi = np.log(doc_counts * d + smoothing) - pi_log

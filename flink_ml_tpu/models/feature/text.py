@@ -251,14 +251,16 @@ class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
             out = np.empty(len(col), dtype=object)
             if _is_token_matrix(col):
                 # vectorized: fold every distinct token once, mask by isin;
-                # filtering makes rows ragged → object column of arrays
+                # filtering makes rows ragged → object column of arrays,
+                # assembled as one flat filter + np.split (no per-row
+                # boolean indexing)
                 uniq, codes = _token_codes(col)
                 folded = (uniq if self.case_sensitive else np.array(
                     [self._fold(str(t), self.locale) for t in uniq]))
-                drop = np.isin(folded, np.array(sorted(stop)))[codes] \
-                    .reshape(col.shape)
-                for i in range(len(col)):
-                    out[i] = col[i][~drop[i]]
+                keep_flat = ~np.isin(folded, np.array(sorted(stop)))[codes]
+                kept = col.reshape(-1)[keep_flat]
+                counts = keep_flat.reshape(col.shape).sum(axis=1)
+                out[:] = np.split(kept, np.cumsum(counts[:-1]))
                 outs[out_name] = out
                 continue
             for i, tokens in enumerate(col):
