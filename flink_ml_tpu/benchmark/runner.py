@@ -112,12 +112,28 @@ def run_benchmark(name: str, spec: dict) -> dict:
 
 
 def _block_device_columns(table) -> None:
-    """Wait for any device-resident columns (device datagen / device
-    transforms dispatch asynchronously; timing must cover real work)."""
+    """Materialize any device-resident columns before the timestamp.
+
+    ``block_until_ready`` alone is NOT sufficient on the relayed TPU
+    backend: it can resolve before remote execution completes, so a chain
+    of pure-device work times as dispatch-only (~1 ms for a 4 GB program —
+    see scripts/probe_async_timing.py for the diagnosis). A device-side
+    reduce fetched to host is the reliable sync, and matches the
+    reference's measurement semantics anyway: its benchmark sink consumes
+    every record (BenchmarkUtils.CountingAndDiscardingSink:156), so data
+    must actually exist, not merely be scheduled."""
+    import jax.numpy as jnp
+    import numpy as np
+
     for name in table.column_names:
         col = table.column(name)
         if hasattr(col, "block_until_ready"):
-            col.block_until_ready()
+            try:
+                # full-graph sync: device reduce + one scalar D2H; the
+                # cast covers every numeric width (bf16/int/bool included)
+                np.asarray(jnp.sum(col.astype(jnp.float32)))
+            except TypeError:
+                col.block_until_ready()  # non-numeric device dtype
 
 
 def run_benchmarks(config: dict) -> dict:

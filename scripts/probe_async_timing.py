@@ -1,0 +1,60 @@
+"""Probe whether benchmark timings on the axon-relayed TPU are real.
+
+The r3 sweep recorded dct 10M x 100 at ~1 ms total — physically impossible
+(generating the 4 GB input alone needs ~5 ms of HBM writes). Two possible
+causes, discriminated here:
+
+A. the relay memoizes identical (executable, inputs) executions — then a
+   repeated same-seed run is ~free while a fresh-seed run pays full cost;
+B. ``block_until_ready`` on a relayed array does not actually wait for
+   remote completion — then even fresh-seed runs look ~free until a D2H
+   forces materialization (the checksum leg).
+
+Run on the real chip: ``python scripts/probe_async_timing.py``.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    print("backend:", jax.default_backend(), jax.devices())
+    from flink_ml_tpu.benchmark.datagen import DenseVectorGenerator
+    from flink_ml_tpu.models.feature import DCT
+
+    def one_run(seed):
+        gen = DenseVectorGenerator(seed=seed, col_names=[["input"]],
+                                   num_values=10_000_000, vector_dim=100)
+        dct = DCT(input_col="input", output_col="o")
+        t0 = time.perf_counter()
+        table = gen.get_data()
+        table.column("input").block_until_ready()
+        t1 = time.perf_counter()
+        out = dct.transform(table)[0]
+        out.column("o").block_until_ready()
+        t2 = time.perf_counter()
+        s = float(jnp.sum(out.column("o")))  # device reduce + scalar D2H
+        t3 = time.perf_counter()
+        return (t1 - t0, t2 - t1, t3 - t2, s)
+
+    one_run(0)  # compile warmup
+    print("same seed x3 (gen_s, dct_s, checksum_s):")
+    for _ in range(3):
+        g, d, c, s = one_run(2)
+        print(f"  gen {g*1e3:8.2f} ms  dct {d*1e3:8.2f} ms  "
+              f"checksum {c*1e3:8.2f} ms  sum={s:.1f}")
+    print("fresh seed x3:")
+    for i in range(3):
+        g, d, c, s = one_run(100 + i)
+        print(f"  gen {g*1e3:8.2f} ms  dct {d*1e3:8.2f} ms  "
+              f"checksum {c*1e3:8.2f} ms  sum={s:.1f}")
+
+
+if __name__ == "__main__":
+    main()
