@@ -121,7 +121,12 @@ def _block_device_columns(table) -> None:
     reduce fetched to host is the reliable sync, and matches the
     reference's measurement semantics anyway: its benchmark sink consumes
     every record (BenchmarkUtils.CountingAndDiscardingSink:156), so data
-    must actually exist, not merely be scheduled."""
+    must actually exist, not merely be scheduled.
+
+    The reduce compiles once per column shape/dtype; a single cold
+    run_benchmark call therefore includes that compile in its timing.
+    Every reported protocol (bench.py, the sweep script) runs an identical
+    warmup first, so steady-state numbers exclude it."""
     import jax.numpy as jnp
     import numpy as np
 

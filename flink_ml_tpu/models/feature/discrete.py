@@ -354,9 +354,20 @@ class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
     (ref: KBinsDiscretizer.java; fit on at most subSamples rows)."""
 
     def fit(self, table: Table) -> KBinsDiscretizerModel:
-        x = table.vectors(self.input_col, np.float64)
-        if x.shape[0] > self.sub_samples:
-            x = x[: self.sub_samples]
+        from flink_ml_tpu.ops import columnar
+
+        raw = table.column(self.input_col)
+        if columnar.is_device_array(raw):
+            # slice BEFORE the host off-ramp: only subSamples rows cross
+            # D2H (the reference likewise fits on the subsample)
+            n = min(raw.shape[0], self.sub_samples)
+            x = np.asarray(raw[:n], np.float64)
+            if x.ndim == 1:
+                x = x[:, None]
+        else:
+            x = table.vectors(self.input_col, np.float64)
+            if x.shape[0] > self.sub_samples:
+                x = x[: self.sub_samples]
         k = self.num_bins
         edges_per_dim = []
         for j in range(x.shape[1]):

@@ -126,7 +126,13 @@ class UnivariateFeatureSelector(Estimator, UnivariateFeatureSelectorParams):
         ftype, ltype = self.feature_type, self.label_type
         if ftype is None or ltype is None:
             raise ValueError("featureType and labelType must be set")
-        x = table.vectors(self.features_col, np.float64)
+        from flink_ml_tpu.ops import columnar
+
+        if ftype == self.CONTINUOUS:
+            # continuous tests reduce on device for device-resident input
+            x, _ = columnar.fit_vectors(table, self.features_col)
+        else:  # chi2 contingency counting is host-side
+            x = table.vectors(self.features_col, np.float64)
         y = np.asarray(table.column(self.label_col))
         if ftype == self.CATEGORICAL and ltype == self.CATEGORICAL:
             _, p_values, _ = chi_square_test(x, y)

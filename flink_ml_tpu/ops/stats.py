@@ -39,16 +39,62 @@ def chi_square_test(features: np.ndarray, labels: np.ndarray) -> Arrays:
     return np.asarray(stats_), np.asarray(ps), np.asarray(dofs, np.int64)
 
 
+def _is_device(x) -> bool:
+    return not isinstance(x, np.ndarray) and hasattr(x, "addressable_shards")
+
+
+def _group_sums_kernel(x, y, c):
+    import jax.numpy as jnp
+    import jax.nn
+
+    oh = jax.nn.one_hot(y.astype(jnp.int32), c, dtype=x.dtype)  # (n, c)
+    return jnp.concatenate([oh.sum(axis=0)[:, None], oh.T @ x], axis=1)
+
+
+def _group_ssw_kernel(x, y, means):
+    import jax.numpy as jnp
+
+    centered = x - means[y.astype(jnp.int32)]
+    return jnp.sum(centered * centered, axis=0)
+
+
 def anova_f_test(features: np.ndarray, labels: np.ndarray) -> Arrays:
     """One-way ANOVA F-test per feature (ref: stats/anovatest/ANOVATest.java
-    — continuous feature vs categorical label)."""
-    features = np.asarray(features, np.float64)
+    — continuous feature vs categorical label).
+
+    A device-resident feature matrix reduces ON device (two passes: group
+    counts/sums, then centered within-group sum of squares against the
+    replicated group means — float32-stable); only the (c, d) group stats
+    cross to host, where the F/p math runs in float64."""
     labels = np.asarray(labels)
-    classes = np.unique(labels)
+    classes, y_idx = np.unique(labels, return_inverse=True)
+    c = len(classes)
+    if _is_device(features):
+        from flink_ml_tpu.ops import columnar
+
+        n, d = features.shape
+        y32 = y_idx.astype(np.int32)
+        packed = np.asarray(columnar.apply_multi(
+            _group_sums_kernel, (features, y32), static=(c,)), np.float64)
+        counts, sums = packed[:, 0], packed[:, 1:]
+        means = sums / np.maximum(counts[:, None], 1.0)
+        ssw = np.asarray(columnar.apply_multi(
+            _group_ssw_kernel, (features, y32),
+            consts=(means.astype(np.float32),)), np.float64)
+        grand = sums.sum(axis=0) / n
+        ssb = (counts[:, None] * (means - grand[None, :]) ** 2).sum(axis=0)
+        dfb, dfw = c - 1, n - c
+        # IEEE semantics mirror scipy.f_oneway: ssw=0 with signal → F=inf
+        # (p=0); 0/0 (constant feature) → NaN, as on the host path
+        with np.errstate(divide="ignore", invalid="ignore"):
+            f = (ssb / dfb) / (ssw / dfw)
+        p = sstats.f.sf(f, dfb, dfw)
+        return f, p, np.full(d, dfw, np.int64)
+    features = np.asarray(features, np.float64)
     stats_, ps, dofs = [], [], []
     n = features.shape[0]
     for j in range(features.shape[1]):
-        groups = [features[labels == c, j] for c in classes]
+        groups = [features[labels == cl, j] for cl in classes]
         f, p = sstats.f_oneway(*groups)
         stats_.append(f)
         ps.append(p)
@@ -56,9 +102,51 @@ def anova_f_test(features: np.ndarray, labels: np.ndarray) -> Arrays:
     return np.asarray(stats_), np.asarray(ps), np.asarray(dofs, np.int64)
 
 
+def _sums_kernel(x, y):
+    import jax.numpy as jnp
+
+    return jnp.concatenate([jnp.sum(x, axis=0), jnp.sum(y)[None]])
+
+
+def _centered_products_kernel(x, y, xmean, ymean):
+    import jax.numpy as jnp
+
+    xc = x - xmean[None, :]
+    yc = y - ymean
+    return jnp.stack([jnp.sum(xc * yc[:, None], axis=0),
+                      jnp.sum(xc * xc, axis=0),
+                      jnp.full(x.shape[1], jnp.sum(yc * yc))])
+
+
 def f_value_test(features: np.ndarray, labels: np.ndarray) -> Arrays:
     """Univariate linear-regression F-test per feature
-    (ref: stats/fvaluetest/FValueTest.java — continuous vs continuous)."""
+    (ref: stats/fvaluetest/FValueTest.java — continuous vs continuous).
+
+    Device-resident features reduce on device (two float32-stable passes);
+    the (d,)-sized correlation → F → p tail runs in float64 on host."""
+    if _is_device(features):
+        from flink_ml_tpu.ops import columnar
+
+        n, d = features.shape
+        y32 = np.asarray(labels, np.float32)
+        sums = np.asarray(columnar.apply_multi(
+            _sums_kernel, (features, y32)), np.float64)
+        xmean, ymean = sums[:-1] / n, sums[-1] / n
+        packed = np.asarray(columnar.apply_multi(
+            _centered_products_kernel, (features, y32),
+            consts=(xmean.astype(np.float32), np.float32(ymean))),
+            np.float64)
+        sxy, sxx, syy = packed[0], packed[1], packed[2][0]
+        dof = n - 2
+        denom = np.sqrt(sxx * syy)
+        corr = np.where(denom > 0, sxy / np.where(denom > 0, denom, 1.0),
+                        0.0)
+        corr = np.clip(corr, -1.0, 1.0)
+        f = np.where(corr ** 2 < 1.0,
+                     corr ** 2 / np.maximum(1.0 - corr ** 2, 1e-300) * dof,
+                     np.inf)
+        p = sstats.f.sf(f, 1, dof)
+        return f, p, np.full(d, dof, np.int64)
     x = np.asarray(features, np.float64)
     y = np.asarray(labels, np.float64)
     n, d = x.shape

@@ -236,3 +236,57 @@ def test_swing_filters_and_validation():
     assert out.num_rows == 0
     with pytest.raises(ValueError):
         Swing(min_user_behavior=10, max_user_behavior=5).transform(t)
+
+
+def test_stats_tests_device_parity(rng):
+    """Device-resident inputs run the on-device reduction branches of the
+    ANOVA/F-value tests; results must match the host float64 paths."""
+    from flink_ml_tpu.ops import columnar
+    from flink_ml_tpu.ops.stats import anova_f_test, f_value_test
+
+    x = (rng.normal(size=(600, 5)) * [1, 2, 3, 4, 5] + 3).astype(np.float64)
+    y_cat = rng.integers(0, 3, 600).astype(np.float64)
+    x[y_cat == 1, 0] += 2.0  # give feature 0 real signal
+    y_cont = x[:, 1] * 0.5 + rng.normal(size=600)
+
+    xd = columnar.to_device(x.astype(np.float32))
+    for host, dev in [(anova_f_test(x, y_cat), anova_f_test(xd, y_cat)),
+                      (f_value_test(x, y_cont), f_value_test(xd, y_cont))]:
+        f_h, p_h, dof_h = host
+        f_d, p_d, dof_d = dev
+        np.testing.assert_allclose(f_d, f_h, rtol=2e-3)
+        np.testing.assert_allclose(p_d, p_h, rtol=5e-3, atol=1e-9)
+        np.testing.assert_array_equal(dof_d, dof_h)
+
+
+def test_univariate_selector_device_parity(rng):
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import UnivariateFeatureSelector
+    from flink_ml_tpu.ops import columnar
+
+    x = rng.normal(size=(400, 8))
+    y = rng.integers(0, 2, 400).astype(np.float64)
+    x[y == 1, 2] += 3.0
+    sel = dict(features_col="f", label_col="l", output_col="o",
+               feature_type="continuous", label_type="categorical",
+               selection_mode="numTopFeatures", selection_threshold=2)
+    m_h = UnivariateFeatureSelector(**sel).fit(
+        Table.from_columns(f=x, l=y))
+    m_d = UnivariateFeatureSelector(**sel).fit(
+        Table.from_columns(f=columnar.to_device(x.astype(np.float32)), l=y))
+    np.testing.assert_array_equal(sorted(m_h.indices), sorted(m_d.indices))
+    assert 2 in m_d.indices
+
+
+def test_kbins_device_subsample_slice(rng):
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import KBinsDiscretizer
+    from flink_ml_tpu.ops import columnar
+
+    x = rng.normal(size=(1000, 3))
+    kb = dict(input_col="f", output_col="o", num_bins=4, sub_samples=200)
+    m_h = KBinsDiscretizer(**kb).fit(Table.from_columns(f=x))
+    m_d = KBinsDiscretizer(**kb).fit(
+        Table.from_columns(f=columnar.to_device(x.astype(np.float32))))
+    for a, b in zip(m_h.bin_edges, m_d.bin_edges):
+        np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
