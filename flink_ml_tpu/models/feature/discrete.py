@@ -471,13 +471,51 @@ class VectorIndexerModel(Model, VectorIndexerModelParams):
             for d, m in raw.items()}
 
 
+def _sized_unique_kernel(x, k):
+    """Per-dimension first k+1 distinct values (NaN fill) plus a
+    has-non-finite flag — the categorical-discovery pass as one device
+    program; a dimension whose (k+1)-th slot is real has too many
+    categories and stays continuous."""
+    import jax
+    import jax.numpy as jnp
+
+    def per_dim(col):
+        return (jnp.unique(col, size=k + 1, fill_value=jnp.nan),
+                ~jnp.all(jnp.isfinite(col)))
+
+    return jax.vmap(per_dim, in_axes=1)(x)
+
+
 class VectorIndexer(Estimator, VectorIndexerParams):
     def fit(self, table: Table) -> VectorIndexerModel:
-        x = table.vectors(self.input_col, np.float64)
+        from flink_ml_tpu.ops import columnar
+
+        x, xp = columnar.fit_vectors(table, self.input_col)
+        k = self.max_categories
         maps = {}
-        for dim in range(x.shape[1]):
-            uniq = np.unique(x[:, dim])
-            if len(uniq) <= self.max_categories:
-                maps[dim] = {float(v): i for i, v in enumerate(sorted(uniq))}
+        if xp is not np:
+            # device: sized uniques per dim, only (d, k+1) candidates
+            # cross to host. Invariant: maps must equal the host path run
+            # on the same column values. Integral candidates satisfy that
+            # directly; dims with non-finite or fractional values re-fit
+            # from a per-dim host off-ramp so NaN/inf keys and
+            # fractional-value keys get exact host np.unique semantics.
+            cand, nonfinite = columnar.apply(
+                _sized_unique_kernel, x, static=(k,))
+            cand = np.asarray(cand, np.float64)
+            nonfinite = np.asarray(nonfinite)
+            for dim in range(cand.shape[0]):
+                vals = cand[dim][~np.isnan(cand[dim])]
+                if nonfinite[dim] or not (vals == np.floor(vals)).all():
+                    vals = np.unique(np.asarray(x[:, dim], np.float64))
+                if len(vals) <= k:
+                    maps[dim] = {float(v): i
+                                 for i, v in enumerate(sorted(vals))}
+        else:
+            for dim in range(x.shape[1]):
+                uniq = np.unique(x[:, dim])
+                if len(uniq) <= k:
+                    maps[dim] = {float(v): i
+                                 for i, v in enumerate(sorted(uniq))}
         model = VectorIndexerModel(category_maps=maps)
         return self.copy_params_to(model)

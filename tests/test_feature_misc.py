@@ -131,3 +131,53 @@ def test_approx_quantiles_matrix(rng):
     assert q.shape == (3, 3)
     np.testing.assert_allclose(
         q[1], np.quantile(x, 0.5, axis=0, method="lower"))
+
+
+def test_vector_indexer_device_parity(rng):
+    """Device-resident fit (sized device uniques) must learn the same
+    category maps as the host path."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import VectorIndexer
+    from flink_ml_tpu.ops import columnar
+
+    x = np.column_stack([
+        rng.integers(0, 3, 500).astype(np.float64),    # categorical (3)
+        rng.normal(size=500),                           # continuous
+        rng.integers(0, 25, 500).astype(np.float64),   # too many cats
+    ])
+    vi = dict(input_col="f", output_col="o", max_categories=20)
+    m_h = VectorIndexer(**vi).fit(Table.from_columns(f=x))
+    m_d = VectorIndexer(**vi).fit(
+        Table.from_columns(f=columnar.to_device(x.astype(np.float32))))
+    assert m_h.category_maps == m_d.category_maps
+    assert set(m_d.category_maps) == {0}
+
+
+def test_vector_indexer_device_nonintegral_and_nan_dims(rng):
+    """Non-integral / non-finite dims on the device path must learn the
+    same maps as the host path fit on identical values (per-dim host
+    refit)."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import VectorIndexer
+    from flink_ml_tpu.ops import columnar
+
+    f32 = np.float32
+    col_frac = rng.choice(np.asarray([0.1, 0.2, 0.3], f32), 300)
+    col_nan = rng.choice(np.asarray([0.0, 1.0, np.nan], f32), 300)
+    x32 = np.column_stack([col_frac, col_nan]).astype(f32)
+    vi = dict(input_col="f", output_col="o", max_categories=20)
+    # host path on the SAME float32 values is the parity oracle
+    m_h = VectorIndexer(**vi).fit(
+        Table.from_columns(f=x32.astype(np.float64)))
+    m_d = VectorIndexer(**vi).fit(
+        Table.from_columns(f=columnar.to_device(x32)))
+    assert set(m_h.category_maps) == set(m_d.category_maps)
+    for dim in m_h.category_maps:
+        h, d = m_h.category_maps[dim], m_d.category_maps[dim]
+        for (kh, vh), (kd, vd) in zip(sorted(h.items(), key=lambda t: repr(t)),
+                                      sorted(d.items(), key=lambda t: repr(t))):
+            assert (kh == kd or (np.isnan(kh) and np.isnan(kd))) and vh == vd
