@@ -107,37 +107,119 @@ class NaiveBayesModel(Model, NaiveBayesModelParams):
         self.floors = np.asarray(data["floors"])
 
 
-class NaiveBayes(Estimator, NaiveBayesParams):
-    def fit(self, table: Table) -> NaiveBayesModel:
-        x = table.vectors(self.features_col, np.float64)
-        y = table.scalars(self.label_col, np.float64)
-        smoothing = self.smoothing
-        n, d = x.shape
-        labels, y_idx = np.unique(y, return_inverse=True)
-        num_labels = len(labels)
+#: device counting applies when every feature/label value is an integer in
+#: [0, _MAX_DEVICE_ARITY) — the (d, L, V) count tensor must stay small
+_MAX_DEVICE_ARITY = 4096
 
-        # vectorized counting: one unique per feature column, then one
-        # (label, value) bincount — L·d sub-array uniques become d passes
-        doc_counts = np.bincount(y_idx, minlength=num_labels).astype(
-            np.float64)
+
+def _integral_bounds_kernel(x, y):
+    import jax.numpy as jnp
+
+    both_int = jnp.logical_and(jnp.all(x == jnp.floor(x)),
+                               jnp.all(y == jnp.floor(y)))
+    return jnp.stack([jnp.minimum(jnp.min(x), jnp.min(y)),
+                      jnp.max(x), jnp.max(y),
+                      both_int.astype(x.dtype)])
+
+
+def _category_counts_kernel(x, y, d, L, V):
+    """(d·L·V,) count vector in ONE device bincount: flat key
+    (dim·L + label)·V + value over the (n, d) grid."""
+    import jax.numpy as jnp
+
+    xi = x.astype(jnp.int32)
+    yi = y.astype(jnp.int32)
+    dim_idx = jnp.arange(d, dtype=jnp.int32)[None, :]
+    flat = (dim_idx * L + yi[:, None]) * V + xi
+    return jnp.bincount(flat.reshape(-1), length=d * L * V)
+
+
+class NaiveBayes(Estimator, NaiveBayesParams):
+    def _finalize(self, per_dim, doc_counts, labels, n, d
+                  ) -> "NaiveBayesModel":
+        """Build the model from per-dimension (value list, (L, nv) count
+        matrix) pairs — the single home of the smoothing/floor/pi math,
+        shared by the host and device counting paths."""
+        smoothing = self.smoothing
+        num_labels = len(labels)
         theta = [[] for _ in range(num_labels)]
         floors = np.zeros((num_labels, d))
-        for j in range(d):
-            vals, codes = np.unique(x[:, j], return_inverse=True)
-            nv = len(vals)
-            counts = np.bincount(y_idx * nv + codes,
-                                 minlength=num_labels * nv) \
-                .reshape(num_labels, nv)
+        for j, (val_list, counts) in enumerate(per_dim):
+            nv = len(val_list)
             denom = np.log(doc_counts + smoothing * nv)  # (L,)
             logp = np.log(counts + smoothing) - denom[:, None]
-            val_list = vals.tolist()
             floors[:, j] = (np.log(smoothing) - denom if smoothing > 0
                             else -np.inf)
             for li in range(num_labels):
                 theta[li].append(dict(zip(val_list, logp[li].tolist())))
-
         pi_log = np.log(n * d + num_labels * smoothing)
         pi = np.log(doc_counts * d + smoothing) - pi_log
         model = NaiveBayesModel(theta=theta, pi=pi, labels=labels,
                                 floors=floors)
         return self.copy_params_to(model)
+
+    def _fit_device(self, x, y) -> Optional["NaiveBayesModel"]:
+        """Device counting path for integral categorical data: the whole
+        (dim, label, value) contingency comes back as one (d·L·V,)
+        bincount; only that small tensor crosses D2H (the host path would
+        off-ramp the full table). Returns None when the data does not
+        qualify (non-integral / negative / too-wide value range)."""
+        from flink_ml_tpu.ops import columnar
+
+        n, d = x.shape
+        lo, x_hi, y_hi, integral = np.asarray(columnar.apply_multi(
+            _integral_bounds_kernel, (x, y)), np.float64)
+        if not integral or lo < 0 or max(x_hi, y_hi) + 1 > \
+                _MAX_DEVICE_ARITY:
+            return None
+        V, L = int(x_hi) + 1, int(y_hi) + 1
+        if d * L * V > 50_000_000:  # count-tensor memory guard
+            return None
+        # labels/values 0..max may be sparse: count every candidate, then
+        # keep the ones actually present
+        counts = np.asarray(columnar.apply_multi(
+            _category_counts_kernel, (x, y), static=(d, L, V)),
+            np.float64).reshape(d, L, V)  # (dim, label, value)
+        label_totals = counts[0].sum(axis=1)  # per-label doc counts
+        present = np.nonzero(label_totals > 0)[0]
+        labels = present.astype(np.float64)
+        doc_counts = label_totals[present]
+
+        def per_dim():
+            for j in range(d):
+                sub = counts[j][present]  # (L, V)
+                vals = np.nonzero(sub.sum(axis=0) > 0)[0]
+                yield [float(v) for v in vals], sub[:, vals]
+
+        return self._finalize(per_dim(), doc_counts, labels, n, d)
+
+    def fit(self, table: Table) -> NaiveBayesModel:
+        from flink_ml_tpu.ops import columnar
+
+        xd, xp = columnar.fit_vectors(table, self.features_col)
+        ycol = table.column(self.label_col)
+        if xp is not np and not isinstance(ycol, np.ndarray):
+            model = self._fit_device(xd, ycol)
+            if model is not None:
+                return model
+        x = xd if xp is np else table.vectors(self.features_col, np.float64)
+        y = table.scalars(self.label_col, np.float64)
+        n, d = x.shape
+        labels, y_idx = np.unique(y, return_inverse=True)
+        num_labels = len(labels)
+        doc_counts = np.bincount(y_idx, minlength=num_labels).astype(
+            np.float64)
+
+        def per_dim():
+            # vectorized counting: one unique per feature column, then
+            # one (label, value) bincount — L·d sub-array uniques become
+            # d passes
+            for j in range(d):
+                vals, codes = np.unique(x[:, j], return_inverse=True)
+                nv = len(vals)
+                counts = np.bincount(y_idx * nv + codes,
+                                     minlength=num_labels * nv) \
+                    .reshape(num_labels, nv)
+                yield vals.tolist(), counts
+
+        return self._finalize(per_dim(), doc_counts, labels, n, d)

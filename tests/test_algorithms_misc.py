@@ -290,3 +290,43 @@ def test_kbins_device_subsample_slice(rng):
         Table.from_columns(f=columnar.to_device(x.astype(np.float32))))
     for a, b in zip(m_h.bin_edges, m_d.bin_edges):
         np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
+
+
+def test_naive_bayes_device_fit_parity(rng):
+    """Integral categorical data on device must learn the same model as
+    the host path (theta/pi/floors/labels) and fall back for data that
+    does not qualify."""
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.classification.naivebayes import NaiveBayes
+    from flink_ml_tpu.ops import columnar
+
+    x = np.floor(rng.random((400, 6)) * 5)
+    y = np.floor(rng.random(400) * 3)
+    nb = dict(features_col="f", label_col="l")
+    m_h = NaiveBayes(**nb).fit(Table.from_columns(f=x, l=y))
+    m_d = NaiveBayes(**nb).fit(Table.from_columns(
+        f=columnar.to_device(x.astype(np.float32)),
+        l=columnar.to_device(y.astype(np.float32))))
+    np.testing.assert_array_equal(m_d.labels, m_h.labels)
+    np.testing.assert_allclose(m_d.pi, m_h.pi, rtol=1e-12)
+    np.testing.assert_allclose(m_d.floors, m_h.floors, rtol=1e-12)
+    for li in range(len(m_h.labels)):
+        for j in range(6):
+            assert m_d.theta[li][j].keys() == m_h.theta[li][j].keys()
+            for v in m_h.theta[li][j]:
+                assert m_d.theta[li][j][v] == pytest.approx(
+                    m_h.theta[li][j][v], rel=1e-12)
+    # identical predictions end to end
+    t = Table.from_columns(f=x, l=y)
+    np.testing.assert_array_equal(
+        np.asarray(m_d.transform(t)[0]["prediction"]),
+        np.asarray(m_h.transform(t)[0]["prediction"]))
+
+    # non-integral features: device path declines, host fallback used
+    x_frac = x + 0.5
+    m_f = NaiveBayes(**nb).fit(Table.from_columns(
+        f=columnar.to_device(x_frac.astype(np.float32)),
+        l=columnar.to_device(y.astype(np.float32))))
+    m_f_host = NaiveBayes(**nb).fit(Table.from_columns(
+        f=x_frac.astype(np.float32).astype(np.float64), l=y))
+    np.testing.assert_array_equal(m_f.labels, m_f_host.labels)
