@@ -146,15 +146,34 @@ def _worker(role: str) -> int:
     elif jax.default_backend() == "cpu":
         return 3  # axon fell through to single-device cpu: not a TPU number
 
-    from flink_ml_tpu.benchmark.runner import run_benchmark
+    from flink_ml_tpu.benchmark.runner import best_of
 
-    run_benchmark("warmup", DEMO_SPEC)  # XLA compile warmup, same shapes
-    best = None
-    for _ in range(3):
-        res = run_benchmark("KMeans-demo", DEMO_SPEC)
-        if best is None or res["inputThroughput"] > best["inputThroughput"]:
-            best = res
+    if role == "tpu_northstar":
+        # The judged workloads (BASELINE.md): the reference's own vendored
+        # north-star configs — LR 10Mx100 batch-100k 20-iter SGD and
+        # KMeans 1Mx100 k=10. Runs as its OWN child so a hang here can
+        # never cost the already-measured headline (the orchestrator
+        # merges this JSON into the headline line if and only if this
+        # child succeeds within its deadline).
+        from flink_ml_tpu.benchmark.runner import load_config
 
+        cfg_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "flink_ml_tpu", "benchmark", "configs")
+        out = {}
+        for cfg_file in ("logisticregression-benchmark.json",
+                         "kmeans-benchmark.json"):
+            for name, spec in load_config(
+                    os.path.join(cfg_dir, cfg_file)).items():
+                best = best_of(name, spec)
+                out[name] = {
+                    "inputRecordNum": best["inputRecordNum"],
+                    "totalTimeMs": round(best["totalTimeMs"], 1),
+                    "inputThroughput": round(best["inputThroughput"], 1),
+                }
+        print(json.dumps(out))
+        return 0
+
+    best = best_of("KMeans-demo", DEMO_SPEC)
     value = best["inputThroughput"]
     print(json.dumps({
         "metric": "kmeans_demo_input_throughput_10kx10",
@@ -177,8 +196,29 @@ def main() -> int:
     run_deadline = float(os.environ.get("FLINK_ML_TPU_BENCH_RUN_DEADLINE_S",
                                         "900"))
     out = None
-    if _wait_for_backend(budget):
+    on_tpu = _wait_for_backend(budget)
+    if on_tpu:
         out = _run_worker_child("tpu", run_deadline)
+    if out is not None and on_tpu:
+        # Headline is safe in `out`; the north-star measurement runs as a
+        # second child so its failure/hang costs only itself. The headline
+        # metric stays the demo — the ONLY workload the reference
+        # publishes a number for, so vs_baseline compares like with like —
+        # while the attached north-star numbers carry the real scale.
+        # Any parse failure below degrades to emitting the headline
+        # verbatim — merging must never cost the measured number.
+        ns = _run_worker_child("tpu_northstar", run_deadline)
+        try:
+            line = json.loads(out)
+            try:
+                line["northstar"] = json.loads(ns)
+            except (TypeError, ValueError):
+                line["northstar"] = {"error": "north-star child failed, "
+                                     "exceeded deadline, or emitted "
+                                     "unparseable output"}
+            out = (json.dumps(line) + "\n").encode()
+        except ValueError:
+            pass  # headline child printed something unexpected: ship as-is
     if out is None:
         out = _run_worker_child("cpu", run_deadline)
     if out is None:

@@ -257,6 +257,22 @@ class DoubleGenerator(InputTableGenerator):
 
 
 @_register
+class LogisticRegressionModelDataGenerator(HasSeed, HasVectorDim):
+    """Zero-initialized LR model data (coefficient vector + modelVersion 0)
+    — the initial model the online trainer requires
+    (OnlineLogisticRegression.java:440 setInitialModelData; its tests seed
+    exactly this shape). The reference ships no online benchmark config, so
+    this generator backs OUR onlinelogisticregression benchmark; zeros make
+    the measured fit independent of the seed."""
+
+    def get_data(self) -> Table:
+        return Table.from_columns(
+            coefficient=as_dense_vector_column(
+                np.zeros((1, self.vector_dim))),
+            modelVersion=np.asarray([0], np.int64))
+
+
+@_register
 class KMeansModelDataGenerator(HasSeed, HasVectorDim, HasArraySize):
     """Random KMeans model data; arraySize = number of centroids
     (ref: datagenerator/clustering/KMeansModelDataGenerator.java)."""

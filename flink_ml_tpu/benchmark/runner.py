@@ -85,7 +85,13 @@ def run_benchmark(name: str, spec: dict) -> dict:
     _block_device_columns(input_table)  # honest datagen/execute split
     datagen_ms = (time.perf_counter() - start) * 1000.0
     if model_table is not None:
-        stage.set_model_data(model_table)
+        if isinstance(stage, Estimator) and hasattr(
+                stage, "set_initial_model_data"):
+            # online trainers seed from model data instead of consuming it
+            # as a fitted model (OnlineLogisticRegression.java:440)
+            stage.set_initial_model_data(model_table)
+        else:
+            stage.set_model_data(model_table)
 
     if isinstance(stage, Estimator):
         outputs = stage.fit(input_table).get_model_data()
@@ -109,6 +115,19 @@ def run_benchmark(name: str, spec: dict) -> dict:
         "dataGenTimeMs": datagen_ms,
         "executeTimeMs": total_ms - datagen_ms,
     }
+
+
+def best_of(name: str, spec: dict, runs: int = 3) -> dict:
+    """The measurement protocol every published number uses: one identical
+    warmup run (XLA compile excluded — the JVM baseline's steady state
+    excludes JIT warmup too), then best inputThroughput of ``runs``."""
+    run_benchmark(name, spec)
+    best = None
+    for _ in range(runs):
+        r = run_benchmark(name, spec)
+        if best is None or r["inputThroughput"] > best["inputThroughput"]:
+            best = r
+    return best
 
 
 def _block_device_columns(table) -> None:

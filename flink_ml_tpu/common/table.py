@@ -242,6 +242,10 @@ class Table:
     def concat(self, other: "Table") -> "Table":
         if set(self.column_names) != set(other.column_names):
             raise ValueError("cannot concat tables with different schemas")
+        if self._num_rows == 0:
+            return other  # also sidesteps representation mismatch vs empty
+        if other.num_rows == 0:
+            return self
         return Table({n: np.concatenate([self._columns[n], other.column(n)])
                       for n in self.column_names})
 

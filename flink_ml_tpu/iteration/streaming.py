@@ -58,7 +58,10 @@ def generate_batches(stream: StreamTable, global_batch_size: int,
     buffer: Optional[Table] = None
     cursor = 0  # consumed prefix of buffer; avoids re-copying the tail per batch
     for chunk in stream:
-        if buffer is None:
+        if buffer is None or cursor == buffer.num_rows:
+            # fully-consumed buffer: start fresh (also keeps a chunk's
+            # column representation intact — concat with an empty table
+            # of a different vector representation would fail)
             buffer, cursor = chunk, 0
         else:
             remaining = buffer.take(np.arange(cursor, buffer.num_rows)) \

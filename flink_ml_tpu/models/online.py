@@ -71,6 +71,12 @@ def _as_stream(data: Union[Table, StreamTable], batch_size: int):
     return generate_batches(data, batch_size)
 
 
+#: max per-batch model snapshots kept on device before draining to host in
+#: one stacked transfer (keeps async dispatch across batches while bounding
+#: HBM held by history on unbounded streams)
+_HISTORY_DEV_CAP = 128
+
+
 import functools
 
 
@@ -296,9 +302,44 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
         ckpt = StreamCheckpointer(self._iteration_config,
                                   self._iteration_listeners)
 
+        # Dense batches keep (w, z, n) ON DEVICE between updates: the whole
+        # batch loop then dispatches asynchronously with zero per-batch
+        # syncs (each np.asarray here is a blocking D2H through the TPU
+        # tunnel — at 100 batches that latency, not the math, dominated).
+        # State comes back to host float64 only when something actually
+        # needs it: a sparse batch, a due checkpoint/listener, or fit end.
+        # float32→float64→float32 round-trips are exact, so host and
+        # device residency produce identical numbers.
+        state_dev = None  # (coeffs, z, n) float32 device triple, or None
+
+        def to_host():
+            nonlocal coeffs, z, n, state_dev
+            if state_dev is not None:
+                coeffs, z, n = (np.asarray(a, np.float64)
+                                for a in state_dev)
+                state_dev = None
+
+        # indices of history entries still holding device snapshots; they
+        # drain to host in one stacked D2H. Capped: past _HISTORY_DEV_CAP
+        # pending snapshots they drain eagerly, so an unbounded stream pins
+        # O(cap·d), not O(stream·d), of HBM.
+        dev_pending: List[int] = []
+
+        def materialize_history():
+            if dev_pending:
+                import jax.numpy as jnp
+                stacked = np.asarray(
+                    jnp.stack([history[i][1] for i in dev_pending]),
+                    np.float64)
+                for j, i in enumerate(dev_pending):
+                    history[i] = (history[i][0], stacked[j])
+                dev_pending.clear()
+
         def pack():
             # history rides in the checkpoint as two stacked arrays so the
             # state pytree has a fixed leaf count regardless of its length
+            to_host()
+            materialize_history()
             hv = np.asarray([v for v, _ in history], np.int64)
             hc = (np.stack([c for _, c in history])
                   if history else np.zeros((0,) + coeffs.shape))
@@ -324,8 +365,8 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
             x = sparse.features_matrix(batch, self.features_col, np.float32)
             if not sparse.is_csr(x):
                 # dense batches update on device: one compiled SPMD step
-                # per batch (state round-trips as three (d,) vectors —
-                # negligible next to the batch matmul)
+                # per batch; state stays device-resident across consecutive
+                # dense batches (see to_host above)
                 import jax.numpy as jnp
 
                 program = _ftrl_program(mesh, alpha, beta, l1, l2)
@@ -334,18 +375,19 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                 if isinstance(ycol, np.ndarray):
                     ycol = batch.scalars(self.label_col)
                 yb, _ = ensure_on_mesh(mesh, ycol, axes, jnp.float32)
-                coeffs_d, z_d, n_d = program(
-                    xb, yb, jnp.float32(n_rows),
-                    jnp.asarray(coeffs, jnp.float32),
-                    jnp.asarray(z, jnp.float32),
-                    jnp.asarray(n, jnp.float32))
-                coeffs = np.asarray(coeffs_d, np.float64)
-                z = np.asarray(z_d, np.float64)
-                n = np.asarray(n_d, np.float64)
+                if state_dev is None:
+                    state_dev = (jnp.asarray(coeffs, jnp.float32),
+                                 jnp.asarray(z, jnp.float32),
+                                 jnp.asarray(n, jnp.float32))
+                state_dev = program(xb, yb, jnp.float32(n_rows), *state_dev)
                 version += 1
-                history.append((version, coeffs.copy()))
+                dev_pending.append(len(history))
+                history.append((version, state_dev[0]))
+                if len(dev_pending) >= _HISTORY_DEV_CAP:
+                    materialize_history()
                 ckpt.after_batch(pack)
                 continue
+            to_host()  # sparse math is host numpy against float64 state
             y = batch.scalars(self.label_col, np.float64)
             # sparse branch (ref CalculateLocalGradient:364-388): the
             # gradient and the weight sum accumulate ONLY at a sample's
@@ -379,6 +421,8 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
             ckpt.after_batch(pack)
 
         ckpt.complete(pack)
+        to_host()
+        materialize_history()
         model.coefficients = coeffs
         model.model_version = version
         model.history = history
@@ -436,13 +480,14 @@ class OnlineKMeans(Estimator, OnlineKMeansParams, IterationRuntimeMixin):
             np.add.at(sums, assign, x)
 
             weights = weights * decay  # 1-task case of decay/parallelism
-            for i in range(k):
-                if counts[i] == 0:
-                    continue
-                weights[i] += counts[i]
-                lam = counts[i] / weights[i]
-                centroids[i] = (1 - lam) * centroids[i] \
-                    + (lam / counts[i]) * sums[i]
+            hit = counts > 0  # empty clusters keep weight and position
+            weights = np.where(hit, weights + counts, weights)
+            lam = np.where(hit, counts / np.where(hit, weights, 1.0), 0.0)
+            means = sums / np.maximum(counts, 1.0)[:, None]
+            centroids = np.where(
+                hit[:, None],
+                (1.0 - lam)[:, None] * centroids + lam[:, None] * means,
+                centroids)
             ckpt.after_batch(lambda: (centroids, weights))
 
         ckpt.complete(lambda: (centroids, weights))

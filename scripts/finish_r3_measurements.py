@@ -14,7 +14,6 @@ Run: python scripts/finish_r3_measurements.py
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -29,7 +28,7 @@ TRANSFORM_CONFIGS = [
 
 
 def remeasure_transforms() -> None:
-    from flink_ml_tpu.benchmark.runner import load_config, run_benchmark
+    from flink_ml_tpu.benchmark.runner import best_of, load_config
 
     with open(RESULTS) as f:
         d = json.load(f)
@@ -38,16 +37,11 @@ def remeasure_transforms() -> None:
             os.path.dirname(__file__), "..", "flink_ml_tpu", "benchmark",
             "configs", cfg))
         for name, spec in config.items():
-            run_benchmark(name, spec)  # warmup (compile incl. sync probe)
-            best = None
-            for _ in range(3):
-                r = run_benchmark(name, spec)
-                if best is None or r["inputThroughput"] > \
-                        best["inputThroughput"]:
-                    best = r
+            best = best_of(name, spec)
             d[name]["results"] = best
             d[name]["runs"] = 4
             d[name].pop("note", None)
+            d[name].pop("exception", None)  # clears the withheld marker
             print(f"{name:40s} {best['inputThroughput']:14.0f} rec/s "
                   f"({best['totalTimeMs']:8.0f} ms)", flush=True)
             with open(RESULTS, "w") as f:
@@ -55,47 +49,24 @@ def remeasure_transforms() -> None:
 
 
 def measure_ftrl() -> dict:
-    """FTRL streaming fit at the north-star shapes; the model-version
-    snapshots fetched per batch are real D2H syncs, so wall time is
-    trustworthy without extra probes."""
-    import numpy as np
+    """FTRL at the north-star shapes (10M x 100 in 100k global batches),
+    measured through the benchmark runner on our
+    onlinelogisticregression-benchmark.json — the ONE source of truth for
+    this workload (same config, protocol and result schema as every other
+    published number)."""
+    from flink_ml_tpu.benchmark.runner import best_of, load_config
 
-    from flink_ml_tpu.benchmark.datagen import LabeledPointWithWeightGenerator
-    from flink_ml_tpu.common.table import Table
-    from flink_ml_tpu.iteration.streaming import StreamTable
-    from flink_ml_tpu.linalg.vectors import DenseVector
-    from flink_ml_tpu.models.online import OnlineLogisticRegression
-
-    n, d, batch = 10_000_000, 100, 100_000
-
-    def one_run(seed):
-        gen = LabeledPointWithWeightGenerator(
-            seed=seed, col_names=[["features", "label", "weight"]],
-            num_values=n, vector_dim=d, feature_arity=0, label_arity=2)
-        est = OnlineLogisticRegression(global_batch_size=batch)
-        est.set_initial_model_data(Table.from_columns(
-            coefficient=[DenseVector(np.zeros(d))]))
-        t0 = time.perf_counter()
-        table = gen.get_data()
-        model = est.fit(StreamTable.from_table(table, batch))
-        wall = time.perf_counter() - t0
-        assert model.model_version == n // batch
-        return wall
-
-    one_run(0)  # warmup
-    best = min(one_run(2), one_run(3), one_run(4))
-    res = {"workload": f"OnlineLogisticRegression FTRL {n}x{d}, "
-                       f"globalBatchSize {batch}",
-           "totalTimeMs": best * 1000.0,
-           "inputRecordNum": n,
-           "inputThroughput": n / best,
-           "modelVersionsEmitted": n // batch}
+    config = load_config(os.path.join(
+        os.path.dirname(__file__), "..", "flink_ml_tpu", "benchmark",
+        "configs", "onlinelogisticregression-benchmark.json"))
+    ((name, spec),) = config.items()
+    res = best_of(name, spec)
     print(json.dumps(res, indent=2))
     with open(RESULTS) as f:
         d2 = json.load(f)
     d2["OnlineLogisticRegression-FTRL"] = {
-        "workload": res["workload"], "results": res, "runs": 4,
-        "platform": "tpu"}
+        "stage": spec["stage"], "inputData": spec["inputData"],
+        "results": res, "runs": 4, "platform": "tpu"}
     with open(RESULTS, "w") as f:
         json.dump(d2, f, indent=2)
     return res

@@ -477,3 +477,47 @@ def test_online_models_publish_model_gauges(rng):
     g = metrics.group("ml", "model")
     assert g.get_gauge("version") == 7
     assert g.get_gauge("timestamp") == 123456
+
+
+def test_online_lr_mixed_dense_sparse_stream(rng):
+    """A stream interleaving dense and sparse (CSR) batches crosses the
+    device/host residency boundary both ways (dense batches keep FTRL state
+    on device; a sparse batch pulls it back to host). With full-pattern
+    sparse vectors the two branches compute the same math, so the mixed
+    stream must match an all-dense fit — and the public model contract
+    stays host numpy float64 regardless of where state last lived."""
+    from flink_ml_tpu.linalg.vectors import SparseVector
+    from flink_ml_tpu.models.online import OnlineLogisticRegression
+
+    n, d, b = 600, 4, 200
+    x = rng.normal(size=(n, d))
+    y = (x @ [1.0, -2.0, 0.5, 1.5] > 0).astype(np.float64)
+
+    def sparse_col(block):
+        col = np.empty(block.shape[0], dtype=object)
+        for i, row in enumerate(block):
+            col[i] = SparseVector(d, np.arange(d), row)
+        return col
+
+    chunks = [
+        Table.from_columns(features=x[0:b], label=y[0:b]),          # dense
+        Table.from_columns(features=sparse_col(x[b:2 * b]),         # CSR
+                           label=y[b:2 * b]),
+        Table.from_columns(features=x[2 * b:], label=y[2 * b:]),    # dense
+    ]
+
+    def fit(stream):
+        est = OnlineLogisticRegression(global_batch_size=b)
+        est.set_initial_model_data(init_model_table(d))
+        return est.fit(stream)
+
+    mixed = fit(StreamTable(iter(chunks)))
+    all_dense = fit(Table.from_columns(features=x, label=y))
+
+    np.testing.assert_allclose(mixed.coefficients, all_dense.coefficients,
+                               rtol=1e-5, atol=1e-7)
+    assert mixed.model_version == n // b
+    for v, c in mixed.history:
+        assert isinstance(c, np.ndarray) and c.dtype == np.float64
+    assert isinstance(mixed.coefficients, np.ndarray)
+    assert mixed.coefficients.dtype == np.float64
