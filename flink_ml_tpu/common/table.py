@@ -28,11 +28,19 @@ def _is_device_column(values) -> bool:
             and hasattr(values, "__array__"))
 
 
+def _is_csr_column(values) -> bool:
+    """A CsrVectorColumn (one scipy CSR backing a whole sparse vector
+    column — see flink_ml_tpu.linalg.sparse). Duck-typed so this module
+    needs neither scipy nor a linalg import at column-normalization time."""
+    return getattr(values, "is_csr_vector_column", False)
+
+
 def _as_column(values) -> np.ndarray:
     """Normalize a column. Numeric 2-D arrays are kept as-is — a (n, d) array
     IS a vector column (row i = vector i); this is the fast path that avoids
     materializing n DenseVector objects for large tables."""
-    if isinstance(values, np.ndarray) or _is_device_column(values):
+    if isinstance(values, np.ndarray) or _is_device_column(values) \
+            or _is_csr_column(values):
         return values
     values = list(values)
     if values and isinstance(values[0], (Vector,)):
@@ -199,6 +207,9 @@ class Table:
         statistics keep their float64 contract.
         """
         col = self.column(name)
+        if _is_csr_column(col):
+            # dense off-ramp, same semantics as stacking SparseVectors
+            return col.to_dense(dtype)
         if _is_device_column(col):
             if col.dtype == np.dtype(dtype):
                 return col if col.ndim == 2 else col[:, None]
@@ -246,12 +257,22 @@ class Table:
             return other  # also sidesteps representation mismatch vs empty
         if other.num_rows == 0:
             return self
-        return Table({n: np.concatenate([self._columns[n], other.column(n)])
+
+        def cat(a, b):
+            if _is_csr_column(a):
+                return a.concat(b)
+            if _is_csr_column(b):
+                return b.concat_after(a)  # keep CSR backing either way
+            return np.concatenate([a, b])
+
+        return Table({n: cat(self._columns[n], other.column(n))
                       for n in self.column_names})
 
     # -- row view (collect parity with table.execute().collect()) -----------
     def _host_column(self, name: str) -> np.ndarray:
         col = self._columns[name]
+        if _is_csr_column(col):
+            return col.to_object_column()
         return np.asarray(col) if _is_device_column(col) else col
 
     def rows(self) -> List[tuple]:

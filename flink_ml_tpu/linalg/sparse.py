@@ -23,13 +23,107 @@ import numpy as np
 from flink_ml_tpu.linalg.vectors import SparseVector, Vector
 
 
+class CsrVectorColumn:
+    """A sparse vector column stored as ONE scipy CSR matrix.
+
+    The producer-side twin of ``column_to_csr``: ops that compute a whole
+    sparse output at once (HashingTF/FeatureHasher/CountVectorizer at
+    n=10M rows) hand their (indptr, indices, data) arrays straight to the
+    table instead of looping 10M ``SparseVector`` constructions — and
+    sparse trainers (``features_matrix``) get the CSR back without
+    re-assembling it. Row access (``col[i]``, iteration) materializes
+    ``SparseVector`` views lazily, so per-row consumers (BLAS, the
+    reference's ``instanceof SparseVector`` dispatch) see the same objects
+    an object column would hold.
+    """
+
+    is_csr_vector_column = True  # duck-type marker (Table, is_sparse_column)
+    #: quacks like numpy's object-column dtype for code that branches on it
+    dtype = np.dtype(object)
+    ndim = 1
+
+    def __init__(self, matrix):
+        self.matrix = matrix.tocsr()
+
+    def __len__(self):
+        return self.matrix.shape[0]
+
+    @property
+    def shape(self):
+        return (self.matrix.shape[0],)
+
+    def _row(self, i: int) -> SparseVector:
+        m = self.matrix
+        lo, hi = m.indptr[i], m.indptr[i + 1]
+        return SparseVector._unchecked(
+            m.shape[1], m.indices[lo:hi].astype(np.int64),
+            m.data[lo:hi].astype(np.float64))
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return CsrVectorColumn(self.matrix[key])
+        if np.ndim(key) == 0:
+            i = int(key)
+            n = self.matrix.shape[0]
+            if i < 0:
+                i += n
+            if not 0 <= i < n:
+                raise IndexError(
+                    f"row {key} out of bounds for column of {n} rows")
+            return self._row(i)
+        return CsrVectorColumn(self.matrix[np.asarray(key)])
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self._row(i)
+
+    def to_csr(self):
+        return self.matrix
+
+    def to_object_column(self) -> np.ndarray:
+        return csr_to_column(self.matrix)
+
+    def to_dense(self, dtype=np.float64) -> np.ndarray:
+        # narrow BEFORE densifying: no full-size float64 temporary
+        m = self.matrix if self.matrix.dtype == dtype \
+            else self.matrix.astype(dtype)
+        return m.toarray()
+
+    def concat(self, other) -> "CsrVectorColumn":
+        import scipy.sparse as sp
+
+        o = other.matrix if isinstance(other, CsrVectorColumn) \
+            else column_to_csr(other)
+        return CsrVectorColumn(sp.vstack([self.matrix, o], format="csr"))
+
+    def concat_after(self, other) -> "CsrVectorColumn":
+        """``other`` (object/dense vector column) followed by this column —
+        the right-hand-side twin of ``concat``, keeping CSR backing however
+        the operands are ordered."""
+        import scipy.sparse as sp
+
+        return CsrVectorColumn(
+            sp.vstack([column_to_csr(other), self.matrix], format="csr"))
+
+    def __repr__(self):
+        return (f"CsrVectorColumn({self.matrix.shape[0]} rows, "
+                f"size={self.matrix.shape[1]}, nnz={self.matrix.nnz})")
+
+
+def is_csr_column(col) -> bool:
+    return getattr(col, "is_csr_vector_column", False)
+
+
 def is_sparse_column(col) -> bool:
-    """True for an object column holding at least one SparseVector row.
+    """True for a CSR-backed column or an object column holding at least
+    one SparseVector row.
 
     The reference dispatches per row (``instanceof SparseVector``,
     OnlineLogisticRegression.java:375); a column with any sparse row takes
     the CSR path here — the scan short-circuits at the first sparse row.
     """
+    if is_csr_column(col):
+        return True
     return (getattr(col, "dtype", None) == object and len(col) > 0
             and isinstance(col[0], Vector)
             and any(isinstance(v, SparseVector) for v in col))
@@ -56,6 +150,10 @@ def column_to_csr(col, dtype=np.float64):
     of bounds.
     """
     import scipy.sparse as sp
+
+    if is_csr_column(col):
+        m = col.to_csr()
+        return m if m.dtype == dtype else m.astype(dtype)
 
     n = len(col)
     parts = [_row_parts(v) for v in col]

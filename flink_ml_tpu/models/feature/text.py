@@ -82,27 +82,34 @@ def _is_token_matrix(col) -> bool:
 
 def _token_codes(col: np.ndarray):
     """Token matrix → (distinct_tokens, flat_codes): every token visited
-    once by np.unique's C sort; per-token Python work then happens once
-    per DISTINCT token only. ``distinct_tokens`` is lexicographically
-    sorted (downstream tie-breaks depend on it).
+    once; per-token Python work then happens once per DISTINCT token only.
+    ``distinct_tokens`` is lexicographically sorted (downstream tie-breaks
+    depend on it).
 
-    A '<U' itemsize is a whole number of 4-byte code points, so the unique
-    runs over an integer VIEW of the buffer (int compare ≈ 5-10x faster
-    than unicode compare at 1e8+ tokens); integer order differs from
-    lexicographic, so the small distinct set is re-sorted and the inverse
-    re-ranked afterwards."""
+    A '<U' itemsize is a whole number of 4-byte code points, so the
+    factorization runs over an integer VIEW of the buffer. Tokens of ≤ 8
+    bytes go through pandas' hash-table factorize — O(N) with no sort of
+    the N tokens (np.unique's argsort was the dominant fit cost at 1e9
+    tokens); longer tokens fall back to np.unique over a struct view
+    (memcmp-style sort). Either way the small distinct set is re-sorted
+    lexicographically and the codes re-ranked afterwards."""
     flat = np.ascontiguousarray(col).reshape(-1)
     nints, rem = divmod(flat.dtype.itemsize, 4)
     if flat.dtype.kind != "U" or rem or nints == 0:
         uniq, inv = np.unique(flat, return_inverse=True)
         return uniq, inv.reshape(-1)
-    if nints == 1:
-        view = flat.view("<i4")
-    elif nints == 2:
-        view = flat.view("<i8")
+    if nints <= 2:
+        view = flat.view("<i4" if nints == 1 else "<i8")
+        try:
+            import pandas as pd
+            inv, uniq_v = pd.factorize(view, sort=False)
+            inv = np.asarray(inv, np.int64)
+            uniq_v = np.asarray(uniq_v)
+        except ImportError:
+            uniq_v, inv = np.unique(view, return_inverse=True)
     else:  # longer tokens: struct of int32 fields, memcmp-style sort
         view = flat.view([(f"f{i}", "<i4") for i in range(nints)])
-    uniq_v, inv = np.unique(view, return_inverse=True)
+        uniq_v, inv = np.unique(view, return_inverse=True)
     uniq = np.ascontiguousarray(uniq_v).view(flat.dtype).reshape(-1)
     order = np.argsort(uniq)
     rank = np.empty(len(order), np.int64)
@@ -110,18 +117,47 @@ def _token_codes(col: np.ndarray):
     return uniq[order], rank[inv.reshape(-1)]
 
 
+def _rowwise_counts(mat: np.ndarray, with_counts: bool = True):
+    """Per-row value counts of an (n, w) int matrix, fully vectorized:
+    sort each row IN PLACE (cache-local O(n·w·log w) — w is the token
+    width, ~1e2), then run-length encode. Replaces the global
+    ``np.unique(rows * size + flat)`` whose O(N log N) argsort dominated
+    the 1e9-token transforms. Returns (row_of, value, count) with rows
+    ascending and values ascending within each row (CSR-canonical order);
+    count is None with ``with_counts=False`` (presence-only consumers).
+    """
+    n, w = mat.shape
+    mat.sort(axis=1)
+    change = np.empty((n, w), np.bool_)
+    change[:, 0] = True
+    np.not_equal(mat[:, 1:], mat[:, :-1], out=change[:, 1:])
+    starts = np.nonzero(change.reshape(-1))[0]
+    if not with_counts:
+        return starts // w, mat.reshape(-1)[starts], None
+    counts = np.empty_like(starts)  # manual diff: no concat temporary
+    np.subtract(starts[1:], starts[:-1], out=counts[:-1])
+    if len(counts):
+        counts[-1] = n * w - starts[-1]
+    return starts // w, mat.reshape(-1)[starts], counts
+
+
 def _build_sparse_rows(n, size, sorted_row_ids, col_idx, values):
-    """Row-major (row, column, value) triples → object array of per-row
-    SparseVectors. ``sorted_row_ids`` must be ascending (the output of the
-    key-sorted np.unique aggregations here); slices are copied so a
-    retained row cannot pin the table-sized arrays."""
-    bounds = np.searchsorted(sorted_row_ids, np.arange(n + 1, dtype=np.int64))
-    out = np.empty(n, dtype=object)
-    for i in range(n):
-        lo, hi = bounds[i], bounds[i + 1]
-        out[i] = SparseVector._unchecked(size, col_idx[lo:hi].copy(),
-                                         values[lo:hi].copy())
-    return out
+    """Row-major (row, column, value) triples → a CSR-backed vector column.
+    ``sorted_row_ids`` must be ascending (the output of the key-sorted
+    aggregations here). O(n) searchsorted + zero copies: the triples ARE
+    the CSR buffers — no per-row SparseVector loop (10M constructions was
+    the dominant transform cost at benchmark scale); rows materialize
+    lazily on access (CsrVectorColumn)."""
+    import scipy.sparse as sp
+
+    from flink_ml_tpu.linalg.sparse import CsrVectorColumn
+
+    indptr = np.searchsorted(sorted_row_ids,
+                             np.arange(n + 1, dtype=np.int64))
+    mat = sp.csr_matrix(
+        (np.asarray(values, np.float64), np.asarray(col_idx, np.int64),
+         indptr), shape=(n, size))
+    return CsrVectorColumn(mat)
 
 
 class Tokenizer(Transformer, HasInputCol, HasOutputCol):
@@ -287,24 +323,27 @@ class HashingTF(Transformer, HasInputCol, HasOutputCol, HasNumFeatures):
             uniq, codes = _token_codes(col)
             buckets = np.fromiter((_hash_index(str(t), m) for t in uniq),
                                   np.int64, len(uniq))
-            flat_idx = buckets[codes]
-            lengths = np.full(n, col.shape[1], np.int64)
-        else:
-            col = _materialize_token_cells(col)
-            lengths = np.fromiter((len(t) for t in col), np.int64, n)
-            total = int(lengths.sum())
-            flat_idx = np.empty(total, np.int64)
-            cache = {}
-            k = 0
-            for tokens in col:
-                for t in tokens:
-                    s = str(t)
-                    h = cache.get(s)
-                    if h is None:
-                        h = _hash_index(s, m)
-                        cache[s] = h
-                    flat_idx[k] = h
-                    k += 1
+            row_of, bucket, counts = _rowwise_counts(
+                buckets[codes].reshape(col.shape))
+            values = (np.ones(len(bucket)) if self.binary
+                      else counts.astype(np.float64))
+            out = _build_sparse_rows(n, m, row_of, bucket, values)
+            return (table.with_column(self.output_col, out),)
+        col = _materialize_token_cells(col)
+        lengths = np.fromiter((len(t) for t in col), np.int64, n)
+        total = int(lengths.sum())
+        flat_idx = np.empty(total, np.int64)
+        cache = {}
+        k = 0
+        for tokens in col:
+            for t in tokens:
+                s = str(t)
+                h = cache.get(s)
+                if h is None:
+                    h = _hash_index(s, m)
+                    cache[s] = h
+                flat_idx[k] = h
+                k += 1
         rows = np.repeat(np.arange(n, dtype=np.int64), lengths)
         key, counts = np.unique(rows * m + flat_idx, return_counts=True)
         values = (np.ones(len(key)) if self.binary
@@ -418,27 +457,39 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
         n = len(col)
         # flat pass: vocab id per token (-1 = OOV), then one vectorized
         # aggregation — same bulk shape as HashingTF.transform
+        min_tf = self.min_tf
         if _is_token_matrix(col):
             uniq, codes = _token_codes(col)
             vocab_ids = np.fromiter((index.get(str(t), -1) for t in uniq),
                                     np.int64, len(uniq))
-            flat = vocab_ids[codes]
-            lengths = np.full(n, col.shape[1], np.int64)
-        else:
-            col = _materialize_token_cells(col)
-            lengths = np.fromiter((len(t) for t in col), np.int64, n)
-            flat = np.empty(int(lengths.sum()), np.int64)
-            k = 0
-            for tokens in col:
-                for t in tokens:
-                    flat[k] = index.get(str(t), -1)
-                    k += 1
+            row_of, vocab_id, counts = _rowwise_counts(
+                vocab_ids[codes].reshape(col.shape))
+            in_vocab = vocab_id >= 0  # OOV runs sort first in each row
+            row_of, vocab_id, counts = (row_of[in_vocab],
+                                        vocab_id[in_vocab],
+                                        counts[in_vocab])
+            thresholds = (min_tf if min_tf >= 1.0
+                          else min_tf * col.shape[1])
+            keep = counts >= thresholds
+            row_of, vocab_id, counts = (row_of[keep], vocab_id[keep],
+                                        counts[keep])
+            values = np.ones(len(vocab_id)) if self.binary \
+                else counts.astype(np.float64)
+            out = _build_sparse_rows(n, size, row_of, vocab_id, values)
+            return (table.with_column(self.output_col, out),)
+        col = _materialize_token_cells(col)
+        lengths = np.fromiter((len(t) for t in col), np.int64, n)
+        flat = np.empty(int(lengths.sum()), np.int64)
+        k = 0
+        for tokens in col:
+            for t in tokens:
+                flat[k] = index.get(str(t), -1)
+                k += 1
         rows = np.repeat(np.arange(n, dtype=np.int64), lengths)
         in_vocab = flat >= 0
         key, counts = np.unique(rows[in_vocab] * size + flat[in_vocab],
                                 return_counts=True)
         row_of = key // size
-        min_tf = self.min_tf
         thresholds = (np.full(len(key), min_tf) if min_tf >= 1.0
                       else min_tf * lengths[row_of])
         keep = counts >= thresholds
@@ -474,23 +525,16 @@ class CountVectorizer(Estimator, CountVectorizerParams):
         n_docs = len(col)
         if _is_token_matrix(col):
             # vectorized: corpus counts by bincount over token codes; doc
-            # freq by deduplicating (doc, token) pairs
+            # freq by row-wise dedup — each run start in the row-sorted
+            # code matrix is one distinct (doc, token) pair, so df is a
+            # bincount over run-start codes (no (n_docs, u) presence
+            # matrix, no global sort)
             uniq, codes = _token_codes(col)
             u = len(uniq)
             tc = np.bincount(codes, minlength=u)
-            if n_docs * u <= 2_000_000_000:
-                # O(N) doc-freq: presence scatter into an (n_docs, u) bool
-                # matrix (1 byte/cell) beats sorting n_docs*size pairs
-                presence = np.zeros((n_docs, u), np.bool_)
-                presence.reshape(-1)[
-                    np.arange(n_docs, dtype=np.int64).repeat(col.shape[1])
-                    * u + codes] = True
-                df = presence.sum(axis=0, dtype=np.int64)
-            else:
-                rows = np.repeat(np.arange(n_docs, dtype=np.int64),
-                                 col.shape[1])
-                df = np.bincount(np.unique(rows * u + codes) % u,
-                                 minlength=u)
+            _, start_codes, _ = _rowwise_counts(codes.reshape(col.shape),
+                                                with_counts=False)
+            df = np.bincount(start_codes, minlength=u)
             min_df = self.min_df if self.min_df >= 1.0 \
                 else self.min_df * n_docs
             max_df = self.max_df if self.max_df >= 1.0 \

@@ -267,3 +267,38 @@ def test_sparse_fit_crash_resume_identical_result(rng, tmp_path):
 
     resumed = est().set_iteration_config(cfg).fit(t).coefficients
     np.testing.assert_allclose(resumed, expected, rtol=1e-12)
+
+
+def test_csr_vector_column_indexing_and_concat():
+    """CsrVectorColumn must behave like the object column it replaces:
+    negative scalar indices, slices, out-of-bounds errors, and concat with
+    an object column on EITHER side (keeping CSR backing both ways)."""
+    import scipy.sparse as sp
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.linalg.sparse import CsrVectorColumn, is_csr_column
+
+    m = sp.csr_matrix(np.asarray([[0.0, 1.0], [2.0, 0.0], [0.0, 3.0]]))
+    col = CsrVectorColumn(m)
+    assert col[-1] == col[2] and col[-1].values.tolist() == [3.0]
+    assert len(col[0:2]) == 2 and col[0:2][1].values.tolist() == [2.0]
+    with pytest.raises(IndexError):
+        col[3]
+    with pytest.raises(IndexError):
+        col[-4]
+
+    # dense off-ramp narrows before densifying (no float64 temp), dtype kept
+    assert col.to_dense(np.float32).dtype == np.float32
+
+    obj = np.empty(2, dtype=object)
+    obj[0] = SparseVector(2, [0], [9.0])
+    obj[1] = DenseVector(np.asarray([7.0, 8.0]))
+    t_csr = Table.from_columns(v=col)
+    t_obj = Table.from_columns(v=obj)
+    both = t_csr.concat(t_obj)
+    rev = t_obj.concat(t_csr)
+    assert is_csr_column(both.column("v"))
+    assert is_csr_column(rev.column("v"))
+    assert both.column("v")[3].to_array().tolist() == [9.0, 0.0]
+    assert rev.column("v")[0].to_array().tolist() == [9.0, 0.0]
+    assert rev.column("v")[2].to_array().tolist() == [0.0, 1.0]
