@@ -62,6 +62,29 @@ def _hash_index(token: str, num_features: int) -> int:
     return zlib.crc32(token.encode("utf-8")) % num_features
 
 
+def _hash_numeric_bits(values: np.ndarray, salt: int,
+                       num_features: int) -> np.ndarray:
+    """Vectorized bucket hash for NUMERIC categorical identities.
+
+    A numeric cell's categorical identity is its float64 bit pattern (so
+    1 and 1.0 coincide; 0.0 and -0.0 differ), salted with the column name
+    and mixed by splitmix64 — no per-value string formatting or Python
+    hashing (3 Python calls per distinct value dominated FeatureHasher at
+    1M distinct doubles per column). The reference hashes the Java string
+    "name=value" with murmur; our hash never matched that bit-for-bit
+    anyway (hash choice is an implementation detail — only internal
+    consistency matters), see docs/deviations.md.
+    """
+    bits = np.ascontiguousarray(values, np.float64).view(np.uint64)
+    with np.errstate(over="ignore"):
+        z = bits ^ np.uint64(salt)
+        z = z + np.uint64(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> np.uint64(31))
+    return (z % np.uint64(num_features)).astype(np.int64)
+
+
 def _materialize_token_cells(col):
     """Token cells may be one-shot iterables; give every cell a len()."""
     if any(not hasattr(t, "__len__") for t in col):
@@ -127,6 +150,10 @@ def _rowwise_counts(mat: np.ndarray, with_counts: bool = True):
     count is None with ``with_counts=False`` (presence-only consumers).
     """
     n, w = mat.shape
+    if w == 0:  # zero-width token matrix (NGram n > width, all-stopword)
+        empty = np.zeros(0, np.int64)
+        return empty, np.zeros(0, mat.dtype), \
+            (empty if with_counts else None)
     mat.sort(axis=1)
     change = np.empty((n, w), np.bool_)
     change[:, 0] = True
@@ -286,16 +313,25 @@ class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
             col = table.column(name)
             out = np.empty(len(col), dtype=object)
             if _is_token_matrix(col):
-                # vectorized: fold every distinct token once, mask by isin;
-                # filtering makes rows ragged → object column of arrays,
-                # assembled as one flat filter + np.split (no per-row
-                # boolean indexing)
+                # vectorized: fold every distinct token once, mask by isin
                 uniq, codes = _token_codes(col)
                 folded = (uniq if self.case_sensitive else np.array(
                     [self._fold(str(t), self.locale) for t in uniq]))
                 keep_flat = ~np.isin(folded, np.array(sorted(stop)))[codes]
-                kept = col.reshape(-1)[keep_flat]
+                if keep_flat.all():
+                    # nothing filtered: the input token matrix IS the
+                    # output (the benchmark corpus of numeric-string
+                    # tokens hits this; no 1M-row np.split)
+                    outs[out_name] = col
+                    continue
                 counts = keep_flat.reshape(col.shape).sum(axis=1)
+                kept = col.reshape(-1)[keep_flat]
+                if (counts == counts[0]).all():
+                    # uniform removals keep the vectorized representation
+                    outs[out_name] = kept.reshape(len(col), int(counts[0]))
+                    continue
+                # ragged → object column of arrays, assembled as one flat
+                # filter + np.split (no per-row boolean indexing)
                 out[:] = np.split(kept, np.cumsum(counts[:-1]))
                 outs[out_name] = out
                 continue
@@ -378,10 +414,16 @@ class FeatureHasher(Transformer, HasInputCols, HasOutputCol, HasNumFeatures,
                 val_cols.append(np.asarray(col, np.float64))
                 continue
             force_cat = name in categorical
+            name_salt = zlib.crc32(name.encode("utf-8"))
             if col.dtype != object:
-                # homogeneous non-object categorical column (strings,
-                # bools, or forced-categorical numerics): hash each
-                # DISTINCT value once, then one gather
+                if col.dtype.kind in "iuf":
+                    # forced-categorical numerics: one vectorized
+                    # bits-hash over the whole column — no distinct set,
+                    # no per-value Python
+                    idx_cols.append(_hash_numeric_bits(col, name_salt, m))
+                    val_cols.append(np.ones(n))
+                    continue
+                # strings/bools: hash each DISTINCT value once, one gather
                 uniq, inv = np.unique(col, return_inverse=True)
                 buckets = np.fromiter(
                     (_hash_index(f"{name}={v}", m) for v in uniq),
@@ -390,31 +432,55 @@ class FeatureHasher(Transformer, HasInputCols, HasOutputCol, HasNumFeatures,
                 val_cols.append(np.ones(n))
                 continue
             # object column: classify per value — mixed numeric/string
-            # cells keep their semantics
+            # cells keep their semantics; numeric-categorical cells use
+            # the same bits-hash as the homogeneous branch (one batched
+            # call, not per cell) so one value buckets identically in
+            # either column representation
             cache = {}
             name_idx = _hash_index(name, m)
             idx = np.empty(n, np.int64)
             vals = np.empty(n)
-            for i, v in enumerate(col):
-                if force_cat or isinstance(v, (str, bool, np.bool_)):
-                    s = f"{name}={v}"
-                    h = cache.get(s)
-                    if h is None:
-                        h = _hash_index(s, m)
-                        cache[s] = h
-                    idx[i], vals[i] = h, 1.0
+            strlike = np.fromiter(
+                (isinstance(v, (str, bool, np.bool_)) for v in col),
+                np.bool_, n)
+            for i in np.nonzero(strlike)[0]:
+                s = f"{name}={col[i]}"
+                h = cache.get(s)
+                if h is None:
+                    h = _hash_index(s, m)
+                    cache[s] = h
+                idx[i], vals[i] = h, 1.0
+            num_pos = np.nonzero(~strlike)[0]
+            if len(num_pos):
+                nums = np.asarray([float(col[i]) for i in num_pos],
+                                  np.float64)
+                if force_cat:
+                    idx[num_pos] = _hash_numeric_bits(nums, name_salt, m)
+                    vals[num_pos] = 1.0
                 else:
-                    idx[i], vals[i] = name_idx, float(v)
+                    idx[num_pos] = name_idx
+                    vals[num_pos] = nums
             idx_cols.append(idx)
             val_cols.append(vals)
 
-        rows = np.tile(np.arange(n, dtype=np.int64), len(idx_cols))
-        keys = rows * m + np.concatenate(idx_cols)
-        vals = np.concatenate(val_cols)
-        # sum values per (row, bucket): collisions within a row accumulate
-        uniq, inverse = np.unique(keys, return_inverse=True)
-        sums = np.bincount(inverse, weights=vals, minlength=len(uniq))
-        out = _build_sparse_rows(n, m, uniq // m, uniq % m, sums)
+        # sum values per (row, bucket) — collisions within a row accumulate.
+        # Each row has exactly k = len(inputCols) entries, so the grouping
+        # is a per-row sort of width k (tiny) + segment sums — not a global
+        # sort of n·k keys.
+        k = len(idx_cols)
+        bucket_mat = np.stack(idx_cols, axis=1)
+        val_mat = np.stack(val_cols, axis=1)
+        order = np.argsort(bucket_mat, axis=1, kind="stable")
+        bucket_sorted = np.take_along_axis(bucket_mat, order, axis=1)
+        val_sorted = np.take_along_axis(val_mat, order, axis=1)
+        change = np.empty((n, k), np.bool_)
+        change[:, 0] = True
+        np.not_equal(bucket_sorted[:, 1:], bucket_sorted[:, :-1],
+                     out=change[:, 1:])
+        starts = np.flatnonzero(change.reshape(-1))
+        sums = np.add.reduceat(val_sorted.reshape(-1), starts)
+        out = _build_sparse_rows(n, m, starts // k,
+                                 bucket_sorted.reshape(-1)[starts], sums)
         return (table.with_column(self.output_col, out),)
 
 

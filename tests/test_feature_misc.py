@@ -181,3 +181,103 @@ def test_vector_indexer_device_nonintegral_and_nan_dims(rng):
         for (kh, vh), (kd, vd) in zip(sorted(h.items(), key=lambda t: repr(t)),
                                       sorted(d.items(), key=lambda t: repr(t))):
             assert (kh == kd or (np.isnan(kh) and np.isnan(kd))) and vh == vd
+
+
+def test_sql_transformer_vectorized_matches_sqlite():
+    """The vectorized SELECT/WHERE evaluator must agree with the sqlite
+    fallback on everything its grammar covers; unsupported statements
+    (aggregates etc.) must still run through sqlite."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import SQLTransformer
+    from flink_ml_tpu.models.feature.misc import _SqlVectorEval
+
+    rng = np.random.default_rng(0)
+    t = Table.from_columns(
+        v1=rng.normal(size=500), v2=rng.normal(size=500),
+        s=np.asarray([f"w{i % 7}" for i in range(500)], dtype=object))
+    stmts = [
+        "SELECT *, ABS(v1) AS v2 FROM __THIS__",
+        "SELECT v1, v2 FROM __THIS__ WHERE v1 > 0",
+        "SELECT v1 + v2 AS sum3, v1 * 2 AS dbl FROM __THIS__",
+        "SELECT v1 FROM __THIS__ WHERE v1 > 0 AND v2 < 0.5 OR NOT v1 < -1",
+        "SELECT SQRT(ABS(v1)) AS r, POWER(v2, 2) AS p2 FROM __THIS__",
+        "SELECT UPPER(s) AS u FROM __THIS__",
+        "SELECT ABS(v1) FROM __THIS__",
+        "SELECT v1 FROM __THIS__ WHERE s = 'w3'",
+        "SELECT -v1 AS neg, (v1 + 1) * 3 AS e FROM __THIS__ WHERE v2 <> 0",
+    ]
+    stage = SQLTransformer()
+    forced = lambda self: (_ for _ in ()).throw(
+        _SqlVectorEval.Unsupported("forced"))
+    for stmt in stmts:
+        stage.set(SQLTransformer.STATEMENT, stmt)
+        fast = stage.transform(t)[0]
+        original = _SqlVectorEval.run
+        _SqlVectorEval.run = forced
+        try:
+            slow = stage.transform(t)[0]
+        finally:
+            _SqlVectorEval.run = original
+        assert fast.column_names == slow.column_names, stmt
+        for c in fast.column_names:
+            a, b = fast.column(c), slow.column(c)
+            if a.dtype.kind in "fc":
+                np.testing.assert_allclose(
+                    np.asarray(a, float), np.asarray(b, float),
+                    rtol=1e-12, err_msg=stmt)
+            else:
+                assert [str(x) for x in a] == [str(x) for x in b], stmt
+    stage.set(SQLTransformer.STATEMENT,
+              "SELECT COUNT(*) AS c FROM __THIS__")
+    assert int(stage.transform(t)[0].column("c")[0]) == 500
+
+
+def test_sql_transformer_integer_and_error_fallback_semantics():
+    """Integer / and % must match sqlite's truncate-toward-zero semantics
+    in the vectorized path, and dtype errors the grammar can't see (ABS
+    over strings) must fall through to sqlite instead of crashing."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import SQLTransformer
+
+    t = Table.from_columns(
+        a=np.asarray([5, 7, -5, -7], np.int64),
+        s=np.asarray(["x", "y", "z", "w"], dtype=object))
+    stage = SQLTransformer()
+    stage.set(SQLTransformer.STATEMENT,
+              "SELECT a / 2 AS h, a % 3 AS r FROM __THIS__")
+    out = stage.transform(t)[0]
+    assert out.column("h").tolist() == [2, 3, -2, -3]   # truncation
+    assert out.column("r").tolist() == [2, 1, -2, -1]   # C-style sign
+
+    # grammar-visible but dtype-invalid: sqlite answers (ABS(text) = 0.0)
+    stage.set(SQLTransformer.STATEMENT, "SELECT ABS(s) AS x FROM __THIS__")
+    out = stage.transform(t)[0]
+    assert [float(v) for v in out.column("x")] == [0.0] * 4
+
+    # constant WHERE predicate broadcasts over all rows
+    stage.set(SQLTransformer.STATEMENT,
+              "SELECT a FROM __THIS__ WHERE 1 < 2")
+    assert stage.transform(t)[0].num_rows == 4
+
+
+def test_zero_width_token_matrix_through_counting_ops():
+    """NGram with n > width emits an (n, 0) token matrix; the counting ops
+    must return all-empty sparse rows, not crash."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import CountVectorizer, HashingTF, NGram
+
+    docs = np.asarray([["a", "b"], ["c", "d"]])
+    t = Table.from_columns(doc=docs)
+    grams = NGram(input_col="doc", output_col="g", n=5).transform(t)[0]
+    assert grams.column("g").shape == (2, 0)
+    out = HashingTF(input_col="g", output_col="v",
+                    num_features=16).transform(grams)[0]
+    assert [v.values.size for v in out.column("v")] == [0, 0]
+    model = CountVectorizer(input_col="g", output_col="v").fit(grams)
+    assert model.vocabulary == []  # empty corpus → empty vocabulary
