@@ -187,6 +187,35 @@ def _build_sparse_rows(n, size, sorted_row_ids, col_idx, values):
     return CsrVectorColumn(mat)
 
 
+def _tokenize_distinct(col: np.ndarray, tokenize):
+    """Tokenize a fixed-width '<U' string column by running ``tokenize``
+    once per DISTINCT string and gathering — a 10M-row column over a small
+    domain pays |distinct| regex/split calls, not 10M. Equal-length token
+    lists come back as a vectorized (n, L) token matrix; ragged results
+    are an object column whose rows SHARE the per-distinct token list
+    (token cells are read-only by convention, like the shared numpy string
+    buffers they replace)."""
+    n = len(col)
+    if n > 4096:
+        # dedup only pays when the domain is small; probe a sample — a
+        # mostly-distinct free-text column skips the factorize sort and
+        # tokenizes row-by-row as before
+        sample = col[:: max(1, n // 1024)]
+        if len(np.unique(sample)) > len(sample) // 2:
+            out = np.empty(n, dtype=object)
+            for i, text in enumerate(col):
+                out[i] = tokenize(str(text))
+            return out
+    uniq, codes = _token_codes(col)  # flattens; (n,) is fine
+    lists = [tokenize(str(s)) for s in uniq]
+    lengths = {len(t) for t in lists}
+    if len(lengths) == 1 and next(iter(lengths)) > 0:
+        return np.asarray(lists)[codes]  # token matrix
+    uniq_objs = np.empty(len(lists), dtype=object)
+    uniq_objs[:] = lists
+    return uniq_objs[codes]
+
+
 class Tokenizer(Transformer, HasInputCol, HasOutputCol):
     """Lowercase + whitespace split (ref: feature/tokenizer/Tokenizer.java)."""
 
@@ -200,11 +229,8 @@ class Tokenizer(Transformer, HasInputCol, HasOutputCol):
             # token, a vectorized (n, 1) token matrix
             if np.char.isalnum(low).all():
                 return (table.with_column(self.output_col, low[:, None]),)
-            col = low  # already lowercased; split per row below
-            out = np.empty(len(col), dtype=object)
-            for i, text in enumerate(col):
-                out[i] = str(text).split()
-            return (table.with_column(self.output_col, out),)
+            return (table.with_column(
+                self.output_col, _tokenize_distinct(low, str.split)),)
         out = np.empty(len(col), dtype=object)
         for i, text in enumerate(col):
             out[i] = str(text).lower().split()
@@ -230,15 +256,22 @@ class RegexTokenizer(Transformer, HasInputCol, HasOutputCol):
 
     def transform(self, table: Table) -> Tuple[Table]:
         pattern = re.compile(self.pattern)
-        col = table.column(self.input_col)
-        out = np.empty(len(col), dtype=object)
-        for i, text in enumerate(col):
-            text = str(text)
+        min_len = self.min_token_length
+
+        def tokenize(text):
             if self.to_lowercase:
                 text = text.lower()
             tokens = (pattern.split(text) if self.gaps
                       else pattern.findall(text))
-            out[i] = [t for t in tokens if len(t) >= self.min_token_length]
+            return [t for t in tokens if len(t) >= min_len]
+
+        col = table.column(self.input_col)
+        if isinstance(col, np.ndarray) and col.dtype.kind == "U" and len(col):
+            return (table.with_column(self.output_col,
+                                      _tokenize_distinct(col, tokenize)),)
+        out = np.empty(len(col), dtype=object)
+        for i, text in enumerate(col):
+            out[i] = tokenize(str(text))
         return (table.with_column(self.output_col, out),)
 
 
