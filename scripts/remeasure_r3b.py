@@ -1,0 +1,93 @@
+"""Re-measure sweep entries whose code paths changed after the committed
+sweep, plus the FTRL north-star row, on the real TPU.
+
+Entries re-measured here (all via the standard warmup + best-of-3
+protocol of flink_ml_tpu.benchmark.runner.best_of):
+- text/string ops vectorized this round: countvectorizer, hashingtf,
+  featurehasher, stopwordsremover, regextokenizer, sqltransformer
+- entries recorded before later device-offload commits: NaiveBayes
+  (naivebayes), univariatefeatureselector, vectorindexer,
+  kbinsdiscretizer
+- OnlineLogisticRegression FTRL (our config; fills BASELINE.md's last TBD)
+
+Each result is written to benchmark_results_r3.json as soon as it lands,
+so a crash or tunnel outage keeps partial progress. Finishes by
+regenerating the sweep chart.
+
+Run: python scripts/remeasure_r3b.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+RESULTS = os.path.join(ROOT, "benchmark_results_r3.json")
+CONFIG_DIR = os.path.join(ROOT, "flink_ml_tpu", "benchmark", "configs")
+
+RE_MEASURE = [
+    "countvectorizer-benchmark.json",
+    "hashingtf-benchmark.json",
+    "featurehasher-benchmark.json",
+    "stopwordsremover-benchmark.json",
+    "regextokenizer-benchmark.json",
+    "sqltransformer-benchmark.json",
+    "naivebayes-benchmark.json",
+    "univariatefeatureselector-benchmark.json",
+    "vectorindexer-benchmark.json",
+    "kbinsdiscretizer-benchmark.json",
+    "tokenizer-benchmark.json",
+    "ngram-benchmark.json",
+    "onlinelogisticregression-benchmark.json",
+]
+
+
+def main():
+    import jax
+
+    assert jax.default_backend() != "cpu", "needs the TPU backend"
+    print("backend:", jax.default_backend(), flush=True)
+
+    from flink_ml_tpu.benchmark.runner import best_of, load_config
+
+    for cfg_file in RE_MEASURE:
+        path = os.path.join(CONFIG_DIR, cfg_file)
+        if not os.path.exists(path):
+            print(f"skip {cfg_file}: no such config", flush=True)
+            continue
+        for name, spec in load_config(path).items():
+            try:
+                best = best_of(name, spec)
+            except Exception as e:  # noqa: BLE001 — keep measuring the rest
+                print(f"{name}: FAILED {type(e).__name__}: {e}", flush=True)
+                continue
+            with open(RESULTS) as f:
+                d = json.load(f)
+            key = name if name in d else \
+                "OnlineLogisticRegression-FTRL" if "Online" in name else name
+            entry = d.get(key, {"configFile": cfg_file})
+            entry["stage"] = spec["stage"]
+            entry["inputData"] = spec["inputData"]
+            entry["results"] = best
+            entry["runs"] = 4
+            entry["platform"] = "tpu"
+            entry.pop("note", None)
+            entry.pop("exception", None)
+            d[key] = entry
+            with open(RESULTS, "w") as f:
+                json.dump(d, f, indent=2)
+            print(f"{name:45s} {best['inputThroughput']:14,.0f} rec/s "
+                  f"({best['totalTimeMs']:10,.0f} ms)", flush=True)
+
+    from flink_ml_tpu.benchmark import visualize
+
+    visualize.main([RESULTS, "--output-file",
+                    os.path.join(ROOT, "benchmark_results_r3.png"),
+                    "--title", "flink-ml-tpu benchmark sweep"])
+    print("chart regenerated", flush=True)
+
+
+if __name__ == "__main__":
+    main()
