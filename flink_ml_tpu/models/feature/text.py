@@ -699,6 +699,25 @@ class IDFModel(Model, IDFModelParams):
     def transform(self, table: Table) -> Tuple[Table]:
         if self.idf is None:
             raise ValueError("IDFModel has no model data")
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        col = table.column(self.input_col)
+        if sp_mod.is_sparse_column(col):
+            # O(nnz), never densified: scale stored values by their
+            # column's idf, structure shared (a 2^18-dim HashingTF/CV
+            # output would be 20 TB dense at 10M rows)
+            import scipy.sparse as sp
+
+            m = sp_mod.column_to_csr(col)
+            if m.shape[1] != self.idf.shape[0]:
+                raise ValueError(
+                    f"input vectors have size {m.shape[1]}, model idf has "
+                    f"{self.idf.shape[0]}")
+            scaled = sp.csr_matrix(
+                (m.data * self.idf[m.indices], m.indices, m.indptr),
+                shape=m.shape)
+            return (table.with_column(self.output_col,
+                                      sp_mod.CsrVectorColumn(scaled)),)
         from flink_ml_tpu.ops import columnar
         x = columnar.input_vectors(table, self.input_col)
         out = columnar.apply(_idf_kernel, x, (self.idf,))
@@ -733,14 +752,25 @@ class IDF(Estimator, IDFParams):
     df < minDocFreq get idf 0 (ref: feature/idf/IDF.java)."""
 
     def fit(self, table: Table) -> IDFModel:
-        from flink_ml_tpu.ops import columnar
+        from flink_ml_tpu.linalg import sparse as sp_mod
 
-        x, xp = columnar.fit_vectors(table, self.input_col)
-        m = x.shape[0]
-        if xp is not np:  # device-resident: df reduction stays on device
-            df = np.asarray(columnar.apply(_df_kernel, x), np.float64)
+        col = table.column(self.input_col)
+        if sp_mod.is_sparse_column(col):
+            csr = sp_mod.column_to_csr(col)
+            m = csr.shape[0]
+            # document frequency per dim = nonzero STORED values per column
+            df = np.bincount(csr.indices,
+                             weights=(csr.data != 0).astype(np.float64),
+                             minlength=csr.shape[1])
         else:
-            df = (x != 0).sum(axis=0)
+            from flink_ml_tpu.ops import columnar
+
+            x, xp = columnar.fit_vectors(table, self.input_col)
+            m = x.shape[0]
+            if xp is not np:  # device-resident: df reduction on device
+                df = np.asarray(columnar.apply(_df_kernel, x), np.float64)
+            else:
+                df = (x != 0).sum(axis=0)
         idf = np.log((m + 1.0) / (df + 1.0))
         idf = np.where(df >= self.min_doc_freq, idf, 0.0)
         model = IDFModel(idf=idf, doc_freq=df.astype(np.int64), num_docs=m)

@@ -59,6 +59,29 @@ class Normalizer(Transformer, HasInputCol, HasOutputCol):
     P = FloatParam("p", "The p norm value.", 2.0, ParamValidators.gt_eq(1.0))
 
     def transform(self, table: Table) -> Tuple[Table]:
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        col = table.column(self.input_col)
+        if sp_mod.is_sparse_column(col):
+            # O(nnz): per-row p-norm over stored values, structure shared
+            import scipy.sparse as sp
+
+            m = sp_mod.column_to_csr(col)
+            p = float(self.p)
+            if np.isinf(p):  # max-abs norm, like the dense kernel
+                norms = np.asarray(
+                    abs(m).max(axis=1).todense()).ravel()
+            else:
+                norms = np.power(
+                    np.asarray(abs(m).power(p).sum(axis=1)).ravel(),
+                    1.0 / p)
+            # zero-norm rows stay unscaled (divide by 1), as in the kernel
+            row_scale = np.repeat(1.0 / np.where(norms > 0, norms, 1.0),
+                                  np.diff(m.indptr))
+            out = sp.csr_matrix((m.data * row_scale, m.indices, m.indptr),
+                                shape=m.shape)
+            return (table.with_column(self.output_col,
+                                      sp_mod.CsrVectorColumn(out)),)
         x = columnar.input_vectors(table, self.input_col)
         out = columnar.apply(_normalizer_kernel, x, (), (float(self.p),))
         return (table.with_column(self.output_col, out),)

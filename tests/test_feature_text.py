@@ -314,3 +314,69 @@ def test_string_indexer_vectorized_matches_object(rng):
                       handle_invalid="keep").fit(Table.from_columns(s=vals))
     out = m.transform(Table.from_columns(s=np.array(["a", "zz"])))[0]["i"]
     np.testing.assert_array_equal(out, [0.0, 3.0])
+
+
+def test_idf_and_normalizer_sparse_never_densify():
+    """The HashingTF->IDF->Normalizer chain at wide dims must stay CSR end
+    to end (dense would be n x 2^18) and match the dense-path math."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.linalg.sparse import is_csr_column
+    from flink_ml_tpu.models.feature import IDF, HashingTF, Normalizer
+
+    rng = np.random.default_rng(3)
+    words = np.asarray([f"tok{i}" for i in range(50)])
+    docs = words[rng.integers(0, 50, (300, 12))]
+    t = Table.from_columns(doc=docs)
+    wide = 1 << 18
+
+    hashed = HashingTF(input_col="doc", output_col="tf",
+                       num_features=wide).transform(t)[0]
+    assert is_csr_column(hashed.column("tf"))
+    idf_model = IDF(input_col="tf", output_col="tfidf").fit(hashed)
+    scored = idf_model.transform(hashed)[0]
+    assert is_csr_column(scored.column("tfidf"))
+    normed = Normalizer(input_col="tfidf", output_col="n",
+                        p=2.0).transform(scored)[0]
+    assert is_csr_column(normed.column("n"))
+
+    # oracle at a narrow width where densifying is affordable
+    narrow = 64
+    hashed_n = HashingTF(input_col="doc", output_col="tf",
+                         num_features=narrow).transform(t)[0]
+    model_n = IDF(input_col="tf", output_col="tfidf").fit(hashed_n)
+    dense_in = hashed_n.column("tf").to_dense()
+    df = (dense_in != 0).sum(axis=0)
+    idf_expect = np.log((300 + 1.0) / (df + 1.0))
+    np.testing.assert_allclose(model_n.idf, idf_expect, rtol=1e-12)
+    sparse_scored = model_n.transform(hashed_n)[0].column("tfidf").to_dense()
+    np.testing.assert_allclose(sparse_scored, dense_in * idf_expect[None, :],
+                               rtol=1e-12)
+    sparse_normed = Normalizer(input_col="tfidf", output_col="n", p=3.0) \
+        .transform(model_n.transform(hashed_n)[0])[0].column("n").to_dense()
+    dense_scored = dense_in * idf_expect[None, :]
+    norms = np.power((np.abs(dense_scored) ** 3.0).sum(axis=1), 1 / 3.0)
+    np.testing.assert_allclose(
+        sparse_normed,
+        dense_scored / np.where(norms > 0, norms, 1.0)[:, None], rtol=1e-12)
+
+
+def test_normalizer_sparse_inf_norm():
+    """p=inf on sparse input must divide by max|v|, matching dense."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.linalg.vectors import SparseVector
+    from flink_ml_tpu.models.feature import Normalizer
+
+    col = np.empty(3, dtype=object)
+    col[0] = SparseVector(4, [1, 2], [3.0, -4.0])
+    col[1] = SparseVector(4, [], [])            # zero row stays zero
+    col[2] = SparseVector(4, [0], [2.0])
+    t = Table.from_columns(v=col)
+    out = Normalizer(input_col="v", output_col="n",
+                     p=float("inf")).transform(t)[0]
+    dense = out.column("n").to_dense()
+    np.testing.assert_allclose(
+        dense, [[0, 0.75, -1.0, 0], [0, 0, 0, 0], [1.0, 0, 0, 0]])
