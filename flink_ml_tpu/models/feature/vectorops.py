@@ -205,6 +205,8 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol,
         return np.asarray(value, np.float64).reshape(-1).shape[0]
 
     def transform(self, table: Table) -> Tuple[Table]:
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
         sizes = self.input_sizes
         if sizes is not None and len(sizes) != len(self.input_cols):
             raise ValueError("inputSizes must match inputCols length")
@@ -216,7 +218,9 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol,
             first_mismatch = None
             for i, name in enumerate(self.input_cols):
                 col = table.column(name)
-                if col.dtype == object:
+                if sp_mod.is_csr_column(col):
+                    row_sizes = np.full(len(col), col.to_csr().shape[1])
+                elif col.dtype == object:
                     row_sizes = np.fromiter(
                         (self._row_size(v) for v in col), dtype=np.int64,
                         count=len(col))
@@ -239,6 +243,10 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol,
                 if table.num_rows == 0:
                     return (table.with_column(
                         self.output_col, np.zeros((0, sum(sizes)))),)
+        sparse_flags = [sp_mod.is_sparse_column(table.column(n))
+                        for n in self.input_cols]
+        if any(sparse_flags):
+            return self._assemble_sparse(table, sparse_flags)
         mats = []
         for name in self.input_cols:
             col = table.column(name)
@@ -259,6 +267,45 @@ class VectorAssembler(Transformer, HasInputCols, HasOutputCol,
                 return (table.take(np.nonzero(keep)[0])
                         .with_column(self.output_col, out[keep]),)
         return (table.with_column(self.output_col, out),)
+
+    def _assemble_sparse(self, table: Table, sparse_flags) -> Tuple[Table]:
+        """Any sparse input → CSR output via block hstack, O(total nnz);
+        a wide HashingTF column plus scalar columns never densifies.
+        NaN policy applies to STORED values (implicit zeros are valid)."""
+        import scipy.sparse as sp
+
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        blocks = []
+        for name, is_sparse in zip(self.input_cols, sparse_flags):
+            col = table.column(name)
+            if is_sparse:
+                blocks.append(sp_mod.column_to_csr(col))
+            elif col.dtype == object or col.ndim == 2:
+                blocks.append(sp.csr_matrix(table.vectors(name, np.float64)))
+            else:
+                blocks.append(sp.csr_matrix(
+                    np.asarray(col, np.float64)[:, None]))
+        out = sp.hstack(blocks, format="csr")
+        nan_pos = np.nonzero(np.isnan(out.data))[0]
+        if len(nan_pos):
+            if self.handle_invalid == self.ERROR_INVALID:
+                rows_nan = np.unique(np.searchsorted(
+                    out.indptr, nan_pos, side="right") - 1)
+                raise ValueError(
+                    f"Encountered NaN while assembling rows "
+                    f"{rows_nan[:5].tolist()}... (handleInvalid=error)")
+            if self.handle_invalid == self.SKIP_INVALID:
+                rows_nan = np.unique(np.searchsorted(
+                    out.indptr, nan_pos, side="right") - 1)
+                keep = np.ones(out.shape[0], bool)
+                keep[rows_nan] = False
+                kept_idx = np.nonzero(keep)[0]
+                return (table.take(kept_idx).with_column(
+                    self.output_col,
+                    sp_mod.CsrVectorColumn(out[kept_idx])),)
+        return (table.with_column(self.output_col,
+                                  sp_mod.CsrVectorColumn(out)),)
 
 
 def _gather_cols_kernel(x, idx):

@@ -122,4 +122,6 @@ def test_assembler_input_sizes_sparse_vectors():
     col[1] = Vectors.sparse(3, [1, 2], [2.0, 3.0])
     t = Table.from_columns(v=col)
     out = VectorAssembler(input_cols=["v"], input_sizes=[3]).transform(t)[0]
-    np.testing.assert_allclose(out["output"], [[1, 0, 0], [0, 2, 3]])
+    # sparse inputs now stay sparse (CSR column); compare densified
+    np.testing.assert_allclose(out["output"].to_dense(),
+                               [[1, 0, 0], [0, 2, 3]])

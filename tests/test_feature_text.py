@@ -319,11 +319,8 @@ def test_string_indexer_vectorized_matches_object(rng):
 def test_idf_and_normalizer_sparse_never_densify():
     """The HashingTF->IDF->Normalizer chain at wide dims must stay CSR end
     to end (dense would be n x 2^18) and match the dense-path math."""
-    import numpy as np
-
-    from flink_ml_tpu.common.table import Table
     from flink_ml_tpu.linalg.sparse import is_csr_column
-    from flink_ml_tpu.models.feature import IDF, HashingTF, Normalizer
+    from flink_ml_tpu.models.feature import Normalizer
 
     rng = np.random.default_rng(3)
     words = np.asarray([f"tok{i}" for i in range(50)])
@@ -364,9 +361,6 @@ def test_idf_and_normalizer_sparse_never_densify():
 
 def test_normalizer_sparse_inf_norm():
     """p=inf on sparse input must divide by max|v|, matching dense."""
-    import numpy as np
-
-    from flink_ml_tpu.common.table import Table
     from flink_ml_tpu.linalg.vectors import SparseVector
     from flink_ml_tpu.models.feature import Normalizer
 

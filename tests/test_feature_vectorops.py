@@ -155,3 +155,50 @@ def test_vector_assembler_ragged_object_column():
     with pytest.raises(ValueError, match="declared inputSizes"):
         VectorAssembler(input_cols=["v", "s"],
                         input_sizes=[2, 1]).transform(t)
+
+
+def test_vector_assembler_sparse_inputs_stay_sparse():
+    """Assembling a wide sparse column with scalars/dense must produce a
+    CSR column (never densify) matching the dense oracle, with
+    handleInvalid semantics applied to stored values."""
+    from flink_ml_tpu.linalg.sparse import is_csr_column
+    from flink_ml_tpu.linalg.vectors import SparseVector
+
+    wide = 1 << 18
+    col = np.empty(4, dtype=object)
+    col[0] = SparseVector(wide, [0, 100], [1.0, 2.0])
+    col[1] = SparseVector(wide, [5], [3.0])
+    col[2] = SparseVector(wide, [], [])
+    col[3] = SparseVector(wide, [7], [np.nan])
+    t = Table.from_columns(v=col, s=np.asarray([1.0, 2.0, 3.0, 4.0]),
+                           d=np.asarray([[1., 2.], [3., 4.],
+                                         [5., 6.], [7., 8.]]))
+
+    va = VectorAssembler(input_cols=["v", "s", "d"], output_col="out",
+                         handle_invalid="skip")
+    out = va.transform(t)[0]
+    assert out.num_rows == 3  # NaN row dropped
+    o = out.column("out")
+    assert is_csr_column(o)
+    assert o.to_csr().shape == (3, wide + 3)
+    r0 = o[0]
+    assert r0.indices.tolist() == [0, 100, wide, wide + 1, wide + 2]
+    assert r0.values.tolist() == [1.0, 2.0, 1.0, 1.0, 2.0]
+    r2 = o[2]  # the empty sparse row keeps its scalar/dense parts
+    assert r2.indices.tolist() == [wide, wide + 1, wide + 2]
+
+    with pytest.raises(ValueError, match="NaN"):
+        VectorAssembler(input_cols=["v", "s"], output_col="out",
+                        handle_invalid="error").transform(t)
+    kept = VectorAssembler(input_cols=["v", "s"], output_col="out",
+                           handle_invalid="keep").transform(t)[0]
+    assert kept.num_rows == 4 and np.isnan(kept.column("out")[3].values).any()
+
+    # inputSizes check works on the CSR column without materializing rows
+    sized = VectorAssembler(input_cols=["v", "s"], output_col="out",
+                            input_sizes=[wide, 1], handle_invalid="keep")
+    assert sized.transform(t)[0].num_rows == 4
+    with pytest.raises(ValueError, match="size"):
+        VectorAssembler(input_cols=["v", "s"], output_col="out",
+                        input_sizes=[8, 1],
+                        handle_invalid="error").transform(t)
