@@ -520,14 +520,69 @@ class MinHashLSHModel(Model, LSHParams, HasSeed):
         return mins.reshape(self.num_hash_tables,
                             self.num_hash_functions_per_table)
 
+    def _hash_column(self, col) -> np.ndarray:
+        """All rows' MinHash signatures at once: (n, tables, fns).
+
+        The whole column goes through one CSR (sparse columns zero-copy,
+        dense ones via their nonzero pattern) and each hash function's
+        per-row min is a ``minimum.reduceat`` over the stored indices —
+        no per-row Python. Chunked so the (H, nnz) hash matrix stays
+        bounded regardless of column size.
+        """
+        import scipy.sparse as sp
+
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        h = len(self.coeff_a)
+        if sp_mod.is_csr_column(col) or (
+                sp_mod.is_sparse_column(col)
+                and all(isinstance(v, SparseVector) for v in col)):
+            # hash over STORED indices, like _hash_one on SparseVector
+            # (explicit zeros participate — reference semantics)
+            m = sp_mod.column_to_csr(col)
+        elif getattr(col, "dtype", None) == object:
+            # mixed sparse/dense rows: dense rows hash their NONZERO
+            # pattern while sparse rows hash stored indices — per-row
+            # dispatch is the only faithful evaluation
+            out = np.empty((len(col), self.num_hash_tables,
+                            self.num_hash_functions_per_table), np.float64)
+            for i in range(len(col)):
+                out[i] = self._hash_one(col[i])
+            return out
+        else:
+            dense = np.asarray(col, np.float64)
+            if dense.ndim == 1:
+                dense = dense[:, None]
+            m = sp.csr_matrix(dense)  # stores only nonzeros, as _hash_one
+        if (np.diff(m.indptr) == 0).any():
+            raise ValueError("MinHash needs at least one non-zero entry")
+        n = m.shape[0]
+        out = np.empty((n, h), np.float64)
+        nnz_budget = max(1, 50_000_000 // max(int(h), 1))
+        r0 = 0
+        while r0 < n:
+            # chunk by nnz so the (h, chunk_nnz) hash matrix stays bounded
+            r1 = int(np.searchsorted(m.indptr, m.indptr[r0] + nnz_budget,
+                                     side="left"))
+            r1 = min(max(r1, r0 + 1), n)
+            lo, hi = m.indptr[r0], m.indptr[r1]
+            idx = m.indices[lo:hi].astype(np.int64)
+            vals = (self.coeff_a[:, None] * (idx[None, :] + 1)
+                    + self.coeff_b[:, None]) % _MERSENNE_PRIME
+            local_ptr = (m.indptr[r0:r1] - lo).astype(np.int64)
+            out[r0:r1] = np.minimum.reduceat(vals, local_ptr, axis=1).T
+            r0 = r1
+        return out.reshape(n, self.num_hash_tables,
+                           self.num_hash_functions_per_table)
+
     def transform(self, table: Table) -> Tuple[Table]:
         if self.coeff_a is None:
             raise ValueError("MinHashLSHModel has no model data")
         col = table.column(self.input_col)
+        hashes = self._hash_column(col)
         out = np.empty(len(col), dtype=object)
         for i in range(len(col)):
-            hashes = self._hash_one(col[i])
-            out[i] = [DenseVector(h) for h in hashes]
+            out[i] = [DenseVector(h) for h in hashes[i]]
         return (table.with_column(self.output_col, out),)
 
     # -- extra model APIs (ref: LSHModel.java:141,210) ----------------------
@@ -544,12 +599,9 @@ class MinHashLSHModel(Model, LSHParams, HasSeed):
         key_hashes = self._hash_one(key)
         key_idx = self._nonzero_indices(key)
         col = dataset.column(self.input_col)
-        candidates = []
-        for i in range(len(col)):
-            h = self._hash_one(col[i])
-            if any((h[t] == key_hashes[t]).all()
-                   for t in range(self.num_hash_tables)):
-                candidates.append(i)
+        hashes = self._hash_column(col)  # (n, T, F), one vectorized pass
+        match = (hashes == key_hashes[None, :, :]).all(axis=2).any(axis=1)
+        candidates = np.nonzero(match)[0]
         dists = [(i, self._jaccard_distance(
             self._nonzero_indices(col[i]), key_idx)) for i in candidates]
         dists.sort(key=lambda t: t[1])
@@ -566,11 +618,11 @@ class MinHashLSHModel(Model, LSHParams, HasSeed):
         equality on any table (ref: LSHModel.approxSimilarityJoin:210)."""
         def buckets(table):
             col = table.column(self.input_col)
+            hashes = self._hash_column(col)  # per-row hashing vectorized
             out = {}
             for i in range(len(col)):
-                h = self._hash_one(col[i])
                 for t in range(self.num_hash_tables):
-                    out.setdefault((t,) + tuple(h[t]), []).append(i)
+                    out.setdefault((t,) + tuple(hashes[i, t]), []).append(i)
             return out
 
         buckets_a, buckets_b = buckets(table_a), buckets(table_b)

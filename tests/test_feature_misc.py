@@ -281,3 +281,67 @@ def test_zero_width_token_matrix_through_counting_ops():
     assert [v.values.size for v in out.column("v")] == [0, 0]
     model = CountVectorizer(input_col="g", output_col="v").fit(grams)
     assert model.vocabulary == []  # empty corpus → empty vocabulary
+
+
+def test_minhash_column_hashing_matches_per_row():
+    """The vectorized CSR signature pass must equal per-row hashing for
+    sparse and dense inputs alike, and reject all-zero rows."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.linalg.vectors import DenseVector, SparseVector
+    from flink_ml_tpu.models.feature import MinHashLSH
+
+    rng = np.random.default_rng(0)
+    col = np.empty(50, dtype=object)
+    for i in range(50):
+        nnz = rng.integers(1, 8)
+        col[i] = SparseVector(64, np.sort(rng.choice(64, nnz,
+                                                     replace=False)),
+                              np.ones(nnz))
+    t = Table.from_columns(v=col)
+    model = MinHashLSH(input_col="v", output_col="h", num_hash_tables=3,
+                       num_hash_functions_per_table=2, seed=5).fit(t)
+    batch = model._hash_column(col)
+    for i in range(50):
+        np.testing.assert_array_equal(batch[i], model._hash_one(col[i]))
+
+    dense = np.asarray([[0, 1, 0, 2.0], [3, 0, 0, 1.0]])
+    td = Table.from_columns(v=dense)
+    m2 = MinHashLSH(input_col="v", output_col="h", num_hash_tables=2,
+                    num_hash_functions_per_table=1, seed=5).fit(td)
+    b2 = m2._hash_column(td.column("v"))
+    for i in range(2):
+        np.testing.assert_array_equal(b2[i],
+                                      m2._hash_one(DenseVector(dense[i])))
+
+    import pytest
+    zero = np.asarray([[0.0, 0.0], [1.0, 0.0]])
+    tz = Table.from_columns(v=zero)
+    m3 = MinHashLSH(input_col="v", output_col="h", seed=1).fit(tz)
+    with pytest.raises(ValueError, match="non-zero"):
+        m3.transform(tz)
+
+
+def test_minhash_mixed_and_scalar_columns_match_per_row():
+    """Mixed sparse/dense columns and 1-D scalar columns must hash exactly
+    as the per-row rule (dense rows by nonzero pattern, sparse rows by
+    stored indices)."""
+    from flink_ml_tpu.linalg.vectors import DenseVector, SparseVector
+
+    mixed = np.empty(3, dtype=object)
+    mixed[0] = SparseVector(4, [1], [1.0])
+    mixed[1] = DenseVector(np.asarray([0.0, 1.0, 0.0, 2.0]))
+    mixed[2] = SparseVector(4, [0, 3], [1.0, 0.0])  # explicit zero stays
+    t = Table.from_columns(v=mixed)
+    model = MinHashLSH(input_col="v", output_col="h", num_hash_tables=2,
+                       num_hash_functions_per_table=2, seed=9).fit(t)
+    batch = model._hash_column(mixed)
+    for i in range(3):
+        np.testing.assert_array_equal(batch[i], model._hash_one(mixed[i]))
+
+    scalars = np.asarray([1.0, 2.0, 3.0])
+    ts = Table.from_columns(v=scalars)
+    m2 = MinHashLSH(input_col="v", output_col="h", seed=2).fit(ts)
+    out = m2.transform(ts)[0]
+    assert len(out["h"]) == 3
