@@ -114,6 +114,21 @@ def is_csr_column(col) -> bool:
     return getattr(col, "is_csr_vector_column", False)
 
 
+def build_csr_column(n: int, size: int, sorted_row_ids, col_idx,
+                     values) -> CsrVectorColumn:
+    """Row-major (row, column, value) triples → a CSR-backed column.
+
+    ``sorted_row_ids`` must be ascending. O(n) searchsorted + zero copies:
+    the triples ARE the CSR buffers — no per-row SparseVector loop."""
+    import scipy.sparse as sp
+
+    indptr = np.searchsorted(sorted_row_ids,
+                             np.arange(n + 1, dtype=np.int64))
+    return CsrVectorColumn(sp.csr_matrix(
+        (np.asarray(values, np.float64), np.asarray(col_idx, np.int64),
+         indptr), shape=(n, size)))
+
+
 def is_sparse_column(col) -> bool:
     """True for a CSR-backed column or an object column holding at least
     one SparseVector row.

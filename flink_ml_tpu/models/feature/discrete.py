@@ -232,22 +232,19 @@ class OneHotEncoderModel(Model, OneHotEncoderParams):
             if self.handle_invalid == self.KEEP_INVALID:
                 size += 1  # extra category for invalid values
             # one-hot rows have 0 or 1 entries: compute the entry index for
-            # every row vectorized, then build via the unchecked fast path
+            # every row vectorized, then emit ONE CSR for the whole column
+            # (no 10M-object loop; rows materialize lazily)
             entry = ints.copy()
             has_entry = (~invalid & (ints < size)
                          & ~(self.drop_last & (ints == n_cats - 1)))
             if self.handle_invalid == self.KEEP_INVALID:
                 entry[invalid] = size - 1  # the extra invalid category
                 has_entry |= invalid
-            empty_i, empty_v = np.empty(0, np.int64), np.empty(0)
-            out = np.empty(len(vals), dtype=object)
-            for i in range(len(vals)):
-                if has_entry[i]:
-                    out[i] = SparseVector._unchecked(
-                        size, entry[i:i + 1].copy(), np.ones(1))
-                else:
-                    out[i] = SparseVector._unchecked(size, empty_i, empty_v)
-            outs[out_name] = out
+            from flink_ml_tpu.linalg.sparse import build_csr_column
+
+            rows = np.nonzero(has_entry)[0]
+            outs[out_name] = build_csr_column(
+                len(vals), size, rows, entry[rows], np.ones(len(rows)))
         if invalid_any.any() and self.handle_invalid == self.ERROR_INVALID:
             raise ValueError("invalid category values encountered "
                              "(handleInvalid=error)")

@@ -169,22 +169,13 @@ def _rowwise_counts(mat: np.ndarray, with_counts: bool = True):
 
 
 def _build_sparse_rows(n, size, sorted_row_ids, col_idx, values):
-    """Row-major (row, column, value) triples → a CSR-backed vector column.
-    ``sorted_row_ids`` must be ascending (the output of the key-sorted
-    aggregations here). O(n) searchsorted + zero copies: the triples ARE
-    the CSR buffers — no per-row SparseVector loop (10M constructions was
-    the dominant transform cost at benchmark scale); rows materialize
-    lazily on access (CsrVectorColumn)."""
-    import scipy.sparse as sp
+    """See linalg.sparse.build_csr_column (shared with OneHotEncoder):
+    the aggregation triples become the CSR buffers directly — no per-row
+    SparseVector loop (10M constructions was the dominant transform cost
+    at benchmark scale); rows materialize lazily on access."""
+    from flink_ml_tpu.linalg.sparse import build_csr_column
 
-    from flink_ml_tpu.linalg.sparse import CsrVectorColumn
-
-    indptr = np.searchsorted(sorted_row_ids,
-                             np.arange(n + 1, dtype=np.int64))
-    mat = sp.csr_matrix(
-        (np.asarray(values, np.float64), np.asarray(col_idx, np.int64),
-         indptr), shape=(n, size))
-    return CsrVectorColumn(mat)
+    return build_csr_column(n, size, sorted_row_ids, col_idx, values)
 
 
 def _tokenize_distinct(col: np.ndarray, tokenize):
