@@ -163,11 +163,43 @@ def _interaction_kernel(*mats):
     return out
 
 
+def _sparse_outer_fold(a, b):
+    """Per-row flattened outer product of two CSR matrices: row i of the
+    result has indices a_idx*size_b + b_idx over the cartesian product of
+    the rows' stored entries (a-major, so per-row order stays ascending).
+    O(total output nnz), fully vectorized."""
+    import scipy.sparse as sp
+
+    n = a.shape[0]
+    na, nb = np.diff(a.indptr), np.diff(b.indptr)
+    per_a_entry = np.repeat(nb, na)        # b-count for each stored a entry
+    a_idx = np.repeat(a.indices.astype(np.int64), per_a_entry)
+    a_val = np.repeat(a.data, per_a_entry)
+    out_nnz = na * nb
+    total = int(out_nnz.sum())
+    out_indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(out_nnz, out=out_indptr[1:])
+    # b side: within each row, the b block tiles once per a entry
+    out_row = np.repeat(np.arange(n, dtype=np.int64), out_nnz)
+    pos = np.arange(total, dtype=np.int64) - out_indptr[out_row]
+    b_pos = b.indptr[out_row] + pos % np.maximum(nb[out_row], 1)
+    out_idx = a_idx * b.shape[1] + b.indices.astype(np.int64)[b_pos]
+    out_val = a_val * b.data[b_pos]
+    return sp.csr_matrix((out_val, out_idx, out_indptr),
+                         shape=(n, a.shape[1] * b.shape[1]))
+
+
 class Interaction(Transformer, HasInputCols, HasOutputCol):
     """Flattened outer product of the input columns' values
     (ref: feature/interaction/ — scalar columns count as 1-dim vectors)."""
 
     def transform(self, table: Table) -> Tuple[Table]:
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        sparse_flags = [sp_mod.is_sparse_column(table.column(n))
+                        for n in self.input_cols]
+        if any(sparse_flags):
+            return self._transform_sparse(table, sparse_flags)
         mats = []
         for name in self.input_cols:
             col = table.column(name)
@@ -179,6 +211,28 @@ class Interaction(Transformer, HasInputCols, HasOutputCol):
                 mats.append(np.asarray(col, np.float32)[:, None])
         out = columnar.apply_multi(_interaction_kernel, mats)
         return (table.with_column(self.output_col, out),)
+
+    def _transform_sparse(self, table: Table, sparse_flags) -> Tuple[Table]:
+        """Any sparse input → fold per-row outer products over CSR blocks,
+        O(output nnz) — a wide hashed column interacted with scalars never
+        densifies."""
+        import scipy.sparse as sp
+
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        out = None
+        for name, is_sparse in zip(self.input_cols, sparse_flags):
+            col = table.column(name)
+            if is_sparse:
+                block = sp_mod.column_to_csr(col)
+            elif getattr(col, "ndim", 1) == 2 or col.dtype == object:
+                block = sp.csr_matrix(table.vectors(name, np.float64))
+            else:
+                block = sp.csr_matrix(
+                    np.asarray(col, np.float64)[:, None])
+            out = block if out is None else _sparse_outer_fold(out, block)
+        return (table.with_column(self.output_col,
+                                  sp_mod.CsrVectorColumn(out)),)
 
 
 class VectorAssembler(Transformer, HasInputCols, HasOutputCol,

@@ -202,3 +202,32 @@ def test_vector_assembler_sparse_inputs_stay_sparse():
         VectorAssembler(input_cols=["v", "s"], output_col="out",
                         input_sizes=[8, 1],
                         handle_invalid="error").transform(t)
+
+
+def test_interaction_sparse_matches_dense_oracle():
+    """Sparse interaction must equal the dense outer-product flatten, stay
+    CSR, and compose scalars x sparse x dense without densifying the wide
+    side."""
+    from flink_ml_tpu.linalg.sparse import is_csr_column
+    from flink_ml_tpu.linalg.vectors import SparseVector
+
+    rng = np.random.default_rng(4)
+    n, da, db = 50, 6, 5
+    dense_a = np.where(rng.random((n, da)) < 0.4,
+                       rng.normal(size=(n, da)), 0.0)
+    col_a = np.empty(n, dtype=object)
+    for i in range(n):
+        nz = np.nonzero(dense_a[i])[0]
+        col_a[i] = SparseVector(da, nz, dense_a[i, nz])
+    dense_b = rng.normal(size=(n, db))
+    scalar = rng.normal(size=n)
+    t = Table.from_columns(a=col_a, b=dense_b, s=scalar)
+
+    out = Interaction(input_cols=["s", "a", "b"],
+                      output_col="x").transform(t)[0]
+    o = out.column("x")
+    assert is_csr_column(o)
+    expect = (scalar[:, None, None, None]
+              * dense_a[:, None, :, None]
+              * dense_b[:, None, None, :]).reshape(n, -1)
+    np.testing.assert_allclose(o.to_dense(), expect, rtol=1e-12)
