@@ -355,8 +355,9 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
         from flink_ml_tpu.parallel.collective import ensure_on_mesh
         from flink_ml_tpu.parallel.mesh import data_axes, default_mesh
 
-        mesh = default_mesh()
-        axes = data_axes(mesh)
+        # the mesh initializes the device backend — only on the first DENSE
+        # batch, so an all-sparse stream trains with no device at all
+        mesh = axes = None
 
         for batch in _as_stream(data, self.global_batch_size):
             # float32 request: a device-resident dense column passes
@@ -369,6 +370,9 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                 # dense batches (see to_host above)
                 import jax.numpy as jnp
 
+                if mesh is None:
+                    mesh = default_mesh()
+                    axes = data_axes(mesh)
                 program = _ftrl_program(mesh, alpha, beta, l1, l2)
                 xb, n_rows = ensure_on_mesh(mesh, x, axes, jnp.float32)
                 ycol = batch.column(self.label_col)  # device col stays put
