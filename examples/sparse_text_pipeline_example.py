@@ -38,7 +38,9 @@ def main():
         .transform(tokens)[0]
     print("hashed column:", hashed.column("features"))  # one CSR, 2^18 dims
 
-    dim = 1 << 18
+    # the initial model width comes FROM the hashed column, so the example
+    # stays correct if the transformer's numFeatures changes
+    dim = hashed.column("features").to_csr().shape[1]
     init = Table.from_columns(
         coefficient=as_dense_vector_column(np.zeros((1, dim))),
         modelVersion=np.asarray([0]))
