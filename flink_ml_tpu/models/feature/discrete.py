@@ -72,9 +72,15 @@ class StringIndexerModel(Model, StringIndexerModelParams):
             col = table.column(name)
             if isinstance(col, np.ndarray) and col.dtype != object:
                 # homogeneous column: one lookup per DISTINCT value, then
-                # a gather — 100M rows cost one np.unique, not 100M dict
-                # probes
-                uniq, inv = np.unique(col, return_inverse=True)
+                # a gather — 100M rows cost one factorization, not 100M
+                # dict probes; '<U' columns hash-factorize over an integer
+                # view (no O(n log n) string sort)
+                if col.dtype.kind == "U":
+                    from flink_ml_tpu.models.feature.text import \
+                        _token_codes
+                    uniq, inv = _token_codes(col)
+                else:
+                    uniq, inv = np.unique(col, return_inverse=True)
                 ids = np.fromiter(
                     (index.get(str(v), -1) for v in uniq), np.int64,
                     len(uniq))
@@ -132,9 +138,21 @@ class StringIndexer(Estimator, StringIndexerParams):
         for name in self.input_cols:
             col = table.column(name)
             if isinstance(col, np.ndarray) and col.dtype != object:
-                # homogeneous column: count/order once per DISTINCT value
-                uniq, first_idx, cnts = np.unique(
-                    col, return_index=True, return_counts=True)
+                # homogeneous column: count/order once per DISTINCT value;
+                # '<U' columns hash-factorize (no global string sort) with
+                # first-occurrence via one reversed scatter (last write
+                # wins → first occurrence survives)
+                if col.dtype.kind == "U" and len(col):
+                    from flink_ml_tpu.models.feature.text import \
+                        _token_codes
+                    uniq, codes = _token_codes(col)
+                    cnts = np.bincount(codes, minlength=len(uniq))
+                    first_idx = np.empty(len(uniq), np.int64)
+                    first_idx[codes[::-1]] = np.arange(
+                        len(col) - 1, -1, -1, dtype=np.int64)
+                else:
+                    uniq, first_idx, cnts = np.unique(
+                        col, return_index=True, return_counts=True)
                 svals = np.array([str(v) for v in uniq])
                 if order == self.FREQUENCY_DESC_ORDER:
                     pick = np.lexsort((svals, -cnts))
