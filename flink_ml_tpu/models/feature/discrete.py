@@ -73,14 +73,10 @@ class StringIndexerModel(Model, StringIndexerModelParams):
             if isinstance(col, np.ndarray) and col.dtype != object:
                 # homogeneous column: one lookup per DISTINCT value, then
                 # a gather — 100M rows cost one factorization, not 100M
-                # dict probes; '<U' columns hash-factorize over an integer
-                # view (no O(n log n) string sort)
-                if col.dtype.kind == "U":
-                    from flink_ml_tpu.models.feature.text import \
-                        _token_codes
-                    uniq, inv = _token_codes(col)
-                else:
-                    uniq, inv = np.unique(col, return_inverse=True)
+                # dict probes ('<U' columns hash-factorize inside
+                # _token_codes; other dtypes fall back to np.unique there)
+                from flink_ml_tpu.models.feature.text import _token_codes
+                uniq, inv = _token_codes(col)
                 ids = np.fromiter(
                     (index.get(str(v), -1) for v in uniq), np.int64,
                     len(uniq))
