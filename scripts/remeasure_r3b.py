@@ -44,15 +44,42 @@ RE_MEASURE = [
 ]
 
 
+#: configs whose measured op runs entirely on host (string/sparse paths) —
+#: their numbers are backend-independent, so a labeled CPU-backend run is
+#: representative when the TPU tunnel is unreachable
+HOST_BOUND = {
+    "countvectorizer-benchmark.json",
+    "hashingtf-benchmark.json",
+    "featurehasher-benchmark.json",
+    "stopwordsremover-benchmark.json",
+    "regextokenizer-benchmark.json",
+    "sqltransformer-benchmark.json",
+    "tokenizer-benchmark.json",
+    "ngram-benchmark.json",
+    "stringindexer-benchmark.json",
+}
+
+
 def main():
+    cpu_fallback = "--cpu-fallback" in sys.argv
+    if cpu_fallback:
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
-    assert jax.default_backend() != "cpu", "needs the TPU backend"
+    if cpu_fallback:
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu-fallback (host-bound op)"
+        configs = [c for c in RE_MEASURE + ["stringindexer-benchmark.json"]
+                   if c in HOST_BOUND]
+    else:
+        assert jax.default_backend() != "cpu", "needs the TPU backend"
+        platform = "tpu"
+        configs = RE_MEASURE + ["stringindexer-benchmark.json"]
     print("backend:", jax.default_backend(), flush=True)
 
     from flink_ml_tpu.benchmark.runner import best_of, load_config
 
-    for cfg_file in RE_MEASURE:
+    for cfg_file in configs:
         path = os.path.join(CONFIG_DIR, cfg_file)
         if not os.path.exists(path):
             print(f"skip {cfg_file}: no such config", flush=True)
@@ -72,7 +99,7 @@ def main():
             entry["inputData"] = spec["inputData"]
             entry["results"] = best
             entry["runs"] = 4
-            entry["platform"] = "tpu"
+            entry["platform"] = platform
             entry.pop("note", None)
             entry.pop("exception", None)
             d[key] = entry
