@@ -137,13 +137,22 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
     runs as plain host code (CSR math); the stop bit is then immediate.
     """
 
-    def round_impl(carry, epoch):
-        new_carry = body(carry, epoch)
-        stop = (jnp.asarray(terminate(new_carry, epoch), dtype=bool)
-                if terminate is not None else jnp.asarray(False))
-        return new_carry, stop
+    if jit_round:
+        def round_impl(carry, epoch):
+            new_carry = body(carry, epoch)
+            stop = (jnp.asarray(terminate(new_carry, epoch), dtype=bool)
+                    if terminate is not None else jnp.asarray(False))
+            return new_carry, stop
 
-    round_fn = jax.jit(round_impl) if jit_round else round_impl
+        round_fn = jax.jit(round_impl)
+    else:
+        # plain host rounds: no jnp anywhere, so a pure-host iteration
+        # (CSR math) runs without ever initializing a device backend
+        def round_fn(carry, epoch):
+            new_carry = body(carry, epoch)
+            stop = (bool(terminate(new_carry, epoch))
+                    if terminate is not None else False)
+            return new_carry, stop
 
     from flink_ml_tpu.common.metrics import ML_GROUP, metrics
     iter_group = metrics.group(ML_GROUP, "iteration")
@@ -161,7 +170,8 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
         round_start = _time.perf_counter()
         if config.per_round_init is not None:
             carry = config.per_round_init(carry, epoch)
-        carry, stop = round_fn(carry, jnp.int32(epoch))
+        carry, stop = round_fn(
+            carry, jnp.int32(epoch) if jit_round else epoch)
         # listeners/checkpoints run while the async-dispatched device round
         # is still executing — host and device legs overlap
         host_start = _time.perf_counter()

@@ -234,6 +234,10 @@ class SGD:
         (SGD.java:308-360) and so is ours.
         """
         prm = self.params
+        # the mesh fixes the simulated task count p: a PURE function of the
+        # mesh configuration, never of process state — sparse and dense
+        # fits must slice batches identically (the parity contract below)
+        # and a checkpointed carry must resume under the same p
         mesh = mesh or default_mesh()
         p = data_shard_count(mesh)
         n, d = features_csr.shape
