@@ -521,3 +521,22 @@ def test_online_lr_mixed_dense_sparse_stream(rng):
         assert isinstance(c, np.ndarray) and c.dtype == np.float64
     assert isinstance(mixed.coefficients, np.ndarray)
     assert mixed.coefficients.dtype == np.float64
+
+
+def test_generate_batches_preserves_device_residency():
+    """Chunks whose device columns align with the global batch size must
+    flow through generate_batches without a host off-ramp (an earlier
+    version concatenated each chunk with an empty buffer, silently pulling
+    every batch to host — 40 MB per batch through the TPU tunnel)."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.iteration.streaming import generate_batches
+
+    x = jnp.ones((40, 4), jnp.float32)
+    y = jnp.zeros((40,), jnp.float32)
+    chunks = [Table.from_columns(features=x[i:i + 10], label=y[i:i + 10])
+              for i in range(0, 40, 10)]
+    for batch in generate_batches(StreamTable(iter(chunks)), 10):
+        col = batch.column("features")
+        assert not isinstance(col, np.ndarray) and hasattr(col, "devices"), \
+            "device column was off-ramped to host"
