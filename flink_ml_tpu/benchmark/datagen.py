@@ -273,6 +273,24 @@ class LogisticRegressionModelDataGenerator(HasSeed, HasVectorDim):
 
 
 @_register
+class KnnModelDataGenerator(HasSeed, HasVectorDim, HasArraySize):
+    """Random KNN model data: arraySize cached train points of vectorDim
+    dims with integer labels (KnnModel.set_model_data schema:
+    packedFeatures + labels). Backs OUR knn benchmark — the reference
+    ships no KNN config; KnnModel.java predict is the matched surface."""
+
+    LABEL_ARITY = IntParam("labelArity", "Number of distinct labels.", 2,
+                           ParamValidators.gt(0))
+
+    def get_data(self) -> Table:
+        rng = np.random.default_rng(self.get_seed_or_default())
+        n = self.array_size
+        return Table.from_columns(
+            packedFeatures=rng.random((n, self.vector_dim)),
+            labels=np.floor(rng.random(n) * self.label_arity))
+
+
+@_register
 class KMeansModelDataGenerator(HasSeed, HasVectorDim, HasArraySize):
     """Random KMeans model data; arraySize = number of centroids
     (ref: datagenerator/clustering/KMeansModelDataGenerator.java)."""

@@ -39,6 +39,7 @@ RE_MEASURE = [
     "tokenizer-benchmark.json",
     "ngram-benchmark.json",
     "onlinelogisticregression-benchmark.json",
+    "knn-benchmark.json",
 ]
 
 
