@@ -144,3 +144,45 @@ def test_table_ids_are_unique():
     builder = GraphBuilder()
     ids = {builder.create_table_id() for _ in range(100)}
     assert len(ids) == 100
+
+
+def test_graph_sparse_text_chain(rng):
+    """A Graph whose edges carry a CSR column end to end: HashingTF ->
+    IDF -> LogisticRegression. Pins that the DAG executor passes
+    CsrVectorColumn tables between stages without densifying and the
+    final model predicts through the sparse path."""
+    from flink_ml_tpu.linalg.sparse import is_csr_column
+    from flink_ml_tpu.models.feature import IDF, HashingTF
+
+    words = np.asarray(["alpha", "beta", "gamma", "delta"])
+    docs = words[rng.integers(0, 4, (400, 6))]
+    label = (np.char.count(docs.astype(str), "alpha").sum(axis=1)
+             > 1).astype(np.float64)
+    t = Table.from_columns(doc=docs, label=label)
+
+    builder = GraphBuilder()
+    src = builder.create_table_id()
+    hashed = builder.add_algo_operator(
+        HashingTF(input_col="doc", output_col="tf", num_features=1 << 12),
+        [src])[0]
+    scored = builder.add_estimator(
+        IDF(input_col="tf", output_col="features"), [hashed])[0]
+    out = builder.add_estimator(
+        LogisticRegression(features_col="features", label_col="label",
+                           max_iter=25, global_batch_size=100,
+                           learning_rate=0.5),
+        [scored])[0]
+    graph = builder.build_estimator([src], [out])
+    model = graph.fit(t)
+    result = model.transform(t)[0]
+    acc = float(np.mean(result["prediction"] == label))
+    assert acc > 0.9, acc
+
+    # the intermediate representation stayed CSR
+    mid = IDF(input_col="tf", output_col="features").fit(
+        HashingTF(input_col="doc", output_col="tf",
+                  num_features=1 << 12).transform(t)[0])
+    assert is_csr_column(
+        mid.transform(HashingTF(input_col="doc", output_col="tf",
+                                num_features=1 << 12).transform(t)[0])[0]
+        .column("features"))
