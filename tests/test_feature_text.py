@@ -374,3 +374,24 @@ def test_normalizer_sparse_inf_norm():
     dense = out.column("n").to_dense()
     np.testing.assert_allclose(
         dense, [[0, 0.75, -1.0, 0], [0, 0, 0, 0], [1.0, 0, 0, 0]])
+
+
+def test_rowwise_counts_engines_agree(rng):
+    """The bincount and row-sort engines must produce identical
+    (row, value, count) triples across chunk boundaries, including
+    single-row, empty, and multi-chunk shapes."""
+    from flink_ml_tpu.models.feature.text import _rowwise_counts
+
+    for n, w, domain in ((1, 1, 1), (7, 3, 2), (1000, 17, 5),
+                         (333, 8, 1024)):
+        mat = rng.integers(0, domain, (n, w)).astype(np.int64)
+        a = _rowwise_counts(mat.copy(), domain=domain)      # bincount
+        b = _rowwise_counts(mat.copy(), domain=None)        # row sort
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x, np.int64),
+                                          np.asarray(y, np.int64))
+        a2 = _rowwise_counts(mat.copy(), with_counts=False, domain=domain)
+        assert a2[2] is None
+        np.testing.assert_array_equal(a2[0], a[0])
+        np.testing.assert_array_equal(np.asarray(a2[1], np.int64),
+                                      np.asarray(a[1], np.int64))
