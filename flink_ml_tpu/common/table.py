@@ -156,6 +156,11 @@ class Table:
         import csv as _csv
         names = self.column_names
         for name in names:
+            if _is_csr_column(self._columns[name]):
+                # rejected without materializing 10M SparseVector rows
+                raise ValueError(
+                    f"column {name!r} is not scalar; to_csv writes scalar "
+                    "columns only")
             col = self._host_column(name)
             if col.ndim != 1 or (
                     col.dtype == object and len(col)
