@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""CLI parity with the reference's bin/benchmark-results-visualize.py:
+    bin/benchmark-results-visualize.py results.json [--output-file chart.png]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from flink_ml_tpu.benchmark.visualize import main
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

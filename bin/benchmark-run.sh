@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# CLI parity with the reference's flink-ml-dist bin/benchmark-run.sh
+# (flink-ml-dist/src/main/flink-ml-bin/bin/benchmark-run.sh):
+#   bin/benchmark-run.sh <config.json> [--output-file results.json]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m flink_ml_tpu.benchmark.runner "$@"
