@@ -99,6 +99,23 @@ class ElementwiseProduct(Transformer, HasInputCol, HasOutputCol):
     def transform(self, table: Table) -> Tuple[Table]:
         if self.scaling_vec is None:
             raise ValueError("scalingVec must be set")
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        col = table.column(self.input_col)
+        if sp_mod.is_sparse_column(col):
+            # O(nnz): scale stored values by their coordinate's factor
+            import scipy.sparse as sp
+
+            m = sp_mod.column_to_csr(col)
+            s = self.scaling_vec.to_array()
+            if s.shape[0] != m.shape[1]:
+                raise ValueError(
+                    f"scalingVec has size {s.shape[0]}, input vectors "
+                    f"have size {m.shape[1]}")
+            out = sp.csr_matrix((m.data * s[m.indices], m.indices,
+                                 m.indptr), shape=m.shape)
+            return (table.with_column(self.output_col,
+                                      sp_mod.CsrVectorColumn(out)),)
         x = columnar.input_vectors(table, self.input_col)
         out = columnar.apply(_scale_kernel, x,
                              (self.scaling_vec.to_array(),), ())
@@ -377,6 +394,19 @@ class VectorSlicer(Transformer, HasInputCol, HasOutputCol):
         idx = np.asarray(self.indices, np.int64)
         if (idx < 0).any():
             raise ValueError("indices must be non-negative")
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        col = table.column(self.input_col)
+        if sp_mod.is_sparse_column(col):
+            m = sp_mod.column_to_csr(col)
+            if (idx >= m.shape[1]).any():
+                raise IndexError(
+                    f"indices {idx[idx >= m.shape[1]].tolist()} out of "
+                    f"range for vectors of size {m.shape[1]}")
+            # scipy column selection keeps CSR; O(nnz of the slice)
+            return (table.with_column(
+                self.output_col,
+                sp_mod.CsrVectorColumn(m[:, idx].tocsr())),)
         x = columnar.input_vectors(table, self.input_col)
         if (idx >= x.shape[1]).any():  # device gather clamps; check on host
             raise IndexError(
@@ -403,11 +433,32 @@ class Binarizer(Transformer, HasInputCols, HasOutputCols):
         if self.thresholds is None or \
                 len(self.thresholds) != len(self.input_cols):
             raise ValueError("thresholds must match inputCols length")
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
         out = {}
         for name, out_name, thr in zip(self.input_cols, self.output_cols,
                                        self.thresholds):
             col = table.column(name)
-            if columnar.is_device_array(col):
+            if sp_mod.is_sparse_column(col) and float(thr) >= 0.0:
+                # implicit zeros stay 0 (0 > thr is false for thr >= 0):
+                # sparse in, sparse out, O(nnz). Negative thresholds turn
+                # zeros into ones — inherently dense, handled below.
+                import scipy.sparse as sp
+
+                m = sp_mod.column_to_csr(col)
+                keep = m.data > float(thr)
+                # drop failing entries instead of storing explicit zeros
+                # (output nnz = number of ones, not input nnz); built
+                # fresh — never mutate buffers shared with the input
+                kept_cumsum = np.concatenate(
+                    ([0], np.cumsum(keep, dtype=np.int64)))
+                out[out_name] = sp_mod.CsrVectorColumn(sp.csr_matrix(
+                    (np.ones(int(kept_cumsum[-1])), m.indices[keep],
+                     kept_cumsum[m.indptr]), shape=m.shape))
+                continue
+            if sp_mod.is_sparse_column(col):
+                x = sp_mod.column_to_csr(col).toarray()
+            elif columnar.is_device_array(col):
                 x = col  # keep its rank: scalar columns stay 1-D
             elif col.dtype == object or col.ndim == 2:
                 x = columnar.input_vectors(table, name)

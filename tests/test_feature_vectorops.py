@@ -231,3 +231,60 @@ def test_interaction_sparse_matches_dense_oracle():
               * dense_a[:, None, :, None]
               * dense_b[:, None, None, :]).reshape(n, -1)
     np.testing.assert_allclose(o.to_dense(), expect, rtol=1e-12)
+
+
+def test_sparse_preserving_elementwise_slicer_binarizer(rng):
+    """ElementwiseProduct, VectorSlicer and Binarizer (threshold >= 0)
+    must keep CSR input sparse and match the dense oracle; Binarizer with
+    a negative threshold densifies (zeros become ones)."""
+    from flink_ml_tpu.linalg.sparse import is_csr_column
+    from flink_ml_tpu.linalg.vectors import SparseVector
+
+    n, d = 40, 6
+    dense = np.where(rng.random((n, d)) < 0.4, rng.normal(size=(n, d)), 0.0)
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        nz = np.nonzero(dense[i])[0]
+        col[i] = SparseVector(d, nz, dense[i, nz])
+    t = Table.from_columns(v=col)
+
+    scale = Vectors.dense(np.arange(1.0, d + 1.0))
+    ew = ElementwiseProduct(input_col="v", output_col="o", scaling_vec=scale)
+    o = ew.transform(t)[0].column("o")
+    assert is_csr_column(o)
+    np.testing.assert_allclose(o.to_dense(), dense * np.arange(1.0, d + 1.0),
+                               rtol=1e-12)
+
+    vs = VectorSlicer(input_col="v", output_col="o", indices=[4, 1])
+    o = vs.transform(t)[0].column("o")
+    assert is_csr_column(o)
+    np.testing.assert_allclose(o.to_dense(), dense[:, [4, 1]], rtol=1e-12)
+
+    b = Binarizer(input_cols=["v"], output_cols=["o"], thresholds=[0.1])
+    o = b.transform(t)[0].column("o")
+    assert is_csr_column(o)
+    np.testing.assert_allclose(o.to_dense(), (dense > 0.1).astype(float))
+
+    bneg = Binarizer(input_cols=["v"], output_cols=["o"], thresholds=[-0.5])
+    o = bneg.transform(t)[0].column("o")
+    assert not is_csr_column(o)  # zeros become ones: dense by necessity
+    np.testing.assert_allclose(np.asarray(o), (dense > -0.5).astype(float))
+
+
+def test_sparse_binarizer_prunes_and_elementwise_validates(rng):
+    from flink_ml_tpu.linalg.vectors import SparseVector
+
+    col = np.empty(2, dtype=object)
+    col[0] = SparseVector(4, [0, 2], [0.05, 0.9])
+    col[1] = SparseVector(4, [1], [0.01])
+    t = Table.from_columns(v=col)
+    o = Binarizer(input_cols=["v"], output_cols=["o"],
+                  thresholds=[0.1]).transform(t)[0].column("o")
+    assert o.to_csr().nnz == 1  # failing entries pruned, not stored zeros
+    np.testing.assert_allclose(o.to_dense(),
+                               [[0, 0, 1, 0], [0, 0, 0, 0]])
+
+    with pytest.raises(ValueError, match="size"):
+        ElementwiseProduct(input_col="v", output_col="o",
+                           scaling_vec=Vectors.dense([1.0, 2.0])
+                           ).transform(t)
