@@ -1,0 +1,64 @@
+"""Wait for the axon TPU tunnel to come back, then run the TPU
+re-measurement pass (scripts/remeasure_r3b.py).
+
+Tunnel discipline (learned the hard way; see bench.py's docstring):
+- probe in a SUBPROCESS and never kill an in-flight probe — killing a
+  claimant wedges the lease for up to hours;
+- a probe that fails fast is respawned after a backoff;
+- outages can last 7+ hours, so the default budget is long.
+
+Run: python scripts/tpu_wait_and_remeasure.py [budget_seconds]
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+PROBE = ("import jax; jax.numpy.ones((128,128)).sum().block_until_ready(); "
+         "print('BACKEND_OK', jax.default_backend())")
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def wait_backend(deadline: float) -> bool:
+    proc = None
+    while time.monotonic() < deadline:
+        if proc is None:
+            proc = subprocess.Popen([sys.executable, "-c", PROBE],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.DEVNULL)
+        rc = proc.poll()
+        if rc is None:
+            time.sleep(20)
+            continue
+        out = proc.stdout.read() or b""
+        if rc == 0 and b"BACKEND_OK" in out and b"cpu" not in out:
+            return True
+        proc = None  # fast failure: back off, respawn
+        time.sleep(45)
+    return False
+
+
+def main() -> int:
+    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 21600.0
+    deadline = time.monotonic() + budget
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        print(f"attempt {attempt}: waiting for backend...", flush=True)
+        if not wait_backend(deadline):
+            print("backend never came up within budget", flush=True)
+            return 1
+        print(f"attempt {attempt}: backend live, measuring", flush=True)
+        rc = subprocess.call(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "remeasure_r3b.py")])
+        print(f"attempt {attempt}: remeasure rc={rc}", flush=True)
+        if rc == 0:
+            return 0
+        time.sleep(90)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
