@@ -63,9 +63,27 @@ class _VectorStatModelBase(Model, HasInputCol, HasOutputCol):
         """→ (replicated stat operands, static jit args)."""
         raise NotImplementedError
 
+    def _sparse_supported(self) -> bool:
+        """Whether the CONFIGURED op is sparsity-preserving — consulted
+        before any CSR conversion so unsupported cases (e.g. mean
+        centering maps implicit zeros off zero) pay no wasted pass."""
+        return False
+
+    def _sparse_apply(self, m):
+        """O(nnz) CSR transform (only called when _sparse_supported());
+        must return a NEW scipy CSR — never alias the input's data."""
+        raise NotImplementedError
+
     def transform(self, table: Table) -> Tuple[Table]:
         if getattr(self, self.STAT_NAMES[0]) is None:
             raise ValueError(f"{type(self).__name__} has no model data")
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        col = table.column(self.input_col)
+        if self._sparse_supported() and sp_mod.is_sparse_column(col):
+            out_m = self._sparse_apply(sp_mod.column_to_csr(col))
+            return (table.with_column(
+                self.output_col, sp_mod.CsrVectorColumn(out_m)),)
         x = columnar.input_vectors(table, self.input_col)
         consts, static = self._kernel_args()
         out = columnar.apply(type(self)._kernel, x, consts, static)
@@ -118,6 +136,19 @@ class StandardScalerModel(_VectorStatModelBase, StandardScalerParams):
         return ((self.mean, self.std),
                 (bool(self.with_mean), bool(self.with_std)))
 
+    def _sparse_supported(self) -> bool:
+        return not self.with_mean  # centering densifies by necessity
+
+    def _sparse_apply(self, m):
+        import scipy.sparse as sp
+
+        if self.with_std:
+            std = np.where(self.std > 0, self.std, 1.0)
+            data = m.data / std[m.indices]
+        else:
+            data = m.data.copy()  # never alias the input column's values
+        return sp.csr_matrix((data, m.indices, m.indptr), shape=m.shape)
+
 
 def _mean_varsum_kernel(x):
     """(2, d): per-dim mean and centered sum of squares — the two-pass
@@ -130,7 +161,21 @@ def _mean_varsum_kernel(x):
 def mean_and_std(table, input_col):
     """Per-dimension (mean, unbiased std) — ON device for device-resident
     columns (no table off-ramp); the float64 host branch keeps the
-    reference's exact Σx²−n·mean² formula (StandardScaler.java:119-131)."""
+    reference's exact Σx²−n·mean² formula (StandardScaler.java:119-131).
+    Sparse columns reduce over stored values, O(nnz), never densified."""
+    from flink_ml_tpu.linalg import sparse as sp_mod
+
+    col = table.column(input_col)
+    if sp_mod.is_sparse_column(col):
+        m = sp_mod.column_to_csr(col)
+        n = m.shape[0]
+        mean = np.asarray(m.sum(axis=0)).ravel() / max(n, 1)
+        if n > 1:
+            sq = np.asarray(m.multiply(m).sum(axis=0)).ravel()
+            std = np.sqrt(np.maximum((sq - n * mean * mean) / (n - 1), 0.0))
+        else:
+            std = np.zeros_like(mean)
+        return mean, std
     x, xp = columnar.fit_vectors(table, input_col)
     n = x.shape[0]
     if xp is jnp:
@@ -189,6 +234,16 @@ def _minmax_kernel(x):
 
 class MinMaxScaler(Estimator, MinMaxScalerParams):
     def fit(self, table: Table) -> MinMaxScalerModel:
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        col = table.column(self.input_col)
+        if sp_mod.is_sparse_column(col):
+            # scipy's sparse min/max include implicit zeros, O(nnz)
+            m = sp_mod.column_to_csr(col)
+            model = MinMaxScalerModel(
+                data_min=np.asarray(m.min(axis=0).todense()).ravel(),
+                data_max=np.asarray(m.max(axis=0).todense()).ravel())
+            return self.copy_params_to(model)
         x, xp = columnar.fit_vectors(table, self.input_col)
         if xp is jnp:
             lo_hi = np.asarray(columnar.apply(_minmax_kernel, x),
@@ -218,6 +273,16 @@ class MaxAbsScalerModel(_VectorStatModelBase, MaxAbsScalerParams):
     def _kernel_args(self):
         return ((self.max_abs,), ())
 
+    def _sparse_supported(self) -> bool:
+        return True
+
+    def _sparse_apply(self, m):
+        import scipy.sparse as sp
+
+        scale = np.where(self.max_abs > 0, self.max_abs, 1.0)
+        return sp.csr_matrix((m.data / scale[m.indices], m.indices,
+                              m.indptr), shape=m.shape)
+
 
 def _maxabs_kernel(x):
     return jnp.max(jnp.abs(x), axis=0)
@@ -225,6 +290,14 @@ def _maxabs_kernel(x):
 
 class MaxAbsScaler(Estimator, MaxAbsScalerParams):
     def fit(self, table: Table) -> MaxAbsScalerModel:
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        col = table.column(self.input_col)
+        if sp_mod.is_sparse_column(col):
+            # |x| >= 0, so the stored-value max IS the column max, O(nnz)
+            m = sp_mod.column_to_csr(col)
+            max_abs = np.asarray(abs(m).max(axis=0).todense()).ravel()
+            return self.copy_params_to(MaxAbsScalerModel(max_abs=max_abs))
         x, xp = columnar.fit_vectors(table, self.input_col)
         max_abs = (np.asarray(columnar.apply(_maxabs_kernel, x), np.float64)
                    if xp is jnp else np.abs(x).max(axis=0))

@@ -203,3 +203,56 @@ def test_device_resident_fit_stats_match_host(rng):
         input_col="input", output_col="o",
         variance_threshold=4.0).fit(t_dev)
     np.testing.assert_array_equal(sel_h.indices, sel_d.indices)
+
+
+def test_scalers_sparse_paths_match_dense(rng):
+    """MaxAbsScaler (fit+transform), StandardScaler (fit; std-only
+    transform) and MinMaxScaler (fit) on CSR input must match their dense
+    results, O(nnz), and only densify when the math demands it (mean
+    centering; min-max offset)."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.linalg.sparse import is_csr_column
+    from flink_ml_tpu.linalg.vectors import SparseVector
+    from flink_ml_tpu.models.feature import (
+        MaxAbsScaler,
+        MinMaxScaler,
+        StandardScaler,
+    )
+
+    n, d = 60, 5
+    dense = np.where(rng.random((n, d)) < 0.5, rng.normal(size=(n, d)), 0.0)
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        nz = np.nonzero(dense[i])[0]
+        col[i] = SparseVector(d, nz, dense[i, nz])
+    t_sparse = Table.from_columns(v=col)
+    t_dense = Table.from_columns(v=dense)
+
+    ms = MaxAbsScaler(input_col="v", output_col="o").fit(t_sparse)
+    md = MaxAbsScaler(input_col="v", output_col="o").fit(t_dense)
+    np.testing.assert_allclose(ms.max_abs, md.max_abs, rtol=1e-6)
+    o = ms.transform(t_sparse)[0].column("o")
+    assert is_csr_column(o)
+    np.testing.assert_allclose(
+        o.to_dense(), np.asarray(md.transform(t_dense)[0].column("o")),
+        rtol=1e-5, atol=1e-7)
+
+    ss = StandardScaler(input_col="v", output_col="o").fit(t_sparse)
+    sd = StandardScaler(input_col="v", output_col="o").fit(t_dense)
+    np.testing.assert_allclose(ss.mean, sd.mean, rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(ss.std, sd.std, rtol=1e-9, atol=1e-12)
+    o = ss.transform(t_sparse)[0].column("o")
+    assert is_csr_column(o)  # with_mean=False default: stays sparse
+    np.testing.assert_allclose(
+        o.to_dense(), np.asarray(sd.transform(t_dense)[0].column("o")),
+        rtol=1e-5, atol=1e-6)
+    ss.set(StandardScaler.WITH_MEAN, True)
+    o = ss.transform(t_sparse)[0].column("o")
+    assert not is_csr_column(o)  # centering densifies by necessity
+
+    mm = MinMaxScaler(input_col="v", output_col="o").fit(t_sparse)
+    mmd = MinMaxScaler(input_col="v", output_col="o").fit(t_dense)
+    np.testing.assert_allclose(mm.data_min, mmd.data_min, rtol=1e-6)
+    np.testing.assert_allclose(mm.data_max, mmd.data_max, rtol=1e-6)
