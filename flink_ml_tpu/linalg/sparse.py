@@ -114,6 +114,23 @@ def is_csr_column(col) -> bool:
     return getattr(col, "is_csr_vector_column", False)
 
 
+def column_moments(m):
+    """Per-column (mean, centered sum of squares, stored-count) of a CSR
+    matrix in O(nnz), TWO-PASS (cancellation-stable): implicit zeros
+    contribute (n − nnz_col)·mean² to the centered sum. Callers needing
+    the reference's one-pass Σx²−n·mean² parity (StandardScaler) should
+    NOT use this — that formula is a documented parity choice, this one
+    is the numerically stable default."""
+    n = m.shape[0]
+    mean = np.asarray(m.sum(axis=0)).ravel() / max(n, 1)
+    centered = m.data - mean[m.indices]
+    nnz_col = np.asarray(m.getnnz(axis=0)).ravel()
+    varsum = (np.bincount(m.indices, weights=centered * centered,
+                          minlength=m.shape[1])
+              + (n - nnz_col) * mean * mean)
+    return mean, varsum, nnz_col
+
+
 def build_csr_column(n: int, size: int, sorted_row_ids, col_idx,
                      values) -> CsrVectorColumn:
     """Row-major (row, column, value) triples → a CSR-backed column.

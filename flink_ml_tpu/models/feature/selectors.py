@@ -47,6 +47,19 @@ class _IndexSelectorModelBase(Model):
     def transform(self, table: Table) -> Tuple[Table]:
         if self.indices is None:
             raise ValueError(f"{type(self).__name__} has no model data")
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        col = table.column(self._in_col)
+        if sp_mod.is_sparse_column(col):
+            # column selection keeps CSR, O(nnz of the slice)
+            m = sp_mod.column_to_csr(col)
+            if len(self.indices) and int(self.indices[-1]) >= m.shape[1]:
+                raise IndexError(
+                    f"selected index {int(self.indices[-1])} out of range "
+                    f"for vectors of size {m.shape[1]}")
+            return (table.with_column(
+                self._out_col,
+                sp_mod.CsrVectorColumn(m[:, self.indices].tocsr())),)
         from flink_ml_tpu.models.feature.vectorops import _gather_cols_kernel
         from flink_ml_tpu.ops import columnar
         x = columnar.input_vectors(table, self._in_col)
@@ -199,6 +212,24 @@ class VarianceThresholdSelector(Estimator, VarianceThresholdSelectorParams):
     def fit(self, table: Table) -> VarianceThresholdSelectorModel:
         from flink_ml_tpu.models.feature.scalers import _mean_varsum_kernel
         from flink_ml_tpu.ops import columnar
+
+        from flink_ml_tpu.linalg import sparse as sp_mod
+
+        col = table.column(self.input_col)
+        if sp_mod.is_sparse_column(col):
+            # O(nnz) TWO-PASS sample variance (the stability invariant of
+            # this fit, see the comment below — not the reference's
+            # one-pass parity form StandardScaler keeps)
+            m = sp_mod.column_to_csr(col)
+            n = m.shape[0]
+            if n > 1:
+                _, varsum, _ = sp_mod.column_moments(m)
+                variances = varsum / (n - 1)
+            else:
+                variances = np.zeros(m.shape[1])
+            indices = np.nonzero(variances > self.variance_threshold)[0]
+            return self.copy_params_to(
+                VarianceThresholdSelectorModel(indices=indices))
 
         # two-pass variance on BOTH paths (cancellation-stable; the host
         # Σx²−n·mean² form belongs to StandardScaler's reference-formula

@@ -256,3 +256,62 @@ def test_scalers_sparse_paths_match_dense(rng):
     mmd = MinMaxScaler(input_col="v", output_col="o").fit(t_dense)
     np.testing.assert_allclose(mm.data_min, mmd.data_min, rtol=1e-6)
     np.testing.assert_allclose(mm.data_max, mmd.data_max, rtol=1e-6)
+
+
+def test_selectors_sparse_paths_match_dense(rng):
+    """VarianceThresholdSelector fit and the index-selector transforms on
+    CSR input must match the dense path and keep the output sparse."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.linalg.sparse import is_csr_column
+    from flink_ml_tpu.linalg.vectors import SparseVector
+    from flink_ml_tpu.models.feature import VarianceThresholdSelector
+
+    n, d = 80, 6
+    dense = np.where(rng.random((n, d)) < 0.5, rng.normal(size=(n, d)), 0.0)
+    dense[:, 3] = 0.0  # zero-variance dim must be dropped on both paths
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        nz = np.nonzero(dense[i])[0]
+        col[i] = SparseVector(d, nz, dense[i, nz])
+    t_sparse = Table.from_columns(v=col)
+    t_dense = Table.from_columns(v=dense)
+
+    sel = dict(input_col="v", output_col="o", variance_threshold=0.05)
+    ms = VarianceThresholdSelector(**sel).fit(t_sparse)
+    md = VarianceThresholdSelector(**sel).fit(t_dense)
+    np.testing.assert_array_equal(ms.indices, md.indices)
+    assert 3 not in ms.indices
+
+    o = ms.transform(t_sparse)[0].column("o")
+    assert is_csr_column(o)
+    np.testing.assert_allclose(
+        o.to_dense(), np.asarray(md.transform(t_dense)[0].column("o")),
+        rtol=1e-5, atol=1e-7)
+
+
+def test_variance_selector_sparse_large_offset_stability(rng):
+    """The sparse variance must be two-pass stable: stored values at a
+    large offset (1e9 + noise, true variance ~1) must not cancel to zero
+    — both paths must keep the feature."""
+    import numpy as np
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.linalg.vectors import SparseVector
+    from flink_ml_tpu.models.feature import VarianceThresholdSelector
+
+    n, d = 200, 2
+    dense = np.zeros((n, d))
+    dense[:, 0] = 1e9 + rng.normal(size=n)      # huge offset, var ~ 1
+    dense[::2, 1] = rng.normal(size=n // 2) * 3  # half-sparse dim
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        nz = np.nonzero(dense[i])[0]
+        col[i] = SparseVector(d, nz, dense[i, nz])
+
+    sel = dict(input_col="v", output_col="o", variance_threshold=0.5)
+    ms = VarianceThresholdSelector(**sel).fit(Table.from_columns(v=col))
+    md = VarianceThresholdSelector(**sel).fit(Table.from_columns(v=dense))
+    np.testing.assert_array_equal(ms.indices, md.indices)
+    assert 0 in ms.indices
