@@ -1,0 +1,161 @@
+"""Fork-based row-shard parallelism for host-bound (string/sparse) ops.
+
+The reference runs every string-tier op on ``defaultParallelism`` Flink
+subtasks with per-subtask partial maps merged by a reduce step (ref:
+flink-ml-lib/src/main/java/org/apache/flink/ml/feature/stringindexer/
+StringIndexer.java:117-142 — per-task counts, DataStreamUtils.reduce
+merge).  Our host tier is vectorized numpy, but single-process; this
+module supplies the missing fan-out: split the row range into shards,
+fork a worker per shard, merge the per-shard results in the parent.
+
+Why raw ``os.fork`` and not multiprocessing:
+
+- **Zero-copy scatter.** Workers read the input arrays through
+  copy-on-write fork pages — a 10M×100 token matrix is never pickled or
+  copied out.  Only the (much smaller) per-shard results travel back,
+  over a pipe.
+- **No interpreter teardown in the child.** Children exit with
+  ``os._exit``, skipping atexit handlers.  This matters: the parent may
+  hold a live TPU client (axon tunnel) whose state a forked child's
+  normal interpreter exit could disturb.  Workers must therefore touch
+  ONLY host numpy — never jax.
+- **No pool daemon threads** in the parent that could interact badly
+  with XLA's own thread pools.
+
+Failure semantics: any worker that dies (non-zero exit, unpicklable
+result, crash) fails the whole map with the worker's traceback; callers
+fall back to their serial path only via ``min_rows`` gating, never on
+silent partial results.
+"""
+
+import io
+import os
+import pickle
+import struct
+import sys
+import traceback
+
+import numpy as np
+
+__all__ = ["host_parallelism", "map_row_shards", "shard_bounds"]
+
+#: result-stream framing: u8 status (0 ok / 1 error), u64 payload length
+_HDR = struct.Struct("<BQ")
+
+
+def host_parallelism() -> int:
+    """Worker count for host-bound fan-out.  Defaults to the reference's
+    benchmark parallelism (8) capped by the machine; override with
+    FLINK_ML_TPU_HOST_PARALLELISM (0 or 1 disables forking)."""
+    env = os.environ.get("FLINK_ML_TPU_HOST_PARALLELISM")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+def shard_bounds(n_rows: int, workers: int):
+    """Even [lo, hi) row ranges, first shards taking the remainder."""
+    base, rem = divmod(n_rows, workers)
+    bounds, lo = [], 0
+    for i in range(workers):
+        hi = lo + base + (1 if i < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def _child_main(fn, lo, hi, wfd):
+    status, payload = 0, None
+    try:
+        payload = pickle.dumps(fn(lo, hi), protocol=pickle.HIGHEST_PROTOCOL)
+    except BaseException:  # noqa: BLE001 — report the traceback, then _exit
+        status = 1
+        payload = traceback.format_exc().encode("utf-8", "replace")
+    try:
+        with io.FileIO(wfd, "w") as f:
+            f.write(_HDR.pack(status, len(payload)))
+            f.write(payload)
+            f.flush()
+    finally:
+        os._exit(status)
+
+
+def map_row_shards(fn, n_rows: int, *, workers: int = None,
+                   min_rows: int = 1 << 17):
+    """Run ``fn(lo, hi)`` over even row shards of ``[0, n_rows)`` in
+    forked workers; return the per-shard results in shard order.
+
+    ``fn`` must be host-numpy only (no jax — see module docstring) and
+    close over whatever input arrays it needs; fork shares them
+    copy-on-write.  Small inputs (below ``min_rows``), a single worker,
+    or a platform without fork all run ``fn(0, n_rows)`` inline — so
+    callers need exactly one code path.
+    """
+    workers = host_parallelism() if workers is None else workers
+    if (workers <= 1 or n_rows < max(min_rows, 2)
+            or not hasattr(os, "fork")):
+        return [fn(0, n_rows)]
+    workers = min(workers, max(1, n_rows // max(1, min_rows // 2)))
+
+    shards = shard_bounds(n_rows, workers)
+    pids, rfds = [], []
+    reaped = set()
+    try:
+        for lo, hi in shards:
+            rfd, wfd = os.pipe()
+            pid = os.fork()
+            if pid == 0:  # child: never returns
+                os.close(rfd)
+                for other in rfds:
+                    os.close(other)
+                _child_main(fn, lo, hi, wfd)
+            os.close(wfd)
+            pids.append(pid)
+            rfds.append(rfd)
+
+        results = []
+        for i, (pid, rfd) in enumerate(zip(pids, rfds)):
+            with io.FileIO(rfd, "r") as f:
+                rfds[i] = None  # FileIO owns (and closes) the fd now
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    os.waitpid(pid, 0)
+                    raise RuntimeError(
+                        "host-pool worker died before reporting a result")
+                status, length = _HDR.unpack(hdr)
+                chunks, got = [], 0
+                while got < length:
+                    chunk = f.read(min(1 << 24, length - got))
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+                    got += len(chunk)
+            os.waitpid(pid, 0)
+            reaped.add(pid)
+            payload = b"".join(chunks)
+            if status != 0:
+                raise RuntimeError("host-pool worker failed:\n"
+                                   + payload.decode("utf-8", "replace"))
+            if got < length:
+                raise RuntimeError("host-pool worker result truncated")
+            results.append(pickle.loads(payload))
+        return results
+    finally:
+        # close pipes first (a worker blocked on a full pipe gets EPIPE
+        # and exits), then reap every un-waited child so an error path
+        # leaves no zombies behind
+        for rfd in rfds:
+            if rfd is not None:
+                try:
+                    os.close(rfd)
+                except OSError:
+                    pass
+        for pid in pids:
+            if pid not in reaped:
+                try:
+                    os.waitpid(pid, 0)
+                except ChildProcessError:
+                    pass

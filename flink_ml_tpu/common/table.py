@@ -259,7 +259,9 @@ class Table:
         if set(self.column_names) != set(other.column_names):
             raise ValueError("cannot concat tables with different schemas")
         if self._num_rows == 0:
-            return other  # also sidesteps representation mismatch vs empty
+            # keep self's column ordering (cheap dict re-keying); also
+            # sidesteps representation mismatch vs empty columns
+            return Table({n: other.column(n) for n in self.column_names})
         if other.num_rows == 0:
             return self
 

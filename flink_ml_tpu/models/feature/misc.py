@@ -333,6 +333,11 @@ class _SqlVectorEval:
             w = self._unary()
             if op == "*":
                 v = v * w
+            elif self._both_int(v, w) and np.any(np.asarray(w) == 0):
+                # sqlite yields NULL on integer div/mod by zero; numpy
+                # floor_divide yields 0 — route to the sqlite fallback
+                # rather than silently diverge
+                raise self.Unsupported("integer division by zero")
             elif self._both_int(v, w):
                 # sqlite integer semantics: division and remainder
                 # truncate toward zero (numpy's floor/floor-sign differ

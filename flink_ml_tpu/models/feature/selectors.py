@@ -53,9 +53,10 @@ class _IndexSelectorModelBase(Model):
         if sp_mod.is_sparse_column(col):
             # column selection keeps CSR, O(nnz of the slice)
             m = sp_mod.column_to_csr(col)
-            if len(self.indices) and int(self.indices[-1]) >= m.shape[1]:
+            # max(), not [-1]: set_model_data may receive unsorted indices
+            if len(self.indices) and int(self.indices.max()) >= m.shape[1]:
                 raise IndexError(
-                    f"selected index {int(self.indices[-1])} out of range "
+                    f"selected index {int(self.indices.max())} out of range "
                     f"for vectors of size {m.shape[1]}")
             return (table.with_column(
                 self._out_col,
@@ -63,9 +64,9 @@ class _IndexSelectorModelBase(Model):
         from flink_ml_tpu.models.feature.vectorops import _gather_cols_kernel
         from flink_ml_tpu.ops import columnar
         x = columnar.input_vectors(table, self._in_col)
-        if len(self.indices) and int(self.indices[-1]) >= x.shape[1]:
+        if len(self.indices) and int(self.indices.max()) >= x.shape[1]:
             raise IndexError(  # device gather clamps instead of raising
-                f"selected index {int(self.indices[-1])} out of range for "
+                f"selected index {int(self.indices.max())} out of range for "
                 f"vectors of size {x.shape[1]}")
         out = columnar.apply(_gather_cols_kernel, x, (),
                              (tuple(int(i) for i in self.indices),))

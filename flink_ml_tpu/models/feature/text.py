@@ -128,6 +128,11 @@ def _token_codes(col: np.ndarray):
             inv, uniq_v = pd.factorize(view, sort=False)
             inv = np.asarray(inv, np.int64)
             uniq_v = np.asarray(uniq_v)
+            if uniq_v.dtype != view.dtype:
+                # a pandas upcast (e.g. int32→int64) would make the
+                # .view(flat.dtype) below produce garbage tokens — fail
+                # safe onto the sort-based engine instead
+                uniq_v, inv = np.unique(view, return_inverse=True)
         except ImportError:
             uniq_v, inv = np.unique(view, return_inverse=True)
     else:  # longer tokens: struct of int32 fields, memcmp-style sort
@@ -147,6 +152,11 @@ def _rowwise_counts(mat: np.ndarray, with_counts: bool = True,
     argsort dominated the 1e9-token transforms. Returns (row_of, value,
     count) with rows ascending and values ascending within each row
     (CSR-canonical order); count is None with ``with_counts=False``.
+
+    IN-PLACE CONTRACT: the row-sort engine sorts ``mat``'s row chunks in
+    place, so callers must pass an owned buffer whose row order they do
+    not rely on afterwards (per-row multisets are preserved; within-row
+    order is not). Pass ``mat.copy()`` to keep the original intact.
 
     Two engines, both processing bounded ROW CHUNKS (one giant pass
     thrashes the allocator — a single 8 GB sort measured ~15x slower than
@@ -364,6 +374,34 @@ class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
             token = token.replace("İ", "i").replace("I", "ı")
         return token.lower()
 
+    @classmethod
+    def _allowed_first_cps(cls, stop, locale: str, case_sensitive: bool):
+        """BMP code points a token may START with and still possibly be a
+        stop word — the prefilter domain for :meth:`transform`'s
+        first-character screen.  Computed by inverting the fold over the
+        whole BMP (one 65k scan, cached per stop set): cp is allowed iff
+        fold(chr(cp)) begins with the first char of some stop word.
+        Astral first chars (>0xFFFF) are handled conservatively by the
+        caller (always candidates)."""
+        key = (frozenset(stop), locale if not case_sensitive else None)
+        cached = cls._ALLOWED_CACHE.get(key)
+        if cached is not None:
+            return cached
+        firsts = {w[0] for w in stop if w}
+        if case_sensitive:
+            cps = sorted(ord(c) for c in firsts)
+        else:
+            cps = sorted(
+                cp for cp in range(0x10000)
+                if (cls._fold(chr(cp), locale) or "\0")[0] in firsts)
+        if "" in stop:  # '' tokens are all-zero '<U' buffers (first cp 0)
+            cps = sorted(set(cps) | {0})
+        allowed = np.array(cps, np.int32)
+        cls._ALLOWED_CACHE[key] = allowed
+        return allowed
+
+    _ALLOWED_CACHE: dict = {}
+
     def transform(self, table: Table) -> Tuple[Table]:
         if self.case_sensitive:
             stop = set(self.stop_words)
@@ -376,12 +414,32 @@ class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
         for name, out_name in zip(self.input_cols, self.output_cols):
             col = table.column(name)
             out = np.empty(len(col), dtype=object)
-            if _is_token_matrix(col):
-                # vectorized: fold every distinct token once, mask by isin
-                uniq, codes = _token_codes(col)
-                folded = (uniq if self.case_sensitive else np.array(
-                    [self._fold(str(t), self.locale) for t in uniq]))
-                keep_flat = ~np.isin(folded, np.array(sorted(stop)))[codes]
+            if _is_token_matrix(col) and col.dtype.itemsize % 4 == 0 \
+                    and col.dtype.itemsize > 0:
+                # first-character screen: a token can only be a stop word
+                # if its first code point folds onto some stop word's
+                # first char.  One int32 pass over the raw '<U' buffer
+                # finds the candidate tokens; only those pay the
+                # fold-and-compare.  A corpus with no candidates (e.g.
+                # numeric-string tokens) is an O(n) identity.
+                n_r, w_r = col.shape
+                nints = col.dtype.itemsize // 4
+                first = col.view("<i4").reshape(n_r, w_r, nints)[:, :, 0]
+                allowed = self._allowed_first_cps(
+                    stop, self.locale, self.case_sensitive)
+                cand = np.isin(first, allowed) | (first > 0xFFFF)
+                cand_flat = cand.reshape(-1)
+                if not cand_flat.any():
+                    outs[out_name] = col
+                    continue
+                # fold/compare ONLY the candidate tokens, per distinct
+                cand_tokens = col.reshape(-1)[cand_flat]
+                cu, cc = _token_codes(cand_tokens)
+                cfold = (cu if self.case_sensitive else np.array(
+                    [self._fold(str(t), self.locale) for t in cu]))
+                is_stop = np.isin(cfold, np.array(sorted(stop)))[cc]
+                keep_flat = np.ones(n_r * w_r, np.bool_)
+                keep_flat[cand_flat] = ~is_stop
                 if keep_flat.all():
                     # nothing filtered: the input token matrix IS the
                     # output (the benchmark corpus of numeric-string
@@ -573,6 +631,54 @@ class CountVectorizerParams(CountVectorizerModelParams):
         "appear in to be included.", 2 ** 63 - 1, ParamValidators.gt_eq(0.0))
 
 
+def _device_token_counts(ids1: np.ndarray, u: int, min_tf: float,
+                         binary: bool, w: int):
+    """TPU-native CountVectorizer transform: per-row token counts as ONE
+    jitted scatter-add into an (n, u+1) count matrix (slot 0 = OOV,
+    sliced off), minTF threshold and the binary flag fused in.  The
+    (n, w) vocab-id matrix travels H2D in the narrowest integer dtype
+    that fits; the dense f32 count column STAYS on device for downstream
+    stages (module residency policy — columnar.py).  Used when the vocab
+    is small enough that dense (n, u) is the natural TPU layout; the CSR
+    host path handles large vocabularies."""
+    from flink_ml_tpu.ops import columnar
+
+    return columnar.apply(_token_count_kernel, ids1, (),
+                          (u, float(min_tf), bool(binary), w))
+
+
+def _token_count_kernel(ids1, u, min_tf, binary, w):
+    import math
+
+    import jax.numpy as jnp
+
+    n = ids1.shape[0]
+    counts = jnp.zeros((n, u + 1), jnp.float32)
+    counts = counts.at[
+        jnp.arange(n, dtype=jnp.int32)[:, None], ids1].add(1.0)
+    counts = counts[:, 1:]
+    # counts are integers, so the float64 host comparison
+    # `count >= thr` (text.py host CSR path) is exactly
+    # `count >= ceil(thr)` — an integer threshold the f32 compare
+    # cannot round differently at count boundaries
+    thr = math.ceil(min_tf if min_tf >= 1.0 else min_tf * w)
+    keep = counts >= thr
+    return jnp.where(keep, 1.0, 0.0) if binary \
+        else jnp.where(keep, counts, 0.0)
+
+
+#: dense device-count budget: above this many output bytes the transform
+#: keeps the host CSR path (sparse is the right layout for big vocabs)
+_DENSE_COUNTS_MAX_BYTES = 4 << 30
+
+
+def _dense_counts_budget() -> int:
+    import os
+
+    env = os.environ.get("FLINK_ML_TPU_DENSE_COUNTS_MAX_BYTES")
+    return int(env) if env else _DENSE_COUNTS_MAX_BYTES
+
+
 class CountVectorizerModel(Model, CountVectorizerModelParams):
     def __init__(self, vocabulary=None, **kwargs):
         super().__init__(**kwargs)
@@ -592,6 +698,17 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
             uniq, codes = _token_codes(col)
             vocab_ids = np.fromiter((index.get(str(t), -1) for t in uniq),
                                     np.int64, len(uniq))
+            w = col.shape[1]
+            if (size + 1 < (1 << 16)
+                    and n * size * 4 <= _dense_counts_budget()):
+                # small vocab → dense (n, size) f32 counts ON DEVICE
+                # (deviation doc: device tier emits a dense device column
+                # where the reference emits SparseVector)
+                dt = np.uint8 if size + 1 <= 0xFF else np.uint16
+                ids1 = (vocab_ids + 1).astype(dt)[codes].reshape(n, w)
+                out = _device_token_counts(ids1, size, min_tf,
+                                           self.binary, w)
+                return (table.with_column(self.output_col, out),)
             # count over codes RANKED by vocab id (small domain → the
             # bincount engine applies) — run values map back to vocab ids
             # still ascending within each row; OOV (-1) ranks first
@@ -652,6 +769,67 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
         self.vocabulary = rw.load_model_json(path, "model")["vocabulary"]
 
 
+def _doc_freq_small_domain(codes_mat: np.ndarray, u: int,
+                           chunk_elems: int = 512 << 10) -> np.ndarray:
+    """Document frequency over an (n, w) code matrix with domain
+    ``[0, u)``: per-chunk (rows, u) bincount matrix, then a per-column
+    nonzero count — no row-of/value triple is ever materialized.  4×
+    faster than routing through :func:`_rowwise_counts` (whose nonzero +
+    fancy-gather steps exist to build CSR triples the fit never needs).
+    Chunks sized to keep the count matrix cache-resident."""
+    n, w = codes_mat.shape
+    if u == 0 or w == 0 or n == 0:  # empty domain / zero-width matrix
+        return np.zeros(u, np.int64)
+    chunk = max(1, chunk_elems // max(1, u))
+    base = np.arange(min(chunk, n), dtype=np.int64)[:, None] * u
+    df = np.zeros(u, np.int64)
+    for r0 in range(0, n, chunk):
+        r1 = min(r0 + chunk, n)
+        keys = (base[: r1 - r0] + codes_mat[r0:r1]).reshape(-1)
+        cm = np.bincount(keys, minlength=(r1 - r0) * u).reshape(-1, u)
+        df += np.count_nonzero(cm, axis=0)
+    return df
+
+
+def _cv_shard_counts(col: np.ndarray, lo: int, hi: int):
+    """Per-shard CountVectorizer partial: (tokens, term counts, doc freqs)
+    over rows [lo, hi) of a token matrix — the per-task count map of the
+    reference's dictionary-learning shape (StringIndexer.java:117-122),
+    merged by :func:`_merge_shard_counts`."""
+    shard = col[lo:hi]
+    uniq, codes = _token_codes(shard)
+    u = len(uniq)
+    tc = np.bincount(codes, minlength=u)
+    mat = codes.reshape(shard.shape)
+    # same width-relative gate as _rowwise_counts: the dense count-matrix
+    # pass is O(n·u) and only beats the row-sort engine while u ~ O(w)
+    if u <= max(4 * shard.shape[1], 1024):
+        df = _doc_freq_small_domain(mat, u)
+    else:  # huge vocab: row-sorted run starts, one per (doc, token) pair
+        # (mat is freshly owned — the in-place row sort is fine)
+        _, start_codes, _ = _rowwise_counts(mat, with_counts=False)
+        df = np.bincount(start_codes, minlength=u)
+    return uniq, tc, df
+
+
+def _merge_shard_counts(parts):
+    """Reduce-merge of per-shard (tokens, tc, df) — the reference's
+    DataStreamUtils.reduce map merge (StringIndexer.java:125-142)."""
+    if len(parts) == 1:
+        return parts[0]
+    all_uniq = np.concatenate([p[0] for p in parts])
+    uniq, inv = np.unique(all_uniq, return_inverse=True)
+    tc = np.zeros(len(uniq), np.int64)
+    df = np.zeros(len(uniq), np.int64)
+    k = 0
+    for pu, ptc, pdf in parts:
+        idx = inv[k:k + len(pu)]
+        np.add.at(tc, idx, ptc)
+        np.add.at(df, idx, pdf)
+        k += len(pu)
+    return uniq, tc, df
+
+
 class CountVectorizer(Estimator, CountVectorizerParams):
     """Learn a frequency-ordered vocabulary from token arrays
     (ref: feature/countvectorizer/ — terms ordered by corpus frequency desc,
@@ -662,17 +840,13 @@ class CountVectorizer(Estimator, CountVectorizerParams):
         col = table.column(self.input_col)
         n_docs = len(col)
         if _is_token_matrix(col):
-            # vectorized: corpus counts by bincount over token codes; doc
-            # freq by row-wise dedup — each run start in the row-sorted
-            # code matrix is one distinct (doc, token) pair, so df is a
-            # bincount over run-start codes (no (n_docs, u) presence
-            # matrix, no global sort)
-            uniq, codes = _token_codes(col)
-            u = len(uniq)
-            tc = np.bincount(codes, minlength=u)
-            _, start_codes, _ = _rowwise_counts(codes.reshape(col.shape),
-                                                with_counts=False, domain=u)
-            df = np.bincount(start_codes, minlength=u)
+            # vectorized, fanned over the host pool (fork shares the token
+            # matrix copy-on-write; each worker returns a per-shard count
+            # map, merged reduce-style — the reference's parallel shape)
+            from flink_ml_tpu.common.hostpool import map_row_shards
+
+            uniq, tc, df = _merge_shard_counts(map_row_shards(
+                lambda lo, hi: _cv_shard_counts(col, lo, hi), n_docs))
             min_df = self.min_df if self.min_df >= 1.0 \
                 else self.min_df * n_docs
             max_df = self.max_df if self.max_df >= 1.0 \

@@ -252,12 +252,14 @@ def test_token_matrix_parity_with_object_columns(rng):
         np.testing.assert_array_equal(a.to_array(), b.to_array())
 
     # CountVectorizer fit (vocabulary order incl. frequency ties) + model
+    # (token-matrix transform emits the dense device count column — the
+    # residency-agnostic vectors() off-ramp is the comparison surface)
     cv_m = CountVectorizer(input_col="tokens", output_col="o").fit(t_mat)
     cv_o = CountVectorizer(input_col="tokens", output_col="o").fit(t_obj)
     assert cv_m.vocabulary == cv_o.vocabulary
-    for a, b in zip(cv_m.transform(t_mat)[0]["o"],
-                    cv_o.transform(t_obj)[0]["o"]):
-        np.testing.assert_array_equal(a.to_array(), b.to_array())
+    np.testing.assert_array_equal(
+        np.asarray(cv_m.transform(t_mat)[0].vectors("o", np.float64)),
+        np.asarray(cv_o.transform(t_obj)[0].vectors("o", np.float64)))
 
     # StopWordsRemover (default English list removes "the"/"on")
     sw = StopWordsRemover(input_cols=["tokens"], output_cols=["o"])
@@ -395,3 +397,101 @@ def test_rowwise_counts_engines_agree(rng):
         np.testing.assert_array_equal(a2[0], a[0])
         np.testing.assert_array_equal(np.asarray(a2[1], np.int64),
                                       np.asarray(a[1], np.int64))
+
+
+def test_countvectorizer_device_dense_matches_host_csr(monkeypatch):
+    """Small-vocab transform emits a dense device count column; it must
+    equal the host CSR path for every (minTF, binary) combination,
+    including OOV tokens (ref semantics: CountVectorizerModel.java)."""
+    import flink_ml_tpu.models.feature.text as tt
+    from flink_ml_tpu.models.feature import CountVectorizer
+
+    rng = np.random.default_rng(0)
+    toks = np.array([f"t{v}" for v in range(7)])
+    col = toks[rng.integers(0, 7, (200, 6))]
+    t = Table.from_columns(docs=col)
+    t2 = Table.from_columns(docs=np.array([["t0", "zz", "t1"],
+                                           ["zz", "zz", "zz"]]))
+    # 0.07*100 = 7.000000000000001 in f64: a count of exactly 7 must be
+    # excluded by BOTH paths (a naive f32 device compare would round the
+    # threshold to 7.0 and include it; the kernel's integer ceil keeps
+    # the f64 semantics)
+    t3 = Table.from_columns(docs=np.array(
+        [["t0"] * 7 + ["t1"] * 93, ["t0"] * 8 + ["t1"] * 92]))
+    model3 = CountVectorizer(input_col="docs", output_col="v",
+                             min_tf=0.07).fit(t3)
+    import flink_ml_tpu.models.feature.text as tt3
+    dev3 = np.asarray(model3.transform(t3)[0].column("v"))
+    monkeypatch.setattr(tt3, "_dense_counts_budget", lambda: 0)
+    host3 = np.asarray(model3.transform(t3)[0].vectors("v", np.float64))
+    monkeypatch.undo()
+    np.testing.assert_allclose(dev3, host3)
+    i_t0 = model3.vocabulary.index("t0")
+    assert dev3[0, i_t0] == 0.0 and dev3[1, i_t0] == 8.0
+
+    for min_tf, binary in [(1.0, False), (2.0, False), (0.3, False),
+                           (1.0, True), (2.0, True)]:
+        model = CountVectorizer(input_col="docs", output_col="v",
+                                min_tf=min_tf, binary=binary).fit(t)
+        for table in (t, t2):
+            dev = model.transform(table)[0].column("v")
+            assert hasattr(dev, "block_until_ready")  # device column
+            monkeypatch.setattr(tt, "_dense_counts_budget", lambda: 0)
+            host = model.transform(table)[0]
+            monkeypatch.undo()
+            np.testing.assert_allclose(
+                np.asarray(dev), np.asarray(host.vectors("v", np.float64)),
+                err_msg=f"minTF={min_tf} binary={binary}")
+
+
+def test_doc_freq_small_domain_matches_rowwise_counts(rng):
+    from flink_ml_tpu.models.feature.text import (_doc_freq_small_domain,
+                                                  _rowwise_counts)
+
+    for n, w, u in ((1, 1, 1), (50, 7, 3), (700, 11, 129)):
+        mat = rng.integers(0, u, (n, w)).astype(np.int64)
+        _, start_codes, _ = _rowwise_counts(mat.copy(), with_counts=False,
+                                            domain=u)
+        expected = np.bincount(start_codes, minlength=u)
+        np.testing.assert_array_equal(
+            _doc_freq_small_domain(mat, u, chunk_elems=64), expected)
+
+
+def test_stopwords_first_char_prefilter_identity():
+    """A corpus whose tokens can't start like any stop word returns the
+    INPUT object (O(n) screen, no factorize)."""
+    from flink_ml_tpu.models.feature import StopWordsRemover
+
+    col = np.array([[str(v) for v in range(5)]] * 10)
+    out = StopWordsRemover(input_cols=["c"], output_cols=["o"]).transform(
+        Table.from_columns(c=col))[0]
+    assert out.column("o") is col
+
+
+def test_stopwords_prefilter_edge_cases():
+    from flink_ml_tpu.models.feature import StopWordsRemover
+
+    # mixed: candidates that are and aren't stop words
+    col = np.array([["The", "quick", "fox"], ["thee", "a", "ox"]])
+    out = StopWordsRemover(input_cols=["c"], output_cols=["o"]).transform(
+        Table.from_columns(c=col))[0]
+    assert [list(r) for r in out.column("o")] == \
+        [["quick", "fox"], ["thee", "ox"]]
+    # Turkic fold: I → ı (a stop word here) only under tr locale
+    r = StopWordsRemover(input_cols=["c"], output_cols=["o"],
+                         stop_words=["ı"], locale="tr_TR")
+    out = r.transform(Table.from_columns(
+        c=np.array([["I", "i", "x"]])))[0]
+    assert [list(x) for x in out.column("o")] == [["i", "x"]]
+    # case-sensitive: exact match only
+    r = StopWordsRemover(input_cols=["c"], output_cols=["o"],
+                         case_sensitive=True, stop_words=["The"])
+    out = r.transform(Table.from_columns(
+        c=np.array([["The", "the", "THE"]])))[0]
+    assert [list(x) for x in out.column("o")] == [["the", "THE"]]
+    # pathological: the empty string as a stop word still filters ''
+    r = StopWordsRemover(input_cols=["c"], output_cols=["o"],
+                         stop_words=["", "zz"])
+    out = r.transform(Table.from_columns(
+        c=np.array([["", "ok", "zz"]])))[0]
+    assert [list(x) for x in out.column("o")] == [["ok"]]

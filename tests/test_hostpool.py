@@ -1,0 +1,91 @@
+"""Host-pool fan-out (common/hostpool.py): the reference's per-subtask
+map + reduce-merge shape (StringIndexer.java:117-142) for host-bound
+string ops. Fork-based workers with copy-on-write inputs; results come
+back by pipe; failures propagate with the worker traceback."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.hostpool import (host_parallelism, map_row_shards,
+                                          shard_bounds)
+
+
+def test_shard_bounds_cover_and_partition():
+    for n, w in [(10, 3), (8, 8), (7, 8), (0, 4), (100, 1)]:
+        bounds = shard_bounds(n, w)
+        assert len(bounds) == w
+        assert bounds[0][0] == 0 and bounds[-1][1] == n
+        for (a, b), (c, d) in zip(bounds, bounds[1:]):
+            assert b == c and a <= b
+
+
+def test_inline_below_min_rows():
+    calls = []
+
+    def fn(lo, hi):
+        calls.append((lo, hi))
+        return hi - lo
+
+    assert map_row_shards(fn, 10, workers=4, min_rows=100) == [10]
+    assert calls == [(0, 10)]
+
+
+def test_forked_shards_merge_and_order():
+    x = np.arange(100_000, dtype=np.int64)
+
+    def fn(lo, hi):
+        return x[lo:hi].sum()
+
+    parts = map_row_shards(fn, len(x), workers=4, min_rows=16)
+    assert len(parts) == 4
+    assert sum(parts) == x.sum()
+    # shard order is preserved (shard 0's partial is the smallest here)
+    assert parts == sorted(parts)
+
+
+def test_array_results_roundtrip():
+    x = np.random.default_rng(0).integers(0, 255, 200_000).astype(np.uint8)
+
+    def fn(lo, hi):
+        return x[lo:hi]
+
+    parts = map_row_shards(fn, len(x), workers=3, min_rows=16)
+    assert np.array_equal(np.concatenate(parts), x)
+
+
+def test_worker_error_propagates_with_traceback():
+    def bad(lo, hi):
+        raise ValueError(f"boom at {lo}")
+
+    with pytest.raises(RuntimeError, match="boom at"):
+        map_row_shards(bad, 10_000, workers=2, min_rows=16)
+
+
+def test_host_parallelism_env_override(monkeypatch):
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "3")
+    assert host_parallelism() == 3
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "0")
+    assert host_parallelism() == 0
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "junk")
+    assert host_parallelism() >= 1
+
+
+def test_countvectorizer_fit_pool_parity(monkeypatch):
+    """Forced multi-worker fit == inline fit (per-shard count maps merge
+    exactly — the reduce-merge contract)."""
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import CountVectorizer
+
+    rng = np.random.default_rng(7)
+    toks = np.array([f"w{v}" for v in range(37)])
+    col = toks[rng.integers(0, 37, (3000, 8))]
+    t = Table.from_columns(docs=col)
+    cv = CountVectorizer(input_col="docs", output_col="v", min_df=2.0)
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "1")
+    serial = cv.fit(t).vocabulary
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "4")
+    monkeypatch.setattr(
+        "flink_ml_tpu.common.hostpool.map_row_shards",
+        lambda fn, n, **kw: map_row_shards(fn, n, min_rows=64))
+    pooled = cv.fit(t).vocabulary
+    assert serial == pooled
