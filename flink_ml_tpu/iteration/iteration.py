@@ -80,6 +80,10 @@ def iterate_bounded(initial_carry: Carry,
     scipy matvecs have no XLA form). Such bodies always use the host loop.
     """
     config = config or IterationConfig()
+    seg = device_checkpoint_segment(config, listeners)
+    if jit_round and seg:
+        return _segmented_device_loop(initial_carry, body, max_iter,
+                                      terminate, config, seg)
     if jit_round and not needs_host_loop(config, listeners):
         return _device_loop(initial_carry, body, max_iter, terminate)
     return _host_loop(initial_carry, body, max_iter, terminate, config,
@@ -90,7 +94,12 @@ def needs_host_loop(config: Optional[IterationConfig],
                     listeners: Sequence[IterationListener] = ()) -> bool:
     """True when any configured behavior requires host-driven rounds.
     The single source of truth for the device/host dispatch — algorithm fast
-    paths (SGD, KMeans) must consult this instead of re-deriving it."""
+    paths (SGD, KMeans) must consult this instead of re-deriving it.
+
+    Checkpointing alone no longer lands here: a device-mode fit with only
+    interval checkpointing runs K-round compiled segments with a host
+    snapshot between them (:func:`device_checkpoint_segment`) — fast paths
+    must check that FIRST, then this."""
     if config is None:
         return bool(listeners)
     return bool(listeners) or config.mode == "host" \
@@ -99,29 +108,123 @@ def needs_host_loop(config: Optional[IterationConfig],
         or config.per_round_init is not None
 
 
-def _device_loop(initial_carry, body, max_iter, terminate):
-    """Single compiled while_loop: the whole iteration is one XLA program.
+def device_checkpoint_segment(
+        config: Optional[IterationConfig],
+        listeners: Sequence[IterationListener] = ()) -> int:
+    """K (the checkpoint interval) when the ONLY host hook is interval
+    checkpointing and the mode is "device": the iteration then runs as
+    K-round compiled ``while_loop`` segments with the carry snapshotted on
+    host between segments — fault tolerance composes with the fast path
+    (ref bar: every reference job checkpoints *through* the iteration,
+    Checkpoints.java:43, without leaving its execution mode).  0 when the
+    configuration needs true per-round host hooks (listeners,
+    per_round_init, mode="host") or no checkpointing is requested."""
+    if config is None or listeners:
+        return 0
+    if (config.mode != "device" or config.per_round_init is not None
+            or config.checkpoint_manager is None
+            or config.checkpoint_interval <= 0):
+        return 0
+    return config.checkpoint_interval
 
-    Termination is evaluated *after* each round on the just-completed epoch,
-    matching _host_loop exactly — the two modes must be numerically
-    interchangeable (a listener must never change the result).
-    """
+
+def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
+    """Drive ``run_segment(carry, epoch0, limit) -> (carry, epoch, stop)``
+    in K-round chunks with a checkpoint at every K-round boundary — the
+    shared segment driver for the generic iteration and the algorithm fast
+    paths (SGD/KMeans build their own compiled segment programs).
+
+    Checkpoint cadence matches the host loop exactly: a snapshot lands
+    after every K completed rounds (including a termination that coincides
+    with a boundary); an early stop mid-segment saves nothing, and a
+    completed run clears its checkpoints.  A restore landing off the
+    K-grid (a snapshot from a different interval or mode) realigns at the
+    first segment so later boundaries checkpoint on-grid again."""
+    from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+    iter_group = metrics.group(ML_GROUP, "iteration")
+
+    import time as _time
+
+    carry, epoch = initial_carry, 0
+    restored = mgr.restore(carry)
+    if restored is not None:
+        carry, epoch = restored
+    stop = False
+    while epoch < max_iter and not stop:
+        # realign to the K-grid so `epoch % K == 0` keeps firing after an
+        # off-phase restore
+        limit = min(epoch + K - epoch % K, max_iter)
+        seg_start = _time.perf_counter()
+        carry, e, s = run_segment(carry, epoch, limit)
+        rounds = int(e) - epoch
+        epoch, stop = int(e), bool(s)
+        if epoch % K == 0:
+            mgr.save(carry, epoch)
+        # per-segment metrics: the host-sync boundary is already here, so
+        # the counters cost no extra device round-trip
+        seg_ms = (_time.perf_counter() - seg_start) * 1000.0
+        iter_group.counter("rounds", rounds)
+        iter_group.gauge("lastSegmentMs", seg_ms)
+        iter_group.gauge("lastRoundMs", seg_ms / max(rounds, 1))
+    mgr.clear()
+    return carry
+
+
+def _segmented_device_loop(initial_carry, body, max_iter, terminate, config,
+                           K: int):
+    """Device-mode iteration with interval checkpointing: one jitted
+    ``while_loop`` per K-round segment (epoch bounds are device scalars, so
+    every segment reuses one compilation), carry snapshotted between
+    segments.  Numerically identical to :func:`_device_loop` by
+    construction — both build on :func:`_loop_pieces`."""
+    cond, step = _loop_pieces(body, terminate)
+
+    @jax.jit
+    def seg(carry, epoch0, limit):
+        carry, epoch, stop, _ = jax.lax.while_loop(
+            cond, step, (carry, epoch0, jnp.asarray(False), limit))
+        return carry, epoch, stop
+
+    def run_segment(carry, epoch0, limit):
+        return seg(carry, jnp.int32(epoch0), jnp.int32(limit))
+
+    return run_segmented(run_segment, initial_carry, max_iter, K,
+                         config.checkpoint_manager)
+
+
+def _loop_pieces(body, terminate):
+    """The shared while_loop (cond, step) over state
+    ``(carry, epoch, stop, limit)`` — ONE definition of the round/stop
+    structure so the full device loop and the checkpointed segment loop
+    cannot drift apart numerically.  Termination is evaluated *after*
+    each round on the just-completed epoch, matching _host_loop exactly —
+    all modes must be numerically interchangeable (a listener or a
+    checkpoint must never change the result)."""
 
     def cond(state):
-        carry, epoch, stop = state
-        return jnp.logical_and(epoch < max_iter, jnp.logical_not(stop))
+        carry, epoch, stop, limit = state
+        return jnp.logical_and(epoch < limit, jnp.logical_not(stop))
 
     def step(state):
-        carry, epoch, _ = state
+        carry, epoch, _, limit = state
         new_carry = body(carry, epoch)
         stop = (jnp.asarray(terminate(new_carry, epoch), dtype=bool)
                 if terminate is not None else jnp.asarray(False))
-        return new_carry, epoch + 1, stop
+        return new_carry, epoch + 1, stop, limit
+
+    return cond, step
+
+
+def _device_loop(initial_carry, body, max_iter, terminate):
+    """Single compiled while_loop: the whole iteration is one XLA program
+    (the K=max_iter degenerate case of the segmented loop)."""
+    cond, step = _loop_pieces(body, terminate)
 
     @jax.jit
     def run(carry):
-        final_carry, _, _ = jax.lax.while_loop(
-            cond, step, (carry, jnp.int32(0), jnp.asarray(False)))
+        final_carry, _, _, _ = jax.lax.while_loop(
+            cond, step,
+            (carry, jnp.int32(0), jnp.asarray(False), jnp.int32(max_iter)))
         return final_carry
 
     return run(initial_carry)

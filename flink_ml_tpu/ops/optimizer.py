@@ -131,38 +131,49 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
 
 
 @functools.lru_cache(maxsize=128)
-def _build_sgd_program(loss_cls, mesh: Mesh, prm: SGDParams):
-    """One jitted SPMD training program per (loss, mesh, hyperparams).
-    Returning the same callable lets jax.jit's shape cache do its job."""
+def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams):
+    """A K-round slice of the training loop as ONE compiled SPMD program:
+    ``segment(xs, ys, ws, coeffs, offsets, epoch0, limit) -> (coeffs,
+    offsets, mean_loss, epoch, stop)``.  The epoch bounds are device
+    scalars, so every segment of a checkpointed fit reuses a single
+    compilation; between segments the host snapshots the carry
+    (iteration.run_segmented) — fault tolerance at fast-path speed, the
+    composition the reference gets from checkpointing *through* the
+    iteration (Checkpoints.java:43).
+
+    The plain (uncheckpointed) fit is the degenerate call
+    ``segment(..., epoch0=0, limit=max_iter)`` — ONE program serves both,
+    so the two paths cannot drift numerically."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     p = data_shard_count(mesh)
     model_axis = model_axis_of(mesh)
     wspec = P(model_axis) if model_axis else P()
     round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis)
-    max_iter = prm.max_iter
 
-    def per_shard(xl, yl, wl, w0):
+    def per_shard(xl, yl, wl, coeffs, offsets, epoch0, limit):
         def cond(state):
             _, _, _, epoch, stop = state
-            return jnp.logical_and(epoch < max_iter, jnp.logical_not(stop))
+            return jnp.logical_and(epoch < limit, jnp.logical_not(stop))
 
         def step(state):
             coeffs, offset, _, epoch, _ = state
             coeffs, new_offset, mean_loss = round_step(xl, yl, wl, coeffs,
                                                        offset)
-            stop = mean_loss < prm.tol
-            return coeffs, new_offset, mean_loss, epoch + 1, stop
+            return (coeffs, new_offset, mean_loss, epoch + 1,
+                    mean_loss < prm.tol)
 
-        init = (w0, jnp.int32(0), jnp.asarray(jnp.inf, w0.dtype),
-                jnp.int32(0), jnp.asarray(False))
-        coeffs, _, mean_loss, _, _ = jax.lax.while_loop(cond, step, init)
-        return coeffs, mean_loss
+        init = (coeffs, offsets[0], jnp.asarray(jnp.inf, coeffs.dtype),
+                epoch0, jnp.asarray(False))
+        coeffs, offset, mean_loss, epoch, stop = jax.lax.while_loop(
+            cond, step, init)
+        return coeffs, offset[None], mean_loss, epoch, stop
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec),
-        out_specs=(wspec, P()), check_vma=False))
+        in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
+                  P(spec0), P(), P()),
+        out_specs=(wspec, P(spec0), P(), P(), P()), check_vma=False))
 
 
 @functools.lru_cache(maxsize=128)
@@ -343,30 +354,14 @@ class SGD:
             ws, _ = ensure_on_mesh(mesh, weights, axes, jnp.float32)
         w0 = jax.device_put(jnp.asarray(init_coeffs, dtype), w_sharding)
 
-        from flink_ml_tpu.iteration.iteration import needs_host_loop
-        if not needs_host_loop(config, listeners):
-            fit = _build_sgd_program(type(loss_func), mesh, self.params)
-            coeffs, mean_loss = fit(xs, ys, ws, w0)
-            return (np.asarray(coeffs, np.float64)[:d],
-                    float(mean_loss))
-
-        from flink_ml_tpu.iteration.iteration import iterate_bounded
-
-        round_fn = _build_sgd_round_program(type(loss_func), mesh,
-                                            self.params)
+        from flink_ml_tpu.iteration.iteration import (
+            device_checkpoint_segment, needs_host_loop, run_segmented)
         p = data_shard_count(mesh)
         spec0 = data_pspec(mesh)
-
-        def body(carry, epoch):
-            coeffs, offsets, _ = carry
-            coeffs, offsets, mean_loss = round_fn(xs, ys, ws, coeffs,
-                                                  offsets)
-            return coeffs, offsets, mean_loss
-
         # carry leaves must live on the full mesh (replicated or
         # model-sharded coeffs, per-task offsets) — both for the
-        # shard_mapped round and so that checkpoint restore re-places
-        # leaves onto the right shardings.
+        # shard_mapped round/segment and so that checkpoint restore
+        # re-places leaves onto the right shardings.
         init = (
             w0,
             jax.device_put(jnp.zeros((p,), jnp.int32),
@@ -374,6 +369,42 @@ class SGD:
             jax.device_put(jnp.asarray(jnp.inf, dtype),
                            NamedSharding(mesh, P())),
         )
+
+        seg_k = device_checkpoint_segment(config, listeners)
+        if seg_k or not needs_host_loop(config, listeners):
+            # the compiled fast path: a plain fit is one max_iter segment;
+            # a checkpointed fit runs K-round segments with the carry
+            # snapshotted between them (same single program either way)
+            seg_prog = _build_sgd_segment_program(type(loss_func), mesh,
+                                                  self.params)
+
+            def run_segment(carry, epoch0, limit):
+                coeffs, offsets, _ = carry
+                coeffs, offsets, mean_loss, epoch, stop = seg_prog(
+                    xs, ys, ws, coeffs, offsets,
+                    jnp.int32(epoch0), jnp.int32(limit))
+                return (coeffs, offsets, mean_loss), epoch, stop
+
+            if seg_k:
+                coeffs, _, mean_loss = run_segmented(
+                    run_segment, init, self.params.max_iter, seg_k,
+                    config.checkpoint_manager)
+            else:
+                (coeffs, _, mean_loss), _, _ = run_segment(
+                    init, 0, self.params.max_iter)
+            return np.asarray(coeffs, np.float64)[:d], float(mean_loss)
+
+        from flink_ml_tpu.iteration.iteration import iterate_bounded
+
+        round_fn = _build_sgd_round_program(type(loss_func), mesh,
+                                            self.params)
+
+        def body(carry, epoch):
+            coeffs, offsets, _ = carry
+            coeffs, offsets, mean_loss = round_fn(xs, ys, ws, coeffs,
+                                                  offsets)
+            return coeffs, offsets, mean_loss
+
         final = iterate_bounded(
             init, body, max_iter=self.params.max_iter,
             terminate=lambda carry, epoch: carry[2] < self.params.tol,

@@ -125,3 +125,118 @@ def test_assembler_input_sizes_sparse_vectors():
     # sparse inputs now stay sparse (CSR column); compare densified
     np.testing.assert_allclose(out["output"].to_dense(),
                                [[1, 0, 0], [0, 2, 3]])
+
+
+class _CrashingManager(CheckpointManager):
+    """Process death at a segment boundary: the save for ``crash_epoch``
+    never lands, earlier snapshots remain — the device-mode analog of
+    FailingMap (no listeners exist on the fast path to crash from)."""
+
+    def __init__(self, base_dir, crash_epoch):
+        super().__init__(base_dir)
+        self.crash_epoch = crash_epoch
+
+    def save(self, carry, epoch):
+        if epoch == self.crash_epoch:
+            raise _Crash()
+        return super().save(carry, epoch)
+
+
+def test_lr_device_mode_checkpointed_fit_matches_plain(lr_data, tmp_path):
+    """checkpoint_interval no longer forces host mode: a device-mode fit
+    with only interval checkpointing runs K-round compiled segments and
+    must equal the single-program fit exactly."""
+    expected = _lr().fit(lr_data).coefficients
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="device", checkpoint_interval=4,
+                          checkpoint_manager=mgr)
+    got = _lr().set_iteration_config(cfg).fit(lr_data).coefficients
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+    assert not mgr.list_checkpoints()  # completed fit clears snapshots
+
+
+def test_lr_device_mode_crash_resume_identical_result(lr_data, tmp_path):
+    """Crash+resume a DEVICE-mode (segmented fast path) LR fit: resumed
+    coefficients must match the uninterrupted fit (ref bar:
+    BoundedAllRoundCheckpointITCase.java:95, without leaving the
+    compiled execution mode)."""
+    expected = _lr().fit(lr_data).coefficients
+
+    bad = _CrashingManager(str(tmp_path / "ckpt"), crash_epoch=8)
+    cfg = IterationConfig(mode="device", checkpoint_interval=2,
+                          checkpoint_manager=bad)
+    with pytest.raises(_Crash):
+        _lr().set_iteration_config(cfg).fit(lr_data)
+    assert bad.list_checkpoints()  # snapshots up to epoch 6 survive
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="device", checkpoint_interval=2,
+                          checkpoint_manager=mgr)
+    resumed = _lr().set_iteration_config(cfg).fit(lr_data).coefficients
+    np.testing.assert_allclose(resumed, expected, rtol=1e-6)
+
+
+def test_kmeans_device_mode_crash_resume_identical_result(rng, tmp_path):
+    """The generic segmented device loop (iterate_bounded) drives KMeans:
+    crash at a boundary, resume, identical centroids."""
+    x = np.concatenate([rng.normal(size=(100, 3)),
+                        rng.normal(size=(100, 3)) + 6]).astype(np.float32)
+    t = Table.from_columns(features=x)
+    expected = KMeans(k=2, seed=7, max_iter=8).fit(t).centroids
+
+    bad = _CrashingManager(str(tmp_path / "ckpt"), crash_epoch=6)
+    cfg = IterationConfig(mode="device", checkpoint_interval=3,
+                          checkpoint_manager=bad)
+    with pytest.raises(_Crash):
+        KMeans(k=2, seed=7, max_iter=8).set_iteration_config(cfg).fit(t)
+    assert bad.list_checkpoints()
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="device", checkpoint_interval=3,
+                          checkpoint_manager=mgr)
+    resumed = (KMeans(k=2, seed=7, max_iter=8)
+               .set_iteration_config(cfg).fit(t).centroids)
+    np.testing.assert_allclose(resumed, expected, rtol=1e-6)
+
+
+def test_lr_device_mode_tol_stop_in_segment(lr_data, tmp_path):
+    """Early tol termination inside a segment must match the plain device
+    fit (stop propagates out of the compiled segment, no spurious
+    checkpoint after the stop)."""
+    expected = _lr(tol=0.5).fit(lr_data).coefficients
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="device", checkpoint_interval=5,
+                          checkpoint_manager=mgr)
+    got = (_lr(tol=0.5).set_iteration_config(cfg)
+           .fit(lr_data).coefficients)
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_segment_resume_realigns_off_phase_checkpoint(lr_data, tmp_path):
+    """A restore landing off the K-grid (snapshot from a different
+    interval) must realign: later boundaries keep checkpointing on-grid
+    instead of never saving again."""
+    # produce a snapshot at epoch 5 via host mode, interval 5, crash at 5
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=5,
+                          checkpoint_manager=mgr)
+    with pytest.raises(_Crash):
+        (_lr().set_iteration_config(cfg, listeners=[_CrashAt(5)])
+         .fit(lr_data))
+    assert mgr.list_checkpoints() == ["ckpt-00000005"]
+
+    # resume in device mode, interval 2: segments realign to 6, 8, ...
+    saved = []
+
+    class _Recording(CheckpointManager):
+        def save(self, carry, epoch):
+            saved.append(epoch)
+            return super().save(carry, epoch)
+
+    rec = _Recording(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="device", checkpoint_interval=2,
+                          checkpoint_manager=rec)
+    resumed = _lr().set_iteration_config(cfg).fit(lr_data).coefficients
+    assert saved == [6, 8, 10, 12], saved
+    expected = _lr().fit(lr_data).coefficients
+    np.testing.assert_allclose(resumed, expected, rtol=1e-6)
