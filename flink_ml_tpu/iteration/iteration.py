@@ -37,7 +37,9 @@ class IterationConfig:
     #: checkpoints and data-dependent host logic between rounds.
     mode: str = "device"
 
-    #: host mode: checkpoint every N epochs (0 = never).
+    #: checkpoint every N epochs (0 = never). Device mode runs N-round
+    #: compiled segments with a snapshot between them (the fast path and
+    #: fault tolerance compose); host mode snapshots between rounds.
     checkpoint_interval: int = 0
     checkpoint_manager: Optional[Any] = None
 
@@ -131,8 +133,11 @@ def device_checkpoint_segment(
 def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
     """Drive ``run_segment(carry, epoch0, limit) -> (carry, epoch, stop)``
     in K-round chunks with a checkpoint at every K-round boundary — the
-    shared segment driver for the generic iteration and the algorithm fast
-    paths (SGD/KMeans build their own compiled segment programs).
+    shared segment driver for the generic iteration and for algorithm fast
+    paths that build their own compiled segment program (SGD does;
+    KMeans rides the generic :func:`_segmented_device_loop` through
+    ``iterate_bounded``, which wraps its shard_mapped round body in the
+    segmented while_loop).
 
     Checkpoint cadence matches the host loop exactly: a snapshot lands
     after every K completed rounds (including a termination that coincides
