@@ -505,25 +505,46 @@ class VectorIndexer(Estimator, VectorIndexerParams):
         k = self.max_categories
         maps = {}
         if xp is not np:
-            # device: sized uniques per dim, only (d, k+1) candidates
-            # cross to host. Invariant: maps must equal the host path run
-            # on the same column values. Integral candidates satisfy that
-            # directly; dims with non-finite or fractional values re-fit
-            # from a per-dim host off-ramp so NaN/inf keys and
-            # fractional-value keys get exact host np.unique semantics.
-            cand, nonfinite = columnar.apply(
-                _sized_unique_kernel, x, static=(k,))
-            cand = np.asarray(cand, np.float64)
-            nonfinite = np.asarray(nonfinite)
-            for dim in range(cand.shape[0]):
-                vals = cand[dim][~np.isnan(cand[dim])]
-                if nonfinite[dim] or not (vals == np.floor(vals)).all():
-                    vals = np.unique(np.asarray(x[:, dim], np.float64))
-                if len(vals) <= k:
-                    maps[dim] = {float(v): i
-                                 for i, v in enumerate(sorted(vals))}
+            # sample screen: a dim whose first rows already show more than
+            # k distinct values cannot be categorical (subset distinct <=
+            # whole-column distinct), so continuous dims never pay the
+            # full-column sized-unique sort or any host off-ramp — the
+            # r3 sweep's 17 s fit was exactly d continuous dims each
+            # doing both
+            n, d = x.shape
+            s_cand, _ = columnar.apply(
+                _sized_unique_kernel, x[: min(n, 4096)], static=(k,))
+            s_cand = np.asarray(s_cand)
+            possible = [dim for dim in range(d)
+                        if np.isnan(s_cand[dim]).any()]
+            if possible:
+                # surviving dims: sized uniques per dim over the full
+                # column; only (|possible|, k+1) candidates cross to
+                # host. Invariant: maps must equal the host path run on
+                # the same column values. Integral candidates satisfy
+                # that directly; dims with non-finite or fractional
+                # values re-fit from ONE shared host off-ramp so NaN/inf
+                # and fractional keys get exact np.unique semantics.
+                sub = x[:, np.asarray(possible)]
+                cand, nonfinite = columnar.apply(
+                    _sized_unique_kernel, sub, static=(k,))
+                cand = np.asarray(cand, np.float64)
+                nonfinite = np.asarray(nonfinite)
+                sub_h = None
+                for j, dim in enumerate(possible):
+                    vals = cand[j][~np.isnan(cand[j])]
+                    if nonfinite[j] or not (vals == np.floor(vals)).all():
+                        if sub_h is None:
+                            sub_h = np.asarray(sub, np.float64)
+                        vals = np.unique(sub_h[:, j])
+                    if len(vals) <= k:
+                        maps[dim] = {float(v): i
+                                     for i, v in enumerate(sorted(vals))}
         else:
+            n = x.shape[0]
             for dim in range(x.shape[1]):
+                if n > 8192 and len(np.unique(x[:8192, dim])) > k:
+                    continue  # same sample screen, host tier
                 uniq = np.unique(x[:, dim])
                 if len(uniq) <= k:
                     maps[dim] = {float(v): i

@@ -337,21 +337,18 @@ class RobustScalerModel(_VectorStatModelBase, RobustScalerParams):
                 (bool(self.with_centering), bool(self.with_scaling)))
 
 
-def _quantile3_kernel(x, qs):
-    return jnp.quantile(x, qs, axis=0)
-
-
 class RobustScaler(Estimator, RobustScalerParams):
     def fit(self, table: Table) -> RobustScalerModel:
         x, xp = columnar.fit_vectors(table, self.input_col)
         if xp is jnp:
-            # device-resident input: EXACT quantiles via a device sort —
-            # exact ⊇ the ε-approximate contract of relativeError (same
-            # argument as the Imputer median, docs/deviations.md)
-            qs = np.asarray(columnar.apply(
-                _quantile3_kernel, x,
-                (np.asarray([self.lower, 0.5, self.upper], np.float32),)),
-                np.float64)
+            # device-resident input: rank-exact order statistics via the
+            # sort-free bisection kernel (ops/quantile.rank_select_device)
+            # — element-of-dataset semantics matching the reference's GK
+            # summary, at streaming-pass cost instead of a (n, d) sort
+            from flink_ml_tpu.ops.quantile import rank_select_device
+
+            qs = np.asarray(rank_select_device(
+                x, [self.lower, 0.5, self.upper]), np.float64)
         else:
             from flink_ml_tpu.ops.quantile import approx_quantiles
             qs = approx_quantiles(

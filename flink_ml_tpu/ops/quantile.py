@@ -145,3 +145,69 @@ def approx_quantiles(x: np.ndarray, probs: Sequence[float],
     if x.ndim == 1:
         x = x[:, None]
     return np.quantile(x, np.asarray(probs), axis=0, method="lower")
+
+
+def rank_select_device(x, probs: Sequence[float]):
+    """Per-column order statistics of a DEVICE (n, d) float32 array →
+    (m, d) device array, WITHOUT a device sort.
+
+    ``jnp.quantile`` sorts every column — the whole fit cost of
+    RobustScaler at benchmark scale (a (10M, 100) sort made it 22x
+    slower than its sibling scalers, r3 sweep).  Instead: 32 rounds of
+    bisection on the ORDER-PRESERVING uint32 bit image of float32 (the
+    sign-magnitude flip radix-sort uses), each one fused compare-count
+    pass over x inside a jitted ``fori_loop``.  XLA fuses the
+    broadcast-compare into the (d, m) count reduction — nothing of shape
+    (n, d, m) materializes.  Integer bisection converges EXACTLY to the
+    bit pattern of the floor(q*(n-1))-th smallest element — the same
+    element-of-dataset semantics as numpy's method='lower' and the
+    reference's GK summary (QuantileSummary.java:42) — independent of
+    the column's value range: outliers, denormals and infinities cost
+    nothing (keys are just 32-bit integers; no midpoint overflow, no
+    lost resolution).  NaN bit patterns sort outside the finite band
+    (negative-payload NaNs below -inf, positive above +inf), matching a
+    sort-based quantile's endpoint behavior.
+    """
+    from flink_ml_tpu.ops import columnar
+
+    n = int(x.shape[0])
+    ranks = np.floor(np.asarray(probs, np.float64) * (n - 1)) \
+        .astype(np.int32)
+    return columnar.apply(_rank_select_kernel, x, (ranks,))
+
+
+def _rank_select_kernel(x, ranks):
+    import jax
+    import jax.numpy as jnp
+
+    m = ranks.shape[0]
+    # order-preserving uint32 image: non-negative floats map above
+    # 0x80000000 keeping magnitude order; negative floats flip so larger
+    # magnitude sorts lower. Total order == IEEE float order.
+    u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    keys = jnp.where(u >= jnp.uint32(0x80000000),
+                     jnp.uint32(0xFFFFFFFF) - u,
+                     u + jnp.uint32(0x80000000))
+    target = (ranks + 1)[:, None]                  # (m, 1)
+    d = x.shape[1]
+    LO = jnp.zeros((m, d), jnp.uint32)
+    HI = jnp.full((m, d), jnp.uint32(0xFFFFFFFF))
+
+    def step(_, state):
+        LO, HI = state
+        mid = LO + (HI - LO) // jnp.uint32(2)
+        # (n, d, m) broadcast-compare fused into the count reduction
+        cnt = jnp.sum(
+            (keys[:, :, None] <= mid.T[None, :, :]).astype(jnp.int32),
+            axis=0)
+        ok = cnt.T >= target                       # (m, d)
+        HI = jnp.where(ok, mid, HI)
+        LO = jnp.where(ok, LO, mid + jnp.uint32(1))
+        return LO, HI
+
+    # 32 halvings of a 2^32 bracket: LO == HI == the answer's bit image
+    _, HI = jax.lax.fori_loop(0, 32, step, (LO, HI))
+    back = jnp.where(HI >= jnp.uint32(0x80000000),
+                     HI - jnp.uint32(0x80000000),
+                     jnp.uint32(0xFFFFFFFF) - HI)
+    return jax.lax.bitcast_convert_type(back, jnp.float32)

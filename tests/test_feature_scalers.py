@@ -184,16 +184,19 @@ def test_device_resident_fit_stats_match_host(rng):
                                        rtol=2e-4, atol=1e-4,
                                        err_msg=type(est).__name__)
 
-    # RobustScaler's device path is EXACT quantiles (the host path is the
-    # GK ε-approximation, so host-vs-device differs by design within ε);
-    # the device result must match the exact numpy oracle
+    # RobustScaler's device path is the sort-free rank-select kernel:
+    # rank-exact order statistics with method='lower' semantics — the
+    # same element-of-dataset contract as the host GK path and the
+    # reference's QuantileSummary; oracle is numpy's 'lower' quantile
     rs_d = RobustScaler(input_col="input", output_col="o").fit(t_dev)
     x32 = x.astype(np.float32)
     np.testing.assert_allclose(
-        rs_d.medians, np.quantile(x32, 0.5, axis=0), rtol=2e-4, atol=1e-4)
+        rs_d.medians, np.quantile(x32, 0.5, axis=0, method="lower"),
+        rtol=2e-4, atol=1e-4)
     np.testing.assert_allclose(
         rs_d.ranges,
-        np.quantile(x32, 0.75, axis=0) - np.quantile(x32, 0.25, axis=0),
+        np.quantile(x32, 0.75, axis=0, method="lower")
+        - np.quantile(x32, 0.25, axis=0, method="lower"),
         rtol=2e-3, atol=1e-3)
 
     sel_h = VarianceThresholdSelector(
@@ -315,3 +318,28 @@ def test_variance_selector_sparse_large_offset_stability(rng):
     md = VarianceThresholdSelector(**sel).fit(Table.from_columns(v=dense))
     np.testing.assert_array_equal(ms.indices, md.indices)
     assert 0 in ms.indices
+
+
+def test_rank_select_device_exact_on_adversarial_columns(rng):
+    """The sort-free device rank-select must return the EXACT
+    method='lower' order statistic even when the value range is hostile:
+    huge outliers (RobustScaler's core use case), infinities, denormals,
+    signed zeros — integer bit-bisection is range-independent."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.ops.quantile import rank_select_device
+
+    cases = [
+        (rng.normal(size=(5000, 4)) * [1, 10, 0.01, 1000]),
+        np.concatenate([rng.random((9999, 2)), [[1e30, -1e30]]]),
+        np.concatenate([rng.random((999, 2)), [[np.inf, -np.inf]]]),
+        rng.random((500, 1)) * 1e-40,
+        np.array([[-0.0], [0.0], [1.0], [-1.0]]),
+    ]
+    probs = [0.0, 0.25, 0.5, 0.75, 1.0]
+    for x in cases:
+        x32 = np.asarray(x, np.float32)
+        got = np.asarray(rank_select_device(jnp.asarray(x32), probs))
+        exp = np.quantile(x32.astype(np.float64), probs, axis=0,
+                          method="lower").astype(np.float32)
+        np.testing.assert_array_equal(got, exp)
