@@ -19,6 +19,8 @@ import sys
 import time
 from typing import Dict
 
+import numpy as np
+
 from flink_ml_tpu.api.stage import AlgoOperator, Estimator, Model, Stage
 from flink_ml_tpu.benchmark.datagen import resolve_generator
 
@@ -105,6 +107,10 @@ def run_benchmark(name: str, spec: dict) -> dict:
     total_ms = (time.perf_counter() - start) * 1000.0
 
     input_num = gen.num_values
+    exec_ms = total_ms - datagen_ms
+    input_bytes = _table_bytes(input_table)
+    if model_table is not None:
+        input_bytes += _table_bytes(model_table)
     return {
         "totalTimeMs": total_ms,
         "inputRecordNum": input_num,
@@ -113,8 +119,40 @@ def run_benchmark(name: str, spec: dict) -> dict:
         "outputThroughput": output_num * 1000.0 / total_ms,
         # extra provenance beyond the reference's schema: where the time went
         "dataGenTimeMs": datagen_ms,
-        "executeTimeMs": total_ms - datagen_ms,
+        "executeTimeMs": exec_ms,
+        # roofline context (SURVEY §6 extended): the stage must read its
+        # input at least once, so inputBytes / executeTime is a LOWER
+        # bound on achieved bandwidth — comparable against the platform
+        # roofline (v5e HBM ~819 GB/s; host DRAM ~10s of GB/s) to spot
+        # rows running far below the memory bound
+        "inputBytes": input_bytes,
+        "achievedGBps": input_bytes / max(exec_ms, 1e-9) / 1e6,
     }
+
+
+def _table_bytes(table) -> int:
+    """Actual byte size of a Table's columns (device, numpy, CSR); object
+    columns are estimated from a 256-row sample — benchmark provenance,
+    not an allocator audit."""
+    total = 0
+    for name in table.column_names:
+        col = table.column(name)
+        if getattr(col, "is_csr_vector_column", False):
+            m = col.matrix
+            total += int(m.data.nbytes + m.indices.nbytes
+                         + m.indptr.nbytes)
+            continue
+        dtype = getattr(col, "dtype", None)
+        if dtype is not None and dtype != np.dtype(object):
+            total += int(col.size) * int(dtype.itemsize)
+            continue
+        n = len(col)
+        if n:
+            sample = min(n, 256)
+            per_row = sum(
+                np.asarray(col[i]).nbytes for i in range(sample))
+            total += per_row * n // sample
+    return total
 
 
 def best_of(name: str, spec: dict, runs: int = 3) -> dict:

@@ -21,7 +21,11 @@ import sys
 from typing import Dict, List
 
 VALID_METRICS = ("inputThroughput", "outputThroughput", "totalTimeMs",
-                 "inputRecordNum", "outputRecordNum")
+                 "inputRecordNum", "outputRecordNum",
+                 # roofline provenance (runner.py): bytes the stage had to
+                 # read at least once, and the resulting lower bound on
+                 # achieved bandwidth over executeTime
+                 "inputBytes", "achievedGBps")
 
 
 def load_results(path: str) -> Dict[str, float]:

@@ -476,13 +476,26 @@ class HashingTF(Transformer, HasInputCol, HasOutputCol, HasNumFeatures):
         col = table.column(self.input_col)
         n = len(col)
         # hash each distinct token once; then aggregate (row, bucket) pairs
-        # with one vectorized unique instead of a dict per row
+        # with one vectorized unique instead of a dict per row — fanned
+        # over the host pool on row shards (each worker returns GLOBAL-row
+        # triples; the parent concatenates and builds ONE CSR column)
         if _is_token_matrix(col):
-            uniq, codes = _token_codes(col)
-            buckets = np.fromiter((_hash_index(str(t), m) for t in uniq),
-                                  np.int64, len(uniq))
-            row_of, bucket, counts = _rowwise_counts(
-                buckets[codes].reshape(col.shape), domain=m)
+            from flink_ml_tpu.common.hostpool import map_row_shards
+
+            def shard(lo, hi):
+                sub = col[lo:hi]
+                uniq, codes = _token_codes(sub)
+                buckets = np.fromiter(
+                    (_hash_index(str(t), m) for t in uniq),
+                    np.int64, len(uniq))
+                row_of, bucket, counts = _rowwise_counts(
+                    buckets[codes].reshape(sub.shape), domain=m)
+                return row_of + lo, bucket, counts
+
+            parts = map_row_shards(shard, n)
+            row_of = np.concatenate([p[0] for p in parts])
+            bucket = np.concatenate([p[1] for p in parts])
+            counts = np.concatenate([p[2] for p in parts])
             values = (np.ones(len(bucket)) if self.binary
                       else counts.astype(np.float64))
             out = _build_sparse_rows(n, m, row_of, bucket, values)
@@ -521,13 +534,35 @@ class FeatureHasher(Transformer, HasInputCols, HasOutputCol, HasNumFeatures,
         m = self.num_features
         n = table.num_rows
         categorical = set(self.categorical_cols or ())
+        cols = {name: np.asarray(table.column(name))
+                for name in self.input_cols}
+        from flink_ml_tpu.common.hostpool import map_row_shards
+
+        def shard(lo, hi):
+            row_of, bucket, sums = self._hash_rows(cols, categorical, m,
+                                                   lo, hi)
+            return row_of + lo, bucket, sums
+
+        parts = map_row_shards(shard, n)
+        out = _build_sparse_rows(
+            n, m,
+            np.concatenate([p[0] for p in parts]),
+            np.concatenate([p[1] for p in parts]),
+            np.concatenate([p[2] for p in parts]))
+        return (table.with_column(self.output_col, out),)
+
+    def _hash_rows(self, cols, categorical, m, lo, hi):
+        """Hash rows [lo, hi) of the input columns into shard-local
+        (row, bucket, value-sum) triples — the per-worker body of the
+        host-pool fan-out."""
+        n = hi - lo
 
         # per column: an (n,) int64 bucket array + an (n,) float64 value
         # array; numeric columns hash their NAME once, categorical columns
         # hash each distinct "name=value" once
         idx_cols, val_cols = [], []
         for name in self.input_cols:
-            col = np.asarray(table.column(name))
+            col = cols[name][lo:hi]
             numeric_dtype = (col.dtype != object
                              and not col.dtype.kind in ("U", "S", "b"))
             if name not in categorical and numeric_dtype:
@@ -601,9 +636,7 @@ class FeatureHasher(Transformer, HasInputCols, HasOutputCol, HasNumFeatures,
                      out=change[:, 1:])
         starts = np.flatnonzero(change.reshape(-1))
         sums = np.add.reduceat(val_sorted.reshape(-1), starts)
-        out = _build_sparse_rows(n, m, starts // k,
-                                 bucket_sorted.reshape(-1)[starts], sums)
-        return (table.with_column(self.output_col, out),)
+        return starts // k, bucket_sorted.reshape(-1)[starts], sums
 
 
 # ---------------------------------------------------------------------------

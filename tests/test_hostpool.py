@@ -83,9 +83,46 @@ def test_countvectorizer_fit_pool_parity(monkeypatch):
     cv = CountVectorizer(input_col="docs", output_col="v", min_df=2.0)
     monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "1")
     serial = cv.fit(t).vocabulary
-    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "4")
-    monkeypatch.setattr(
-        "flink_ml_tpu.common.hostpool.map_row_shards",
-        lambda fn, n, **kw: map_row_shards(fn, n, min_rows=64))
+    _forced_pool(monkeypatch)
     pooled = cv.fit(t).vocabulary
     assert serial == pooled
+
+
+def _forced_pool(monkeypatch, workers=4, min_rows=64):
+    import flink_ml_tpu.common.hostpool as hp
+
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", str(workers))
+    orig = map_row_shards
+    monkeypatch.setattr(hp, "map_row_shards",
+                        lambda fn, n, **kw: orig(fn, n, min_rows=min_rows))
+
+
+def test_featurehasher_pool_parity(monkeypatch):
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import FeatureHasher
+
+    rng = np.random.default_rng(0)
+    t = Table.from_columns(
+        f0=rng.integers(0, 5, 3000).astype(np.float64),
+        f1=rng.random(3000),
+        f2=np.array([f"c{i % 7}" for i in range(3000)]))
+    fh = FeatureHasher(input_cols=["f0", "f1", "f2"],
+                       categorical_cols=["f0"], num_features=128)
+    serial = fh.transform(t)[0].column("output").matrix
+    _forced_pool(monkeypatch)
+    pooled = fh.transform(t)[0].column("output").matrix
+    assert (serial != pooled).nnz == 0
+
+
+def test_hashingtf_pool_parity(monkeypatch):
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import HashingTF
+
+    rng = np.random.default_rng(1)
+    toks = np.array([f"t{v}" for v in range(40)])
+    t = Table.from_columns(tok=toks[rng.integers(0, 40, (3000, 6))])
+    htf = HashingTF(input_col="tok", output_col="o", num_features=64)
+    serial = htf.transform(t)[0].column("o").matrix
+    _forced_pool(monkeypatch)
+    pooled = htf.transform(t)[0].column("o").matrix
+    assert (serial != pooled).nnz == 0
