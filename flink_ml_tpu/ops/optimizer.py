@@ -92,14 +92,22 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
         lb = jnp.minimum(lb_base + (task_id < lb_rem).astype(jnp.int32),
                          local_n)
 
-        # minibatch slice with clip-at-end + wrap-to-zero
-        rel = jnp.arange(lb_max)
-        idx = offset + rel
-        valid = jnp.logical_and(rel < lb, idx < local_n)
-        idx = jnp.where(valid, idx, 0)
-        xb = jnp.where(valid[:, None], xl[idx], 0)
-        yb = yl[idx]
-        wb = wl[idx] * valid.astype(xl.dtype)
+        # minibatch slice with clip-at-end + wrap-to-zero (the reference's
+        # contiguous subList, SGD.java:262-284) as ONE dynamic-slice DMA
+        # instead of a row gather — a contiguous HBM window, not per-row
+        # addressing. dynamic_slice clamps its start to keep the window
+        # in bounds, so validity is remapped to SOURCE rows: rows outside
+        # [offset, offset+lb) ∩ [0, local_n) get weight 0, and the
+        # weight-scaled losses (losses.py terms — loss and multipliers
+        # are both `weights * ...`) zero their loss and gradient exactly;
+        # the batch values themselves need no masking.
+        start = jnp.minimum(offset, local_n - lb_max)
+        xb = jax.lax.dynamic_slice_in_dim(xl, start, lb_max, axis=0)
+        yb = jax.lax.dynamic_slice_in_dim(yl, start, lb_max, axis=0)
+        ws = jax.lax.dynamic_slice_in_dim(wl, start, lb_max, axis=0)
+        src = start + jnp.arange(lb_max)
+        valid = jnp.logical_and(src >= offset, src < offset + lb)
+        wb = ws * valid.astype(xl.dtype)
 
         if model_axis is None:
             loss_sum, grad_sum = loss_func.loss_and_gradient(coeffs, xb, yb,
