@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Optional
 
 import numpy as np
@@ -63,6 +64,42 @@ class SGDParams:
     elastic_net: float = 0.0
 
 
+def _sgd_update_math(loss_func, prm: SGDParams, axes, model_axis=None):
+    """The post-slice math of one round — loss/gradient on the minibatch,
+    the fused [grad, weight, loss] psum (the reference's feedbackArray
+    layout, SGD.java:190), the model update + regularization
+    (SGD.java:231-243) — shared by the while-loop, unrolled and host-driven
+    programs so a change here propagates to every fit path.
+
+    Returns ``update(coeffs, xb, yb, wb) -> (new_coeffs, mean_loss)``; must
+    be called inside shard_map over the mesh's data ``axes``."""
+
+    def update(coeffs, xb, yb, wb):
+        if model_axis is None:
+            loss_sum, grad_sum = loss_func.loss_and_gradient(coeffs, xb, yb,
+                                                             wb)
+        else:
+            dots = jax.lax.psum(xb @ coeffs, model_axis)
+            loss_sum, multipliers = loss_func.terms(dots, yb, wb)
+            grad_sum = xb.T @ multipliers  # local feature shard
+        packed = jnp.concatenate([
+            grad_sum, jnp.sum(wb)[None].astype(grad_sum.dtype),
+            loss_sum[None]])
+        packed = jax.lax.psum(packed, axes)
+        grad, total_w, total_loss = packed[:-2], packed[-2], packed[-1]
+
+        # ref updateModel (SGD.java:231-243); skip when no weight
+        updated = coeffs - (prm.learning_rate
+                            / jnp.maximum(total_w, 1e-30)) * grad
+        updated, _ = regularize(updated, prm.reg, prm.elastic_net,
+                                prm.learning_rate)
+        coeffs_out = jnp.where(total_w > 0, updated, coeffs)
+        mean_loss = total_loss / jnp.maximum(total_w, 1e-30)
+        return coeffs_out, mean_loss
+
+    return update
+
+
 def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
                     model_axis=None):
     """The per-shard math of ONE training round — shared verbatim by the
@@ -83,6 +120,7 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
     shard, and the loss/weight reduction crosses the data axes only."""
     gb = prm.global_batch_size
     lb_base, lb_rem = gb // p, gb % p
+    update = _sgd_update_math(loss_func, prm, axes, model_axis)
 
     def round_step(xl, yl, wl, coeffs, offset):
         local_n = xl.shape[0]  # static at trace time
@@ -109,30 +147,8 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
         valid = jnp.logical_and(src >= offset, src < offset + lb)
         wb = ws * valid.astype(xl.dtype)
 
-        if model_axis is None:
-            loss_sum, grad_sum = loss_func.loss_and_gradient(coeffs, xb, yb,
-                                                             wb)
-        else:
-            dots = jax.lax.psum(xb @ coeffs, model_axis)
-            loss_sum, multipliers = loss_func.terms(dots, yb, wb)
-            grad_sum = xb.T @ multipliers  # local feature shard
-        # one fused all-reduce over [grad, weight, loss] (the
-        # reference's feedbackArray layout, SGD.java:190)
-        packed = jnp.concatenate([
-            grad_sum, jnp.sum(wb)[None].astype(grad_sum.dtype),
-            loss_sum[None]])
-        packed = jax.lax.psum(packed, axes)
-        grad, total_w, total_loss = packed[:-2], packed[-2], packed[-1]
-
-        # ref updateModel (SGD.java:231-243); skip when no weight
-        updated = coeffs - (prm.learning_rate
-                            / jnp.maximum(total_w, 1e-30)) * grad
-        updated, _ = regularize(updated, prm.reg, prm.elastic_net,
-                                prm.learning_rate)
-        coeffs = jnp.where(total_w > 0, updated, coeffs)
-
+        coeffs, mean_loss = update(coeffs, xb, yb, wb)
         new_offset = jnp.where(offset + lb >= local_n, 0, offset + lb)
-        mean_loss = total_loss / jnp.maximum(total_w, 1e-30)
         return coeffs, new_offset, mean_loss
 
     return round_step
@@ -181,6 +197,82 @@ def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams):
         per_shard, mesh=mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
                   P(spec0), P(), P()),
+        out_specs=(wspec, P(spec0), P(), P(), P()), check_vma=False))
+
+
+#: plain fits with at most this many rounds compile fully unrolled with
+#: STATIC slice starts (the offset schedule is data-independent) — no
+#: dynamic-slice machinery, no while-loop: XLA sees max_iter static-offset
+#: windows and can pipeline their HBM reads. Large max_iter keeps the
+#: while program (compile time scales with the unroll).
+_UNROLL_MAX_ROUNDS = int(os.environ.get(
+    "FLINK_ML_TPU_SGD_UNROLL_MAX", "64"))
+
+
+def _static_batch_schedule(local_n: int, lb: int, max_iter: int):
+    """The per-shard minibatch schedule as Python ints — valid because the
+    reference's slicing (SGD.java:262-284) depends only on (n, batch), not
+    on data: round r slices [start, start+lb) with clip-at-end and
+    wrap-to-zero. Returns [(start, first_valid)] per round; rows before
+    ``first_valid`` (clip overlap) weigh 0. Requires offset 0 at entry and
+    a uniform lb (gb % p == 0)."""
+    sched, offset = [], 0
+    for _ in range(max_iter):
+        start = min(offset, local_n - lb)
+        sched.append((start, offset - start))  # offset-start == 0 unless clipped
+        offset = 0 if offset + lb >= local_n else offset + lb
+    return sched
+
+
+@functools.lru_cache(maxsize=128)
+def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams):
+    """The plain (uncheckpointed, fresh-offset) fit as ONE fully-unrolled
+    SPMD program: ``fit(xs, ys, ws, coeffs, offsets) -> (coeffs, offsets,
+    mean_loss, epoch, stop)`` — the same carry as the segment program. The
+    tol early-exit becomes masking (rounds after the stop compute and are
+    discarded by ``where``), so the result — coeffs, final offsets, the
+    loss AT the stopping round, the executed-round count — is identical to
+    the while program's by construction. Only valid for offsets == 0 and
+    gb %% p == 0 (the dispatch in ``optimize`` guarantees both)."""
+    axes = data_axes(mesh)
+    spec0 = data_pspec(mesh)
+    p = data_shard_count(mesh)
+    model_axis = model_axis_of(mesh)
+    wspec = P(model_axis) if model_axis else P()
+    lb_base = prm.global_batch_size // p
+    assert prm.global_batch_size % p == 0
+    update = _sgd_update_math(loss_cls(), prm, axes, model_axis)
+
+    def per_shard(xl, yl, wl, coeffs, offsets):
+        local_n = xl.shape[0]
+        lb = min(lb_base, local_n)
+        sched = _static_batch_schedule(local_n, lb, prm.max_iter)
+        offset = offsets[0]
+        mean_loss = jnp.asarray(jnp.inf, coeffs.dtype)
+        epoch = jnp.int32(0)
+        stop = jnp.asarray(False)
+        for start, clip in sched:
+            xb = jax.lax.slice_in_dim(xl, start, start + lb, axis=0)
+            yb = jax.lax.slice_in_dim(yl, start, start + lb, axis=0)
+            wb = jax.lax.slice_in_dim(wl, start, start + lb, axis=0)
+            if clip:  # short batch at the shard end: clipped rows weigh 0
+                wb = wb * (np.arange(lb) >= clip).astype(xl.dtype)
+            updated, new_loss = update(coeffs, xb, yb, wb)
+            new_off = jnp.int32(0 if start + clip + lb >= local_n
+                                else start + clip + lb)
+            active = jnp.logical_not(stop)
+            coeffs = jnp.where(active, updated, coeffs)
+            offset = jnp.where(active, new_off, offset)
+            mean_loss = jnp.where(active, new_loss, mean_loss)
+            epoch = epoch + active.astype(jnp.int32)
+            stop = jnp.logical_or(stop, jnp.logical_and(
+                active, new_loss < prm.tol))
+        return coeffs, offset[None], mean_loss, epoch, stop
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
+                  P(spec0)),
         out_specs=(wspec, P(spec0), P(), P(), P()), check_vma=False))
 
 
@@ -382,7 +474,19 @@ class SGD:
         if seg_k or not needs_host_loop(config, listeners):
             # the compiled fast path: a plain fit is one max_iter segment;
             # a checkpointed fit runs K-round segments with the carry
-            # snapshotted between them (same single program either way)
+            # snapshotted between them (same single program either way).
+            # A plain fit with a uniform batch share and a bounded round
+            # count compiles fully UNROLLED instead: the offset schedule
+            # is data-independent, so every slice start is static — no
+            # dynamic-slice machinery, no while-loop (results identical
+            # by construction; see _build_sgd_unrolled_program).
+            if (not seg_k and self.params.global_batch_size % p == 0
+                    and 0 < self.params.max_iter <= _UNROLL_MAX_ROUNDS):
+                prog = _build_sgd_unrolled_program(type(loss_func), mesh,
+                                                   self.params)
+                coeffs, _, mean_loss, _, _ = prog(xs, ys, ws, init[0],
+                                                  init[1])
+                return np.asarray(coeffs, np.float64)[:d], float(mean_loss)
             seg_prog = _build_sgd_segment_program(type(loss_func), mesh,
                                                   self.params)
 

@@ -1,0 +1,49 @@
+"""Wait for the axon TPU tunnel, then run the round-4 benchmark sweep.
+
+Same tunnel discipline as tpu_wait_and_remeasure.py (probe in a
+subprocess, never kill an in-flight probe, back off on fast failures,
+outages can last hours) but the payload is the full priority-ordered
+sweep into benchmark_results_r4.json with --resume, so repeated
+invocations after partial outages only measure what is still missing.
+
+Run: python scripts/tpu_wait_and_sweep.py [budget_seconds]
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tpu_wait_and_remeasure import wait_backend  # noqa: E402 — one probe impl
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def main() -> int:
+    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 28800.0
+    deadline = time.monotonic() + budget
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        print(f"attempt {attempt}: waiting for backend...", flush=True)
+        if not wait_backend(deadline):
+            print("backend never came up within budget", flush=True)
+            return 1
+        print(f"attempt {attempt}: backend live, sweeping", flush=True)
+        rc = subprocess.call(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "run_benchmark_sweep.py"),
+             "--output-file", os.path.join(REPO,
+                                           "benchmark_results_r4.json"),
+             "--chart", os.path.join(REPO, "benchmark_results_r4.png"),
+             "--budget-s", "150", "--resume"])
+        print(f"attempt {attempt}: sweep rc={rc}", flush=True)
+        if rc == 0:
+            return 0
+        time.sleep(90)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

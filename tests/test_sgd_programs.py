@@ -1,0 +1,130 @@
+"""The two compiled SGD fit programs must be interchangeable: the
+fully-unrolled static-schedule program (plain fits, bounded rounds) and the
+while-loop segment program (checkpointed fits, large round counts) are both
+built from the reference's round semantics (SGD.java:206-213, 231-243,
+262-284) and must produce identical results — including the clip-at-end /
+wrap-to-zero batch schedule and the tol early-exit.
+"""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.ops import optimizer as opt_mod
+from flink_ml_tpu.ops.losses import (
+    BinaryLogisticLoss,
+    HingeLoss,
+    LeastSquareLoss,
+)
+from flink_ml_tpu.ops.optimizer import SGD, SGDParams
+from flink_ml_tpu.parallel import create_mesh
+
+
+def _fit_both_ways(monkeypatch, prm, loss, x, y, w=None, mesh=None):
+    """Run optimize() through the unrolled dispatch and (by disabling the
+    unroll) through the while/segment program; return both results."""
+    d = x.shape[1]
+    sgd = SGD(prm)
+    coeffs_u, loss_u = sgd.optimize(loss, np.zeros(d), x, y, w, mesh=mesh)
+    monkeypatch.setattr(opt_mod, "_UNROLL_MAX_ROUNDS", 0)
+    coeffs_w, loss_w = sgd.optimize(loss, np.zeros(d), x, y, w, mesh=mesh)
+    monkeypatch.undo()
+    return (coeffs_u, loss_u), (coeffs_w, loss_w)
+
+
+@pytest.mark.parametrize("loss_cls", [BinaryLogisticLoss, HingeLoss,
+                                      LeastSquareLoss])
+def test_unrolled_matches_while_program(monkeypatch, rng, loss_cls):
+    x = rng.normal(size=(1000, 8))
+    y = (rng.random(1000) > 0.5).astype(np.float64)
+    prm = SGDParams(learning_rate=0.05, global_batch_size=160, max_iter=7,
+                    tol=0.0, reg=0.0)
+    (cu, lu), (cw, lw) = _fit_both_ways(monkeypatch, prm, loss_cls(), x, y)
+    np.testing.assert_allclose(cu, cw, rtol=1e-6, atol=1e-12)
+    np.testing.assert_allclose(lu, lw, rtol=1e-6)
+
+
+def test_unrolled_clip_and_wrap_schedule(monkeypatch, rng):
+    # shard length 125 on the 8-device mesh, lb 20: round 7 clips at the
+    # shard end (start 105, 15 zero-weight rows), round 8 wraps to zero —
+    # the exact subList semantics of SGD.java:262-284
+    x = rng.normal(size=(1000, 5))
+    y = (x @ rng.normal(size=5) > 0).astype(np.float64)
+    prm = SGDParams(learning_rate=0.1, global_batch_size=160, max_iter=9,
+                    tol=0.0)
+    (cu, lu), (cw, lw) = _fit_both_ways(monkeypatch, prm,
+                                        BinaryLogisticLoss(), x, y)
+    np.testing.assert_allclose(cu, cw, rtol=1e-6, atol=1e-12)
+    np.testing.assert_allclose(lu, lw, rtol=1e-6)
+
+
+def test_unrolled_tol_early_exit(monkeypatch, rng):
+    # a tol the first round already satisfies: the while program executes
+    # exactly one round; the unrolled program must mask rounds 2+ out and
+    # report the SAME coefficients and the round-1 loss
+    x = rng.normal(size=(400, 4))
+    y = (rng.random(400) > 0.5).astype(np.float64)
+    prm = SGDParams(learning_rate=0.05, global_batch_size=80, max_iter=6,
+                    tol=1e9)
+    (cu, lu), (cw, lw) = _fit_both_ways(monkeypatch, prm,
+                                        BinaryLogisticLoss(), x, y)
+    np.testing.assert_allclose(cu, cw, rtol=1e-6, atol=1e-12)
+    np.testing.assert_allclose(lu, lw, rtol=1e-6)
+    # one round of plain SGD from zeros — not six
+    prm_one = SGDParams(learning_rate=0.05, global_batch_size=80,
+                        max_iter=1, tol=0.0)
+    c1, l1 = SGD(prm_one).optimize(BinaryLogisticLoss(), np.zeros(4), x, y)
+    np.testing.assert_allclose(cu, c1, rtol=1e-6, atol=1e-12)
+
+
+def test_unrolled_weighted_and_regularized(monkeypatch, rng):
+    x = rng.normal(size=(600, 6))
+    y = (rng.random(600) > 0.5).astype(np.float64)
+    w = rng.random(600) + 0.5
+    prm = SGDParams(learning_rate=0.1, global_batch_size=240, max_iter=5,
+                    tol=0.0, reg=0.02, elastic_net=0.4)
+    (cu, lu), (cw, lw) = _fit_both_ways(monkeypatch, prm,
+                                        BinaryLogisticLoss(), x, y, w)
+    np.testing.assert_allclose(cu, cw, rtol=1e-6, atol=1e-12)
+    np.testing.assert_allclose(lu, lw, rtol=1e-6)
+
+
+def test_unrolled_tensor_parallel_mesh(monkeypatch, rng):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = create_mesh((4, 2), ("data", "model"))
+    x = rng.normal(size=(800, 10))
+    y = (rng.random(800) > 0.5).astype(np.float64)
+    prm = SGDParams(learning_rate=0.1, global_batch_size=200, max_iter=5,
+                    tol=0.0)
+    (cu, lu), (cw, lw) = _fit_both_ways(monkeypatch, prm,
+                                        BinaryLogisticLoss(), x, y,
+                                        mesh=mesh)
+    np.testing.assert_allclose(cu, cw, rtol=1e-6, atol=1e-12)
+    np.testing.assert_allclose(lu, lw, rtol=1e-6)
+
+
+def test_dispatch_gates(monkeypatch, rng):
+    # gb % p != 0 or max_iter beyond the unroll cap must fall back to the
+    # while program (no unrolled compile) — and still fit correctly
+    x = rng.normal(size=(300, 3))
+    y = (rng.random(300) > 0.5).astype(np.float64)
+    called = []
+    orig = opt_mod._build_sgd_unrolled_program
+
+    def spy(*a, **k):
+        called.append(True)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(opt_mod, "_build_sgd_unrolled_program", spy)
+    prm = SGDParams(global_batch_size=31, max_iter=3)  # 31 % 8 != 0
+    SGD(prm).optimize(BinaryLogisticLoss(), np.zeros(3), x, y)
+    assert not called
+    prm = SGDParams(global_batch_size=32,
+                    max_iter=opt_mod._UNROLL_MAX_ROUNDS + 1)
+    SGD(prm).optimize(BinaryLogisticLoss(), np.zeros(3), x, y)
+    assert not called
+    prm = SGDParams(global_batch_size=32, max_iter=3)
+    SGD(prm).optimize(BinaryLogisticLoss(), np.zeros(3), x, y)
+    assert called
