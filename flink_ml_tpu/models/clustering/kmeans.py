@@ -21,6 +21,8 @@ keeps its previous centroid instead of producing NaN.
 
 from __future__ import annotations
 
+import functools
+import os
 from typing import Optional, Tuple
 
 import numpy as np
@@ -58,8 +60,6 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
         ParamValidators.in_array("random"))
 
 
-import functools
-
 
 @functools.lru_cache(maxsize=32)
 def _build_assign_program(measure_name: str):
@@ -96,9 +96,13 @@ def _lloyd_round_math(measure, axes):
 
 
 @functools.lru_cache(maxsize=32)
-def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
+def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
+                         unroll: bool = False):
     """One compiled Lloyd's program per (mesh, measure, maxIter); k and
-    shapes are trace-time static, handled by jit's shape cache."""
+    shapes are trace-time static, handled by jit's shape cache. With
+    ``unroll`` the static round count compiles as a straight-line Python
+    loop instead of a while_loop — identical results by construction (one
+    round_step, one builder), but XLA may pipeline across rounds."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     round_step = _lloyd_round_math(
@@ -107,18 +111,22 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
     def per_shard(xl, n_valid, c0):
         k = c0.shape[0]
         vl = local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
+        centroids, counts = c0, jnp.zeros((k,), xl.dtype)
+        if unroll:
+            for _ in range(max_iter):
+                centroids, counts = round_step(xl, vl, centroids)
+        else:
+            def cond(state):
+                _, _, epoch = state
+                return epoch < max_iter
 
-        def cond(state):
-            _, _, epoch = state
-            return epoch < max_iter
+            def step(state):
+                centroids, counts, epoch = state
+                centroids, counts = round_step(xl, vl, centroids)
+                return centroids, counts, epoch + 1
 
-        def step(state):
-            centroids, _, epoch = state
-            centroids, counts = round_step(xl, vl, centroids)
-            return centroids, counts, epoch + 1
-
-        centroids, counts, _ = jax.lax.while_loop(
-            cond, step, (c0, jnp.zeros((k,), xl.dtype), jnp.int32(0)))
+            centroids, counts, _ = jax.lax.while_loop(
+                cond, step, (centroids, counts, jnp.int32(0)))
         # one packed output = one device->host fetch for the whole fit
         return jnp.concatenate([centroids, counts[:, None]], axis=1)
 
@@ -126,6 +134,15 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int):
         per_shard, mesh=mesh,
         in_specs=(P(spec0, None), P(), P()),
         out_specs=P(), check_vma=False))
+
+
+#: fits with at most this many rounds compile fully unrolled — Lloyd's has
+#: no data-dependent exit (TerminateOnMaxIter only, ref KMeans.java:150),
+#: so the unrolled body is just max_iter repetitions XLA can pipeline
+#: (same rationale and escape hatch as optimizer._UNROLL_MAX_ROUNDS:
+#: compile time scales with the unroll; 0 disables unrolling)
+_UNROLL_MAX_ROUNDS = int(os.environ.get(
+    "FLINK_ML_TPU_LLOYD_UNROLL_MAX", "64"))
 
 
 @functools.lru_cache(maxsize=32)
@@ -232,8 +249,9 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                                                       needs_host_loop)
         if not needs_host_loop(self._iteration_config,
                                self._iteration_listeners):
-            fit = _build_lloyd_program(mesh, self.distance_measure,
-                                       self.max_iter)
+            fit = _build_lloyd_program(
+                mesh, self.distance_measure, self.max_iter,
+                unroll=self.max_iter <= _UNROLL_MAX_ROUNDS)
             packed = np.asarray(fit(xs, n_valid, jnp.asarray(init)))
             centroids, counts = packed[:, :-1], packed[:, -1]
         else:

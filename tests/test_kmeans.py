@@ -120,3 +120,24 @@ def test_pipeline_with_kmeans(rng, tmp_path):
     pm.save(str(tmp_path / "pipe"))
     out2 = PipelineModel.load(str(tmp_path / "pipe")).transform(table)[0]
     np.testing.assert_array_equal(out["prediction"], out2["prediction"])
+
+
+def test_unrolled_lloyd_matches_while_program(rng):
+    """The unrolled fit program (static round count) must equal the
+    while-loop program — same round_step, same order."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.clustering.kmeans import _build_lloyd_program
+    from flink_ml_tpu.parallel.collective import ensure_on_mesh
+    from flink_ml_tpu.parallel.mesh import data_axes, default_mesh
+
+    mesh = default_mesh()
+    x = rng.random((500, 6)).astype(np.float32)
+    init = jnp.asarray(x[:4])
+    xs, _ = ensure_on_mesh(mesh, x, data_axes(mesh), jnp.float32)
+    for measure in ("euclidean", "manhattan", "cosine"):
+        a = np.asarray(_build_lloyd_program(mesh, measure, 5, unroll=True)(
+            xs, jnp.int32(500), init))
+        b = np.asarray(_build_lloyd_program(mesh, measure, 5, unroll=False)(
+            xs, jnp.int32(500), init))
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-12)
