@@ -72,19 +72,32 @@ def _build_assign_program(measure_name: str):
     return assign
 
 
-def _lloyd_round_math(measure, axes):
+def _lloyd_round_math(measure, axes, partials_fn=None):
     """The per-shard math of ONE Lloyd round — shared verbatim by the
-    all-device while_loop program and the host-driven round program so the
-    two modes stay numerically identical by construction. Must be called
-    inside shard_map over the mesh's data axes (flat or dcn-hybrid)."""
+    all-device programs and the host-driven round program so every mode
+    stays numerically identical by construction. Must be called inside
+    shard_map over the mesh's data axes (flat or dcn-hybrid).
 
-    def round_step(xl, vl, centroids):
+    ``partials_fn(xl, vl, centroids) -> (k, d+1)`` overrides how the
+    local [weighted sums | counts] partials are computed (the fused
+    pallas kernel); the cross-shard psum and the empty-cluster-preserving
+    renormalization stay shared either way. Caveat scoping the identity
+    claim: the kernel's csq − 2·x·cᵀ assignment can differ from
+    ``measure.pairwise`` in float rounding for near-tie points, so a
+    kernel-partialed fit matches the XLA programs up to tie-breaks (the
+    same asymmetry the predict path accepts for ``assign_nearest``) —
+    modes sharing ``partials_fn=None`` remain bit-identical."""
+
+    def local_partials(xl, vl, centroids):
         k = centroids.shape[0]
         dists = measure.pairwise(xl, centroids)
         one_hot = jax.nn.one_hot(jnp.argmin(dists, axis=1), k,
                                  dtype=xl.dtype) * vl[:, None]
-        packed = jnp.concatenate(
+        return jnp.concatenate(
             [one_hot.T @ xl, jnp.sum(one_hot, axis=0)[:, None]], axis=1)
+
+    def round_step(xl, vl, centroids):
+        packed = (partials_fn or local_partials)(xl, vl, centroids)
         packed = jax.lax.psum(packed, axes)
         sums, counts = packed[:, :-1], packed[:, -1]
         new_centroids = jnp.where(
@@ -97,20 +110,34 @@ def _lloyd_round_math(measure, axes):
 
 @functools.lru_cache(maxsize=32)
 def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
-                         unroll: bool = False):
+                         unroll: bool = False, use_kernel: bool = False):
     """One compiled Lloyd's program per (mesh, measure, maxIter); k and
     shapes are trace-time static, handled by jit's shape cache. With
     ``unroll`` the static round count compiles as a straight-line Python
     loop instead of a while_loop — identical results by construction (one
-    round_step, one builder), but XLA may pipeline across rounds."""
+    round_step, one builder), but XLA may pipeline across rounds. With
+    ``use_kernel`` (TPU + euclidean) the per-shard partials come from the
+    fused pallas assign+accumulate kernel: each round reads the shard
+    once instead of once per sub-op; the shard is zero-weight-padded to
+    the kernel tile ONCE, outside the rounds."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
+    partials_fn = None
+    if use_kernel:
+        from flink_ml_tpu.ops.pallas_kernels import lloyd_partial_sums
+        partials_fn = lloyd_partial_sums
     round_step = _lloyd_round_math(
-        DistanceMeasure.get_instance(measure_name), axes)
+        DistanceMeasure.get_instance(measure_name), axes, partials_fn)
 
     def per_shard(xl, n_valid, c0):
         k = c0.shape[0]
         vl = local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
+        if use_kernel:
+            from flink_ml_tpu.ops.pallas_kernels import TILE_N
+            pad = (-xl.shape[0]) % TILE_N
+            if pad:  # once per fit, not per round (loop-invariant)
+                xl = jnp.pad(xl, ((0, pad), (0, 0)))
+                vl = jnp.pad(vl, (0, pad))
         centroids, counts = c0, jnp.zeros((k,), xl.dtype)
         if unroll:
             for _ in range(max_iter):
@@ -167,6 +194,19 @@ def _build_lloyd_round_program(mesh, measure_name: str):
 # set on the first pallas lowering failure so later transforms skip straight
 # to the XLA path instead of re-tracing the kernel to the same exception
 _pallas_assign_broken = False
+
+# same policy for the fused fit-round kernel (independent lowering)
+_pallas_lloyd_broken = False
+
+
+def _is_pallas_failure(e: Exception) -> bool:
+    """Heuristic: does this exception come from the pallas/Mosaic stack
+    (lowering, compile, or kernel execution) rather than from the fit
+    itself (e.g. RESOURCE_EXHAUSTED on a too-large dataset)?"""
+    text = f"{type(e).__name__}: {e}"
+    if "RESOURCE_EXHAUSTED" in text:
+        return False
+    return any(s in text for s in ("Mosaic", "mosaic", "pallas", "Pallas"))
 
 
 class KMeansModel(Model, KMeansModelParams):
@@ -249,10 +289,37 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                                                       needs_host_loop)
         if not needs_host_loop(self._iteration_config,
                                self._iteration_listeners):
-            fit = _build_lloyd_program(
-                mesh, self.distance_measure, self.max_iter,
-                unroll=self.max_iter <= _UNROLL_MAX_ROUNDS)
-            packed = np.asarray(fit(xs, n_valid, jnp.asarray(init)))
+            from flink_ml_tpu.ops.pallas_kernels import (
+                LLOYD_VMEM_ACCUM_BYTES, pallas_supported)
+            global _pallas_lloyd_broken
+            unroll = self.max_iter <= _UNROLL_MAX_ROUNDS
+            use_kernel = (self.distance_measure == "euclidean"
+                          and pallas_supported()
+                          and not _pallas_lloyd_broken
+                          and k * (dim + 1) * 4 <= LLOYD_VMEM_ACCUM_BYTES)
+            try:
+                fit = _build_lloyd_program(
+                    mesh, self.distance_measure, self.max_iter,
+                    unroll=unroll, use_kernel=use_kernel)
+                packed = np.asarray(fit(xs, n_valid, jnp.asarray(init)))
+            except Exception as e:
+                if not use_kernel or not _is_pallas_failure(e):
+                    raise
+                # kernel lowering/compile failed: fall back to the XLA
+                # partials for the rest of the process, loudly (same
+                # policy as the assign/KNN kernels). Non-kernel failures
+                # (e.g. HBM OOM) re-raise above instead of being
+                # misattributed and silently retried.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "pallas Lloyd kernel failed; using the XLA fit path "
+                    "for the rest of this process", exc_info=True)
+                _pallas_lloyd_broken = True
+                fit = _build_lloyd_program(
+                    mesh, self.distance_measure, self.max_iter,
+                    unroll=unroll, use_kernel=False)
+                packed = np.asarray(fit(xs, n_valid, jnp.asarray(init)))
             centroids, counts = packed[:, :-1], packed[:, -1]
         else:
 

@@ -73,6 +73,85 @@ def pallas_supported() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# -- fused Lloyd round: assign + accumulate (KMeans fit) ---------------------
+
+#: the (k, d+1) partial-sum accumulator must stay in VMEM across grid steps
+#: alongside one (TILE_N, d) tile and the (k, d) centroids — callers gate
+#: use of the kernel on this (kmeans.fit)
+LLOYD_VMEM_ACCUM_BYTES = 4 << 20
+
+
+def _lloyd_accum_kernel(x_ref, v_ref, c_ref, csq_ref, out_ref):
+    """One row tile of a Lloyd round, entirely in VMEM: nearest-centroid
+    assignment and the weighted (sums, counts) accumulation read the tile
+    ONCE — the XLA round reads the shard for the pairwise matmul, again
+    for the row norms, and a third time for the one_hot.T @ x sums. The
+    TPU grid iterates sequentially per core, so out_ref accumulates
+    across tiles (init at step 0)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = x_ref[:]                       # (tile_n, d)
+    v = v_ref[:]                       # (tile_n, 1) validity weight
+    c = c_ref[:]                       # (k, d)
+    cross = jnp.dot(x, c.T, preferred_element_type=jnp.float32)
+    # ‖x−c‖² up to the per-point constant ‖x‖² (irrelevant to the argmin)
+    d2 = csq_ref[:][None, :] - 2.0 * cross
+    a = jnp.argmin(d2, axis=1)
+    k = c.shape[0]
+    one_hot = (a[:, None] == jax.lax.broadcasted_iota(
+        jnp.int32, (1, k), 1)).astype(jnp.float32) * v
+    sums = jnp.dot(one_hot.T, x, preferred_element_type=jnp.float32)
+    counts = jnp.sum(one_hot, axis=0)
+    out_ref[:] += jnp.concatenate([sums, counts[:, None]], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _lloyd_padded(x, v, centroids, interpret=False):
+    n, d = x.shape
+    k = centroids.shape[0]
+    csq = jnp.sum(centroids * centroids, axis=1)
+    return pl.pallas_call(
+        _lloyd_accum_kernel,
+        out_shape=jax.ShapeDtypeStruct((k, d + 1), jnp.float32),
+        grid=(n // TILE_N,),
+        in_specs=[
+            pl.BlockSpec((TILE_N, d), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_N, 1), lambda i: (i, 0)),
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((k,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((k, d + 1), lambda i: (0, 0)),
+        interpret=interpret,
+    )(x, v, centroids, csq)
+
+
+def lloyd_partial_sums(x, v, centroids, interpret: bool = False):
+    """Per-shard Lloyd partials — fused assign+accumulate, one pass over x.
+
+    x: (n, d) float32; v: (n,) float32 validity/weight (0 for padding);
+    centroids: (k, d) float32 → (k, d+1) float32 = [weighted sums | counts].
+    Pads n up to the tile size with zero-weight rows; euclidean only
+    (assignment by the same csq − 2·x·cᵀ argmin as ``assign_nearest``).
+    Callers psum the result across data shards and renormalize.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    v = jnp.asarray(v, jnp.float32)
+    centroids = jnp.asarray(centroids, jnp.float32)
+    n = x.shape[0]
+    if n == 0:  # empty grid would skip the step-0 init and return garbage
+        k, d = centroids.shape
+        return jnp.zeros((k, d + 1), jnp.float32)
+    pad = (-n) % TILE_N
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        v = jnp.pad(v, (0, pad))
+    return _lloyd_padded(x, v[:, None], centroids, interpret=interpret)
+
+
 # -- fused distance + top-k (KNN) -------------------------------------------
 
 KNN_TILE_N = 256

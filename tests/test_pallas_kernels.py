@@ -68,3 +68,100 @@ def test_knn_chunked_fallback_matches_single_shot(rng, monkeypatch):
     monkeypatch.setattr(knn_mod, "_MAX_DIST_ELEMS", 6 * 200)  # ~6-row chunks
     chunked = model.transform(t)[0]["prediction"]
     np.testing.assert_array_equal(np.asarray(expected), np.asarray(chunked))
+
+
+def test_lloyd_partial_sums_matches_xla(rng):
+    """The fused assign+accumulate kernel must equal the XLA partials
+    (one_hot.T @ x and counts) for well-separated data."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.ops.pallas_kernels import lloyd_partial_sums
+
+    k, d, n = 5, 8, 300
+    centers = rng.normal(size=(k, d)).astype(np.float32) * 10
+    assign = rng.integers(0, k, n)
+    x = (centers[assign] + rng.normal(size=(n, d)) * 0.1).astype(np.float32)
+    v = (rng.random(n) > 0.1).astype(np.float32)  # some zero-weight rows
+
+    got = np.asarray(lloyd_partial_sums(x, v, centers, interpret=True))
+
+    d2 = ((x[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+    a = d2.argmin(1)
+    one_hot = (a[:, None] == np.arange(k)[None, :]) * v[:, None]
+    want = np.concatenate([one_hot.T @ x, one_hot.sum(0)[:, None]], axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_lloyd_partial_sums_pads_zero_weight(rng):
+    """Rows added by tile padding must contribute nothing."""
+    from flink_ml_tpu.ops.pallas_kernels import TILE_N, lloyd_partial_sums
+
+    k, d = 3, 4
+    c = rng.normal(size=(k, d)).astype(np.float32)
+    x = rng.normal(size=(10, d)).astype(np.float32)  # far from TILE_N
+    v = np.ones(10, np.float32)
+    got = np.asarray(lloyd_partial_sums(x, v, c, interpret=True))
+    xp = np.zeros((TILE_N, d), np.float32)
+    xp[:10] = x
+    vp = np.zeros(TILE_N, np.float32)
+    vp[:10] = 1.0
+    got_pre = np.asarray(lloyd_partial_sums(xp, vp, c, interpret=True))
+    np.testing.assert_allclose(got, got_pre, rtol=1e-5)
+    assert got[:, -1].sum() == 10.0
+
+
+def test_lloyd_fit_program_with_kernel_partials(rng):
+    """The full fit program with kernel partials (interpret-mode pallas
+    inside shard_map) must match the XLA fit program."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.clustering import kmeans as km
+    from flink_ml_tpu.ops import pallas_kernels as pk
+    from flink_ml_tpu.parallel.collective import ensure_on_mesh
+    from flink_ml_tpu.parallel.mesh import data_axes, default_mesh
+
+    mesh = default_mesh()
+    k, d, n = 4, 6, 500
+    centers = rng.normal(size=(k, d)).astype(np.float32) * 10
+    x = (centers[rng.integers(0, k, n)]
+         + rng.normal(size=(n, d)) * 0.1).astype(np.float32)
+    init = jnp.asarray(x[:k])
+    xs, _ = ensure_on_mesh(mesh, x, data_axes(mesh), jnp.float32)
+
+    partials = km._lloyd_round_math(
+        None, data_axes(mesh),
+        lambda xl, vl, c: pk.lloyd_partial_sums(xl, vl, c, interpret=True))
+    # build a one-off interpret-mode fit mirroring _build_lloyd_program
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from flink_ml_tpu.parallel.collective import local_valid_mask
+    from flink_ml_tpu.parallel.mesh import data_pspec
+
+    spec0 = data_pspec(mesh)
+
+    def per_shard(xl, n_valid, c0):
+        vl = local_valid_mask(data_axes(mesh), xl.shape[0], n_valid,
+                              xl.dtype)
+        centroids = c0
+        for _ in range(3):
+            centroids, counts = partials(xl, vl, centroids)
+        return jnp.concatenate([centroids, counts[:, None]], axis=1)
+
+    fit_k = jax.jit(jax.shard_map(
+        per_shard, mesh=mesh, in_specs=(P(spec0, None), P(), P()),
+        out_specs=P(), check_vma=False))
+    got = np.asarray(fit_k(xs, jnp.int32(n), init))
+    want = np.asarray(km._build_lloyd_program(mesh, "euclidean", 3,
+                                              unroll=True)(
+        xs, jnp.int32(n), init))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_lloyd_partial_sums_empty_input(rng):
+    from flink_ml_tpu.ops.pallas_kernels import lloyd_partial_sums
+
+    c = rng.normal(size=(3, 4)).astype(np.float32)
+    got = np.asarray(lloyd_partial_sums(
+        np.zeros((0, 4), np.float32), np.zeros(0, np.float32), c,
+        interpret=True))
+    np.testing.assert_array_equal(got, np.zeros((3, 5), np.float32))
