@@ -201,12 +201,13 @@ _pallas_lloyd_broken = False
 
 def _is_pallas_failure(e: Exception) -> bool:
     """Heuristic: does this exception come from the pallas/Mosaic stack
-    (lowering, compile, or kernel execution) rather than from the fit
-    itself (e.g. RESOURCE_EXHAUSTED on a too-large dataset)?"""
+    (lowering, compile, or kernel execution — including a Mosaic VMEM
+    exhaustion) rather than from the fit itself (e.g. an HBM
+    RESOURCE_EXHAUSTED on a too-large dataset, whose message carries no
+    Mosaic/vmem marker)?"""
     text = f"{type(e).__name__}: {e}"
-    if "RESOURCE_EXHAUSTED" in text:
-        return False
-    return any(s in text for s in ("Mosaic", "mosaic", "pallas", "Pallas"))
+    return any(s in text for s in ("Mosaic", "mosaic", "pallas", "Pallas",
+                                   "memory space vmem"))
 
 
 class KMeansModel(Model, KMeansModelParams):
@@ -290,13 +291,13 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
         if not needs_host_loop(self._iteration_config,
                                self._iteration_listeners):
             from flink_ml_tpu.ops.pallas_kernels import (
-                LLOYD_VMEM_ACCUM_BYTES, pallas_supported)
+                lloyd_kernel_fits, pallas_supported)
             global _pallas_lloyd_broken
             unroll = self.max_iter <= _UNROLL_MAX_ROUNDS
             use_kernel = (self.distance_measure == "euclidean"
                           and pallas_supported()
                           and not _pallas_lloyd_broken
-                          and k * (dim + 1) * 4 <= LLOYD_VMEM_ACCUM_BYTES)
+                          and lloyd_kernel_fits(k, dim))
             try:
                 fit = _build_lloyd_program(
                     mesh, self.distance_measure, self.max_iter,

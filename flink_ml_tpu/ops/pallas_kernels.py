@@ -75,10 +75,18 @@ def pallas_supported() -> bool:
 
 # -- fused Lloyd round: assign + accumulate (KMeans fit) ---------------------
 
-#: the (k, d+1) partial-sum accumulator must stay in VMEM across grid steps
-#: alongside one (TILE_N, d) tile and the (k, d) centroids — callers gate
-#: use of the kernel on this (kmeans.fit)
-LLOYD_VMEM_ACCUM_BYTES = 4 << 20
+#: VMEM the kernel's working set may claim: double-buffered (TILE_N, d)
+#: x tiles, the (TILE_N, k) distance/one-hot blocks, the (k, d) centroids
+#: and the (k, d+1) accumulator that persists across grid steps
+LLOYD_VMEM_BUDGET_BYTES = 8 << 20
+
+
+def lloyd_kernel_fits(k: int, d: int) -> bool:
+    """True when the fused Lloyd kernel's working set fits the VMEM
+    budget for these shapes — the gate kmeans.fit applies."""
+    working = (2 * TILE_N * d + 3 * TILE_N * k + k * d
+               + 2 * k * (d + 1)) * 4
+    return working <= LLOYD_VMEM_BUDGET_BYTES
 
 
 def _lloyd_accum_kernel(x_ref, v_ref, c_ref, csq_ref, out_ref):

@@ -73,8 +73,6 @@ def test_knn_chunked_fallback_matches_single_shot(rng, monkeypatch):
 def test_lloyd_partial_sums_matches_xla(rng):
     """The fused assign+accumulate kernel must equal the XLA partials
     (one_hot.T @ x and counts) for well-separated data."""
-    import jax.numpy as jnp
-
     from flink_ml_tpu.ops.pallas_kernels import lloyd_partial_sums
 
     k, d, n = 5, 8, 300
