@@ -38,11 +38,16 @@ def _rand_program(shape, arity: int, sharding):
     import jax
     import jax.numpy as jnp
 
+    from flink_ml_tpu.parallel.collective import row_major_format
+
     def gen(key):
         u = jax.random.uniform(key, shape, jnp.float32)
         return jnp.floor(u * arity) if arity else u
 
-    return jax.jit(gen, out_shardings=sharding)
+    # random bits have no layout preference; pin row-major so consumers
+    # (the fit programs) never pay a full-input relayout copy
+    return jax.jit(gen,
+                   out_shardings=row_major_format(sharding, len(shape)))
 
 
 def _device_random(seed: int, shape, arity: int = 0, stream: int = 0):

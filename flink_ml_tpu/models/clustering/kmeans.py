@@ -206,6 +206,11 @@ def _is_pallas_failure(e: Exception) -> bool:
     RESOURCE_EXHAUSTED on a too-large dataset, whose message carries no
     Mosaic/vmem marker)?"""
     text = f"{type(e).__name__}: {e}"
+    if "RESOURCE_EXHAUSTED" in text and "vmem" not in text.lower():
+        # an HBM OOM can mention the pallas op in its allocation
+        # breakdown without the kernel being at fault — only a VMEM
+        # exhaustion is the kernel's own
+        return False
     return any(s in text for s in ("Mosaic", "mosaic", "pallas", "Pallas",
                                    "memory space vmem"))
 

@@ -305,7 +305,9 @@ def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams):
 def _tp_prepare_program(rem: int, pad_d: int, sharding):
     """Compiled cast+pad for a device-resident feature matrix entering the
     tensor-parallel layout (rows to the data axes, features to the model
-    axis) — no host round-trip."""
+    axis) — no host round-trip. Row-major output layout (see
+    collective.row_major_format)."""
+    from flink_ml_tpu.parallel.collective import row_major_format
 
     def prep(a):
         a = a.astype(jnp.float32)
@@ -313,7 +315,7 @@ def _tp_prepare_program(rem: int, pad_d: int, sharding):
             a = jnp.pad(a, ((0, rem), (0, pad_d)))
         return a
 
-    return jax.jit(prep, out_shardings=sharding)
+    return jax.jit(prep, out_shardings=row_major_format(sharding, 2))
 
 
 class SGD:
