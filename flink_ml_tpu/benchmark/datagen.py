@@ -263,15 +263,27 @@ class DoubleGenerator(InputTableGenerator):
                      ParamValidators.gt_eq(0))
 
     def get_data(self) -> Table:
-        rng = self._rng()
         arity = self.ARITY
+        names = self._col_names()
+        n = self.num_values
+        if _use_device_gen(n, n * len(names)):
+            # same on-device policy as DenseVectorGenerator: big scalar
+            # columns are generated sharded in HBM (f32, the dtype every
+            # device consumer computes in) — the 100M-row Bucketizer
+            # config stops shipping 400 MB through the tunnel; host
+            # consumers (FeatureHasher, SQLTransformer) pay one
+            # symmetric D2H instead of the device consumers' H2D
+            seed = self.get_seed_or_default()
+            return Table.from_columns(**{
+                name: _device_random(seed, (n,), arity, stream)
+                for stream, name in enumerate(names)})
+        rng = self._rng()
         if arity > 0:
-            cols = {name: rng.integers(
-                        0, arity, self.num_values).astype(np.float64)
-                    for name in self._col_names()}
+            cols = {name: rng.integers(0, arity, n).astype(np.float64)
+                    for name in names}
         else:
-            cols = {name: rng.random(self.num_values, dtype=np.float64)
-                    for name in self._col_names()}
+            cols = {name: rng.random(n, dtype=np.float64)
+                    for name in names}
         return Table.from_columns(**cols)
 
 

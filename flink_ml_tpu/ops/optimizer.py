@@ -431,18 +431,23 @@ class SGD:
             spec0 = data_pspec(mesh)
             rem = (-n) % data_shard_count(mesh)
             x_sharding = NamedSharding(mesh, P(spec0, MODEL_AXIS))
+            from flink_ml_tpu.parallel.collective import row_major_format
+            x_format = row_major_format(x_sharding, 2)
             if isinstance(features, jax.Array):
                 # device-resident input: cast/pad/reshard on device — the
-                # same residency contract as the DP branch
+                # same residency contract as the DP branch; layout pinned
+                # row-major like every other producer (a bare
+                # NamedSharding put preserves a compiler-chosen
+                # column-major layout and the fit re-pays the relayout)
                 if pad or rem or features.dtype != jnp.float32:
                     features = _tp_prepare_program(
                         rem, pad, x_sharding)(features)
-                xs = jax.device_put(features, x_sharding)
+                xs = jax.device_put(features, x_format)
             else:
                 features = np.asarray(features, np.float32)
                 if pad or rem:
                     features = np.pad(features, ((0, rem), (0, pad)))
-                xs = jax.device_put(features, x_sharding)
+                xs = jax.device_put(features, x_format)
             w_sharding = NamedSharding(mesh, P(MODEL_AXIS))
         else:
             # device-resident features/labels (device datagen or a previous

@@ -224,3 +224,28 @@ def test_host_loop_round_metrics():
                     config=IterationConfig(mode="host"))
     assert group.get_counter("rounds") == before + 3
     assert group.get_gauge("lastRoundMs") is not None
+
+
+def test_double_generator_device_path(monkeypatch):
+    """Past the device-gen threshold DoubleGenerator emits device-resident
+    f32 columns (same policy as DenseVectorGenerator); host consumers can
+    still materialize them."""
+    import jax
+
+    from flink_ml_tpu.benchmark import datagen
+    from flink_ml_tpu.benchmark.datagen import DoubleGenerator
+    from flink_ml_tpu.ops import columnar
+
+    monkeypatch.setattr(datagen, "_DEVICE_DATAGEN_MIN_BYTES", 0)
+    gen = DoubleGenerator(seed=2, col_names=[["f0", "f1"]], num_values=64)
+    t = gen.get_data()
+    col = t.column("f0")
+    assert isinstance(col, jax.Array) and columnar.is_device_array(col)
+    vals = np.asarray(col)  # host off-ramp still works
+    assert vals.shape == (64,)
+    assert 0.0 <= vals.min() and vals.max() < 1.0
+    assert not np.array_equal(vals, np.asarray(t.column("f1")))  # streams
+    gen2 = DoubleGenerator(seed=2, col_names=[["f0"]], num_values=64,
+                           arity=5)
+    v2 = np.asarray(gen2.get_data().column("f0"))
+    assert set(np.unique(v2)) <= set(range(5))
