@@ -73,16 +73,10 @@ _DEVICE_DATAGEN_MIN_BYTES = 8 << 20
 
 
 def _code_dtype(k: int):
-    """Narrowest integer dtype for codes in [0, k): a 10M x 100 draw at
-    the benchmark's 100-distinct domain is 1 GB as uint8 vs 8 GB as the
-    default int64 — page-fault traffic this host punishes 5-20x."""
-    if k <= 1 << 8:
-        return np.uint8
-    if k <= 1 << 16:
-        return np.uint16
-    if k <= 1 << 31:
-        return np.int32
-    return np.int64
+    """Narrowest integer dtype for codes in [0, k) — the shared ladder."""
+    from flink_ml_tpu.common.functions import narrow_uint
+
+    return narrow_uint(k)
 
 
 def _codes_to_strings(ints: np.ndarray, k: int) -> np.ndarray:

@@ -83,77 +83,134 @@ def _child_main(fn, lo, hi, wfd):
         os._exit(status)
 
 
+#: shards are additionally capped at this many rows so one shard's
+#: temporaries stay cache/page friendly — a single 10M-row shard's
+#: hundreds-of-MB intermediates measured 5-10x slower per row than the
+#: same work in 1M-row pieces on this page-fault-punishing host (callers
+#: merge per-shard results, so extra shards are transparent)
+SHARD_CAP_ROWS = 1 << 20
+
+
 def map_row_shards(fn, n_rows: int, *, workers: int = None,
-                   min_rows: int = 1 << 17):
+                   min_rows: int = 1 << 17,
+                   shard_cap: int = SHARD_CAP_ROWS):
     """Run ``fn(lo, hi)`` over even row shards of ``[0, n_rows)`` in
-    forked workers; return the per-shard results in shard order.
+    forked workers (waves of ``workers`` at a time); return the per-shard
+    results in shard order.
 
     ``fn`` must be host-numpy only (no jax — see module docstring) and
     close over whatever input arrays it needs; fork shares them
     copy-on-write.  Small inputs (below ``min_rows``), a single worker,
-    or a platform without fork all run ``fn(0, n_rows)`` inline — so
+    or a platform without fork run the shards inline in the parent — so
     callers need exactly one code path.
     """
     workers = host_parallelism() if workers is None else workers
-    if (workers <= 1 or n_rows < max(min_rows, 2)
-            or not hasattr(os, "fork")):
-        return [fn(0, n_rows)]
-    workers = min(workers, max(1, n_rows // max(1, min_rows // 2)))
+    small = n_rows < max(min_rows, 2)
+    n_shards = 1 if small else max(
+        min(workers, n_rows // max(1, min_rows // 2)),
+        -(-n_rows // max(1, shard_cap)))
+    shards = shard_bounds(n_rows, max(1, n_shards))
+    if workers <= 1 or small or not hasattr(os, "fork"):
+        return [fn(lo, hi) for lo, hi in shards]
+    return _fork_sliding(fn, shards, workers)
 
-    shards = shard_bounds(n_rows, workers)
-    pids, rfds = [], []
-    reaped = set()
+
+class _Child:
+    """One forked worker: pid, shard index, reader and an incremental
+    payload buffer (children stream results while others still run)."""
+
+    __slots__ = ("pid", "idx", "reader", "buf", "header")
+
+    def __init__(self, pid, idx, rfd):
+        self.pid, self.idx = pid, idx
+        self.reader = io.FileIO(rfd, "r")
+        self.buf = bytearray()
+        self.header = None  # (status, length) once parsed
+
+
+def _finalize(child):
+    """Parse a finished child's stream → its unpickled result."""
+    if child.header is None:
+        raise RuntimeError(
+            "host-pool worker died before reporting a result")
+    status, length = child.header
+    payload = bytes(child.buf)
+    if status != 0:
+        raise RuntimeError("host-pool worker failed:\n"
+                           + payload.decode("utf-8", "replace"))
+    if len(payload) < length:
+        raise RuntimeError("host-pool worker result truncated")
+    return pickle.loads(payload)
+
+
+def _fork_sliding(fn, shards, workers):
+    """Sliding-window scheduler: at most ``workers`` live children; as
+    each child's stream closes it is reaped and the next shard forks —
+    no end-of-wave barrier idling workers when len(shards) is not a
+    multiple of ``workers``. Results return in shard order."""
+    import selectors
+
+    sel = selectors.DefaultSelector()
+    live = {}          # fd -> _Child
+    results = [None] * len(shards)
+    next_shard = 0
+    forked_pids, reaped = [], set()
+
+    def fork_next():
+        nonlocal next_shard
+        lo, hi = shards[next_shard]
+        rfd, wfd = os.pipe()
+        pid = os.fork()
+        if pid == 0:  # child: never returns
+            os.close(rfd)
+            for other_fd in list(live):
+                os.close(other_fd)
+            _child_main(fn, lo, hi, wfd)
+        os.close(wfd)
+        child = _Child(pid, next_shard, rfd)
+        live[rfd] = child
+        sel.register(child.reader, selectors.EVENT_READ, child)
+        forked_pids.append(pid)
+        next_shard += 1
+
     try:
-        for lo, hi in shards:
-            rfd, wfd = os.pipe()
-            pid = os.fork()
-            if pid == 0:  # child: never returns
-                os.close(rfd)
-                for other in rfds:
-                    os.close(other)
-                _child_main(fn, lo, hi, wfd)
-            os.close(wfd)
-            pids.append(pid)
-            rfds.append(rfd)
-
-        results = []
-        for i, (pid, rfd) in enumerate(zip(pids, rfds)):
-            with io.FileIO(rfd, "r") as f:
-                rfds[i] = None  # FileIO owns (and closes) the fd now
-                hdr = f.read(_HDR.size)
-                if len(hdr) < _HDR.size:
-                    os.waitpid(pid, 0)
-                    raise RuntimeError(
-                        "host-pool worker died before reporting a result")
-                status, length = _HDR.unpack(hdr)
-                chunks, got = [], 0
-                while got < length:
-                    chunk = f.read(min(1 << 24, length - got))
-                    if not chunk:
-                        break
-                    chunks.append(chunk)
-                    got += len(chunk)
-            os.waitpid(pid, 0)
-            reaped.add(pid)
-            payload = b"".join(chunks)
-            if status != 0:
-                raise RuntimeError("host-pool worker failed:\n"
-                                   + payload.decode("utf-8", "replace"))
-            if got < length:
-                raise RuntimeError("host-pool worker result truncated")
-            results.append(pickle.loads(payload))
+        while next_shard < len(shards) and len(live) < workers:
+            fork_next()
+        while live:
+            for key, _ in sel.select():
+                child = key.data
+                chunk = child.reader.read(1 << 20)
+                if chunk:
+                    child.buf.extend(chunk)
+                    if child.header is None and \
+                            len(child.buf) >= _HDR.size:
+                        child.header = _HDR.unpack_from(child.buf)
+                        del child.buf[:_HDR.size]
+                    continue
+                # EOF: child done — reap, finalize, refill the window
+                sel.unregister(child.reader)
+                del live[child.reader.fileno()]
+                child.reader.close()
+                os.waitpid(child.pid, 0)
+                reaped.add(child.pid)
+                results[child.idx] = _finalize(child)
+                if next_shard < len(shards):
+                    fork_next()
         return results
     finally:
         # close pipes first (a worker blocked on a full pipe gets EPIPE
         # and exits), then reap every un-waited child so an error path
         # leaves no zombies behind
-        for rfd in rfds:
-            if rfd is not None:
-                try:
-                    os.close(rfd)
-                except OSError:
-                    pass
-        for pid in pids:
+        for child in live.values():
+            try:
+                sel.unregister(child.reader)
+            except Exception:
+                pass
+            try:
+                child.reader.close()
+            except OSError:
+                pass
+        for pid in forked_pids:
             if pid not in reaped:
                 try:
                     os.waitpid(pid, 0)

@@ -25,6 +25,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from flink_ml_tpu.api.stage import Estimator, Model, Transformer
+from flink_ml_tpu.common.functions import narrow_uint
 from flink_ml_tpu.common.table import Table
 from flink_ml_tpu.linalg.vectors import SparseVector
 from flink_ml_tpu.params.param import (
@@ -158,11 +159,15 @@ def _rowwise_counts(mat: np.ndarray, with_counts: bool = True,
     not rely on afterwards (per-row multisets are preserved; within-row
     order is not). Pass ``mat.copy()`` to keep the original intact.
 
-    Two engines, both processing bounded ROW CHUNKS (one giant pass
+    Three engines, all processing bounded ROW CHUNKS (one giant pass
     thrashes the allocator — a single 8 GB sort measured ~15x slower than
     the same work chunked):
-    - small ``domain`` (values known to lie in [0, domain)): a per-chunk
-      (rows, domain) bincount matrix + nonzero — O(N), no sorting at all;
+    - tiny ``domain`` (≤ 64): ``domain`` equality-sum passes over the
+      matrix — no sort, no key materialization, and ``mat`` is NOT
+      modified (measured ~4x over the row-sort engine at 10M x 10 on
+      this page-fault-punishing host);
+    - small ``domain``: a per-chunk (rows, domain) bincount matrix +
+      nonzero — O(N), no sorting;
     - otherwise: in-place row sort + run-length encode per chunk,
       O(n·w·log w) with w the token width (~1e2).
     """
@@ -174,7 +179,25 @@ def _rowwise_counts(mat: np.ndarray, with_counts: bool = True,
 
     row_parts, val_parts, cnt_parts = [], [], []
 
-    if domain is not None and 0 < domain <= max(4 * w, 1024):
+    if domain is not None and 0 < domain <= 64:
+        # k-pass engine: per-row counts ≤ w, so the count matrix can be
+        # one byte per cell for the usual token widths. Chunk by
+        # max(domain, w): the per-pass ``sub == j`` bool temporary is
+        # chunk·w bytes and must stay bounded too.
+        cdt = narrow_uint(w + 1)
+        chunk = max(1, (64 << 20) // max(domain, w))
+        for r0 in range(0, n, chunk):
+            r1 = min(r0 + chunk, n)
+            sub = mat[r0:r1]
+            cnt = np.empty((r1 - r0, domain), cdt)
+            for j in range(domain):
+                np.sum(sub == j, axis=1, dtype=cdt, out=cnt[:, j])
+            rr, vv = np.nonzero(cnt)
+            row_parts.append(rr + r0)
+            val_parts.append(vv.astype(mat.dtype, copy=False))
+            if with_counts:
+                cnt_parts.append(cnt[rr, vv])
+    elif domain is not None and 0 < domain <= max(4 * w, 1024):
         # bincount engine: chunk so the counts matrix stays ~512 MB
         chunk = max(1, (64 << 20) // domain)
         base = np.arange(min(chunk, n), dtype=np.int64)[:, None] * domain
@@ -488,9 +511,18 @@ class HashingTF(Transformer, HasInputCol, HasOutputCol, HasNumFeatures):
                 buckets = np.fromiter(
                     (_hash_index(str(t), m) for t in uniq),
                     np.int64, len(uniq))
-                row_of, bucket, counts = _rowwise_counts(
-                    buckets[codes].reshape(sub.shape), domain=m)
-                return row_of + lo, bucket, counts
+                # count over the DISTINCT-BUCKET alphabet, not the 2^18
+                # bucket domain: tokens hashing to one bucket share a
+                # label (collisions merge inside the count), the
+                # relabeled matrix is 1-2 bytes/cell instead of 8 (this
+                # host punishes big working sets 5-20x), and ascending
+                # labels stay ascending buckets (CSR-canonical)
+                ub, inv = np.unique(buckets, return_inverse=True)
+                row_of, ub_idx, counts = _rowwise_counts(
+                    inv.astype(narrow_uint(len(ub)))[codes]
+                       .reshape(sub.shape),
+                    domain=len(ub))
+                return row_of + lo, ub[ub_idx], counts
 
             parts = map_row_shards(shard, n)
             row_of = np.concatenate([p[0] for p in parts])
@@ -737,8 +769,8 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
                 # small vocab → dense (n, size) f32 counts ON DEVICE
                 # (deviation doc: device tier emits a dense device column
                 # where the reference emits SparseVector)
-                dt = np.uint8 if size + 1 <= 0xFF else np.uint16
-                ids1 = (vocab_ids + 1).astype(dt)[codes].reshape(n, w)
+                ids1 = (vocab_ids + 1).astype(
+                    narrow_uint(size + 2))[codes].reshape(n, w)
                 out = _device_token_counts(ids1, size, min_tf,
                                            self.binary, w)
                 return (table.with_column(self.output_col, out),)

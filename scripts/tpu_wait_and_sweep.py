@@ -40,6 +40,18 @@ def main() -> int:
              "--budget-s", "150", "--resume"])
         print(f"attempt {attempt}: sweep rc={rc}", flush=True)
         if rc == 0:
+            # same tunnel-up window: grab the north-star per-op traces +
+            # layout diagnosis before the tunnel can die again. Bounded
+            # wait, but an overdue child is ABANDONED, never killed — a
+            # killed claimant wedges the tunnel lease (bench.py).
+            prof = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(REPO, "scripts", "tpu_profile_r4.py")])
+            deadline2 = time.monotonic() + 2400
+            while prof.poll() is None and time.monotonic() < deadline2:
+                time.sleep(15)
+            print(f"profile rc={prof.poll()} (None = overdue, left "
+                  "running)", flush=True)
             return 0
         time.sleep(90)
     return 1
