@@ -95,14 +95,17 @@ def map_row_shards(fn, n_rows: int, *, workers: int = None,
                    min_rows: int = 1 << 17,
                    shard_cap: int = SHARD_CAP_ROWS):
     """Run ``fn(lo, hi)`` over even row shards of ``[0, n_rows)`` in
-    forked workers (waves of ``workers`` at a time); return the per-shard
-    results in shard order.
+    forked workers — a sliding window with at most ``workers`` live
+    children, refilled as each finishes (no end-of-wave barrier); return
+    the per-shard results in shard order.
 
-    ``fn`` must be host-numpy only (no jax — see module docstring) and
-    close over whatever input arrays it needs; fork shares them
-    copy-on-write.  Small inputs (below ``min_rows``), a single worker,
-    or a platform without fork run the shards inline in the parent — so
-    callers need exactly one code path.
+    ``shard_cap`` bounds each shard's rows (default ``SHARD_CAP_ROWS``)
+    so one shard's temporaries stay page/cache friendly; there may be
+    many more shards than workers.  ``fn`` must be host-numpy only (no
+    jax — see module docstring) and close over whatever input arrays it
+    needs; fork shares them copy-on-write.  Small inputs (below
+    ``min_rows``), a single worker, or a platform without fork run the
+    shards inline in the parent — so callers need exactly one code path.
     """
     workers = host_parallelism() if workers is None else workers
     small = n_rows < max(min_rows, 2)

@@ -126,3 +126,41 @@ def test_hashingtf_pool_parity(monkeypatch):
     _forced_pool(monkeypatch)
     pooled = htf.transform(t)[0].column("o").matrix
     assert (serial != pooled).nnz == 0
+
+
+def test_sliding_window_refill_many_shards():
+    """shard_cap forcing many more shards than workers: the window must
+    refill as children finish, preserve shard order, and lose nothing."""
+    import numpy as np
+
+    from flink_ml_tpu.common.hostpool import map_row_shards
+
+    n = 300_000
+    parts = map_row_shards(lambda lo, hi: np.arange(lo, hi), n,
+                           workers=3, min_rows=1, shard_cap=10_000)
+    assert len(parts) == 30  # cap drives the shard count, not workers
+    got = np.concatenate(parts)
+    assert np.array_equal(got, np.arange(n))
+
+
+def test_sliding_window_refill_error_midstream():
+    """A failing late shard (in a refill wave) must propagate and leave
+    no zombies."""
+    import os
+
+    import numpy as np
+    import pytest
+
+    from flink_ml_tpu.common.hostpool import map_row_shards
+
+    def fn(lo, hi):
+        if lo >= 80_000:
+            raise ValueError("late boom")
+        return np.arange(lo, hi)
+
+    with pytest.raises(RuntimeError, match="late boom"):
+        map_row_shards(fn, 100_000, workers=2, min_rows=1,
+                       shard_cap=10_000)
+    # all children reaped: waitpid on any child now raises
+    with pytest.raises(ChildProcessError):
+        os.waitpid(-1, os.WNOHANG)
