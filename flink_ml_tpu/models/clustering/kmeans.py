@@ -200,19 +200,9 @@ _pallas_lloyd_broken = False
 
 
 def _is_pallas_failure(e: Exception) -> bool:
-    """Heuristic: does this exception come from the pallas/Mosaic stack
-    (lowering, compile, or kernel execution — including a Mosaic VMEM
-    exhaustion) rather than from the fit itself (e.g. an HBM
-    RESOURCE_EXHAUSTED on a too-large dataset, whose message carries no
-    Mosaic/vmem marker)?"""
-    text = f"{type(e).__name__}: {e}"
-    if "RESOURCE_EXHAUSTED" in text and "vmem" not in text.lower():
-        # an HBM OOM can mention the pallas op in its allocation
-        # breakdown without the kernel being at fault — only a VMEM
-        # exhaustion is the kernel's own
-        return False
-    return any(s in text for s in ("Mosaic", "mosaic", "pallas", "Pallas",
-                                   "memory space vmem"))
+    from flink_ml_tpu.ops.pallas_kernels import is_pallas_failure
+
+    return is_pallas_failure(e)
 
 
 class KMeansModel(Model, KMeansModelParams):

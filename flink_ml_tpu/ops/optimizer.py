@@ -71,8 +71,26 @@ def _sgd_update_math(loss_func, prm: SGDParams, axes, model_axis=None):
     (SGD.java:231-243) — shared by the while-loop, unrolled and host-driven
     programs so a change here propagates to every fit path.
 
-    Returns ``update(coeffs, xb, yb, wb) -> (new_coeffs, mean_loss)``; must
-    be called inside shard_map over the mesh's data ``axes``."""
+    Returns ``(update, apply_packed)``: ``update(coeffs, xb, yb, wb) ->
+    (new_coeffs, mean_loss)`` for the slice-based rounds, and
+    ``apply_packed(coeffs, packed_local) -> (new_coeffs, mean_loss)`` for
+    rounds whose local [grad | weight | loss] partials come from the
+    fused pallas kernel — the cross-shard psum and the model update are
+    this one shared tail either way. Must be called inside shard_map
+    over the mesh's data ``axes``."""
+
+    def apply_packed(coeffs, packed_local):
+        packed = jax.lax.psum(packed_local, axes)
+        grad, total_w, total_loss = packed[:-2], packed[-2], packed[-1]
+
+        # ref updateModel (SGD.java:231-243); skip when no weight
+        updated = coeffs - (prm.learning_rate
+                            / jnp.maximum(total_w, 1e-30)) * grad
+        updated, _ = regularize(updated, prm.reg, prm.elastic_net,
+                                prm.learning_rate)
+        coeffs_out = jnp.where(total_w > 0, updated, coeffs)
+        mean_loss = total_loss / jnp.maximum(total_w, 1e-30)
+        return coeffs_out, mean_loss
 
     def update(coeffs, xb, yb, wb):
         if model_axis is None:
@@ -85,19 +103,9 @@ def _sgd_update_math(loss_func, prm: SGDParams, axes, model_axis=None):
         packed = jnp.concatenate([
             grad_sum, jnp.sum(wb)[None].astype(grad_sum.dtype),
             loss_sum[None]])
-        packed = jax.lax.psum(packed, axes)
-        grad, total_w, total_loss = packed[:-2], packed[-2], packed[-1]
+        return apply_packed(coeffs, packed)
 
-        # ref updateModel (SGD.java:231-243); skip when no weight
-        updated = coeffs - (prm.learning_rate
-                            / jnp.maximum(total_w, 1e-30)) * grad
-        updated, _ = regularize(updated, prm.reg, prm.elastic_net,
-                                prm.learning_rate)
-        coeffs_out = jnp.where(total_w > 0, updated, coeffs)
-        mean_loss = total_loss / jnp.maximum(total_w, 1e-30)
-        return coeffs_out, mean_loss
-
-    return update
+    return update, apply_packed
 
 
 def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
@@ -120,7 +128,7 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
     shard, and the loss/weight reduction crosses the data axes only."""
     gb = prm.global_batch_size
     lb_base, lb_rem = gb // p, gb % p
-    update = _sgd_update_math(loss_func, prm, axes, model_axis)
+    update, _ = _sgd_update_math(loss_func, prm, axes, model_axis)
 
     def round_step(xl, yl, wl, coeffs, offset):
         local_n = xl.shape[0]  # static at trace time
@@ -208,6 +216,10 @@ def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams):
 _UNROLL_MAX_ROUNDS = int(os.environ.get(
     "FLINK_ML_TPU_SGD_UNROLL_MAX", "64"))
 
+# set on the first pallas lowering failure so later fits skip straight to
+# the XLA rounds instead of re-tracing the kernel to the same exception
+_pallas_sgd_broken = False
+
 
 def _static_batch_schedule(local_n: int, lb: int, max_iter: int):
     """The per-shard minibatch schedule as Python ints — valid because the
@@ -225,7 +237,8 @@ def _static_batch_schedule(local_n: int, lb: int, max_iter: int):
 
 
 @functools.lru_cache(maxsize=128)
-def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams):
+def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
+                                use_kernel: bool = False):
     """The plain (uncheckpointed, fresh-offset) fit as ONE fully-unrolled
     SPMD program: ``fit(xs, ys, ws, coeffs, offsets) -> (coeffs, offsets,
     mean_loss, epoch, stop)`` — the same carry as the segment program. The
@@ -233,7 +246,14 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams):
     discarded by ``where``), so the result — coeffs, final offsets, the
     loss AT the stopping round, the executed-round count — is identical to
     the while program's by construction. Only valid for offsets == 0 and
-    gb %% p == 0 (the dispatch in ``optimize`` guarantees both)."""
+    gb %% p == 0 (the dispatch in ``optimize`` guarantees both).
+
+    With ``use_kernel`` (TPU, DP-only mesh), rounds whose window aligns
+    to a shared tile run the fused pallas batch-terms kernel — one pass
+    over the window instead of a slice copy plus two reads; the psum and
+    the model update stay in the one shared tail
+    (``_sgd_update_math.apply_packed``), so results agree with the XLA
+    rounds up to float reassociation in the per-tile partial sums."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     p = data_shard_count(mesh)
@@ -241,23 +261,34 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams):
     wspec = P(model_axis) if model_axis else P()
     lb_base = prm.global_batch_size // p
     assert prm.global_batch_size % p == 0
-    update = _sgd_update_math(loss_cls(), prm, axes, model_axis)
+    update, apply_packed = _sgd_update_math(loss_cls(), prm, axes,
+                                            model_axis)
 
     def per_shard(xl, yl, wl, coeffs, offsets):
         local_n = xl.shape[0]
         lb = min(lb_base, local_n)
+        tile = 0
+        if use_kernel and model_axis is None:
+            from flink_ml_tpu.ops.pallas_kernels import sgd_round_tile
+            tile = sgd_round_tile(lb, local_n, xl.shape[1])
         sched = _static_batch_schedule(local_n, lb, prm.max_iter)
         offset = offsets[0]
         mean_loss = jnp.asarray(jnp.inf, coeffs.dtype)
         epoch = jnp.int32(0)
         stop = jnp.asarray(False)
         for start, clip in sched:
-            xb = jax.lax.slice_in_dim(xl, start, start + lb, axis=0)
-            yb = jax.lax.slice_in_dim(yl, start, start + lb, axis=0)
-            wb = jax.lax.slice_in_dim(wl, start, start + lb, axis=0)
-            if clip:  # short batch at the shard end: clipped rows weigh 0
-                wb = wb * (np.arange(lb) >= clip).astype(xl.dtype)
-            updated, new_loss = update(coeffs, xb, yb, wb)
+            if tile:
+                from flink_ml_tpu.ops.pallas_kernels import sgd_batch_terms
+                packed = sgd_batch_terms(xl, yl, wl, coeffs, start, clip,
+                                         lb, tile, loss_cls.NAME)
+                updated, new_loss = apply_packed(coeffs, packed)
+            else:
+                xb = jax.lax.slice_in_dim(xl, start, start + lb, axis=0)
+                yb = jax.lax.slice_in_dim(yl, start, start + lb, axis=0)
+                wb = jax.lax.slice_in_dim(wl, start, start + lb, axis=0)
+                if clip:  # short batch at the end: clipped rows weigh 0
+                    wb = wb * (np.arange(lb) >= clip).astype(xl.dtype)
+                updated, new_loss = update(coeffs, xb, yb, wb)
             new_off = jnp.int32(0 if start + clip + lb >= local_n
                                 else start + clip + lb)
             active = jnp.logical_not(stop)
@@ -489,10 +520,35 @@ class SGD:
             # by construction; see _build_sgd_unrolled_program).
             if (not seg_k and self.params.global_batch_size % p == 0
                     and 0 < self.params.max_iter <= _UNROLL_MAX_ROUNDS):
-                prog = _build_sgd_unrolled_program(type(loss_func), mesh,
-                                                   self.params)
-                coeffs, _, mean_loss, _, _ = prog(xs, ys, ws, init[0],
-                                                  init[1])
+                from flink_ml_tpu.ops.pallas_kernels import (
+                    is_pallas_failure, pallas_supported)
+                global _pallas_sgd_broken
+                use_kernel = (pallas_supported() and not tp
+                              and not _pallas_sgd_broken)
+                try:
+                    prog = _build_sgd_unrolled_program(
+                        type(loss_func), mesh, self.params,
+                        use_kernel=use_kernel)
+                    # materialize INSIDE the try: async dispatch surfaces
+                    # kernel-execution failures only here
+                    coeffs, _, mean_loss, _, _ = prog(xs, ys, ws, init[0],
+                                                      init[1])
+                    return (np.asarray(coeffs, np.float64)[:d],
+                            float(mean_loss))
+                except Exception as e:
+                    if not use_kernel or not is_pallas_failure(e):
+                        raise
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "pallas SGD kernel failed; using the XLA rounds "
+                        "for the rest of this process", exc_info=True)
+                    _pallas_sgd_broken = True
+                    prog = _build_sgd_unrolled_program(
+                        type(loss_func), mesh, self.params,
+                        use_kernel=False)
+                    coeffs, _, mean_loss, _, _ = prog(xs, ys, ws, init[0],
+                                                      init[1])
                 return np.asarray(coeffs, np.float64)[:d], float(mean_loss)
             seg_prog = _build_sgd_segment_program(type(loss_func), mesh,
                                                   self.params)

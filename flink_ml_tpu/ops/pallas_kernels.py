@@ -73,6 +73,22 @@ def pallas_supported() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def is_pallas_failure(e: Exception) -> bool:
+    """Heuristic: does this exception come from the pallas/Mosaic stack
+    (lowering, compile, or kernel execution — including a Mosaic VMEM
+    exhaustion) rather than from the surrounding program (e.g. an HBM
+    RESOURCE_EXHAUSTED on a too-large dataset, whose message carries no
+    Mosaic/vmem marker)? Drives the try-kernel-then-XLA fallbacks."""
+    text = f"{type(e).__name__}: {e}"
+    if "RESOURCE_EXHAUSTED" in text and "vmem" not in text.lower():
+        # an HBM OOM can mention the pallas op in its allocation
+        # breakdown without the kernel being at fault — only a VMEM
+        # exhaustion is the kernel's own
+        return False
+    return any(s in text for s in ("Mosaic", "mosaic", "pallas", "Pallas",
+                                   "memory space vmem"))
+
+
 # -- fused Lloyd round: assign + accumulate (KMeans fit) ---------------------
 
 #: VMEM the kernel's working set may claim: double-buffered (TILE_N, d)
@@ -158,6 +174,113 @@ def lloyd_partial_sums(x, v, centroids, interpret: bool = False):
         x = jnp.pad(x, ((0, pad), (0, 0)))
         v = jnp.pad(v, (0, pad))
     return _lloyd_padded(x, v[:, None], centroids, interpret=interpret)
+
+
+# -- fused SGD batch terms (one pass over the minibatch window) --------------
+
+
+def _sgd_terms_kernel(terms, tile_n, scalars_ref, x_ref, y_ref, w_ref,
+                      c_ref, out_ref):
+    """One row tile of the minibatch: forward dots, loss terms and the
+    gradient accumulate in VMEM — the batch window is read ONCE (the XLA
+    round reads it for the forward matvec and again for the gradient,
+    after a dynamic-slice copy). The window's start arrives as a
+    prefetched scalar (block units), so ONE compiled kernel serves every
+    round of the static schedule; ``scalars_ref[1]`` carries the
+    clip-round cutoff (rows before it weigh 0)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = x_ref[:]                       # (tile_n, d)
+    y = y_ref[:]
+    w = w_ref[:]
+    c = c_ref[:]                       # (d,)
+    row = jnp.reshape(
+        jax.lax.broadcasted_iota(jnp.int32, (tile_n, 1), 0), (tile_n,))
+    w = jnp.where(i * tile_n + row >= scalars_ref[1], w, 0.0)
+    dots = jnp.dot(x, c, preferred_element_type=jnp.float32)
+    loss_sum, mult = terms(dots, y, w)
+    grad = jnp.dot(mult, x, preferred_element_type=jnp.float32)
+    out_ref[:] += jnp.concatenate(
+        [grad, jnp.stack([jnp.sum(w), loss_sum])])
+
+
+#: VMEM budget for the SGD kernel working set: double-buffered (tile, d)
+#: x blocks + the y/w vectors + coeffs + the (d+2,) accumulator
+SGD_VMEM_BUDGET_BYTES = 8 << 20
+
+
+def sgd_round_tile(lb: int, local_n: int, d: int) -> int:
+    """The largest row tile ≤ 1024, a multiple of 8, dividing both the
+    local batch and the shard length (the alignment that makes every
+    static-schedule window start a whole number of blocks), whose
+    working set fits the VMEM budget for feature width ``d``. 0 when no
+    such tile exists (callers fall back to the XLA round) — a shape gate,
+    so predictable wide-feature failures never burn the process-wide
+    broken flag."""
+    import math
+
+    g = math.gcd(lb, local_n)
+    for t in range(min(1024, g) - min(1024, g) % 8, 7, -8):
+        if g % t != 0:
+            continue
+        working = (2 * t * d + 4 * t + 2 * d + (d + 2)) * 4
+        if working <= SGD_VMEM_BUDGET_BYTES:
+            return t
+    return 0
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("loss_name", "lb", "tile", "interpret"))
+def _sgd_terms_padded(xl, yl, wl, coeffs, scalars, loss_name, lb, tile,
+                      interpret=False):
+    from jax.experimental.pallas import tpu as pltpu
+
+    from flink_ml_tpu.ops.losses import LossFunc
+
+    terms = LossFunc.by_name(loss_name).terms
+    d = xl.shape[1]
+    kernel = functools.partial(_sgd_terms_kernel, terms, tile)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(lb // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i, s: (s[0] + i, 0)),
+            pl.BlockSpec((tile,), lambda i, s: (s[0] + i,)),
+            pl.BlockSpec((tile,), lambda i, s: (s[0] + i,)),
+            pl.BlockSpec((d,), lambda i, s: (0,)),
+        ],
+        out_specs=pl.BlockSpec((d + 2,), lambda i, s: (0,)),
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((d + 2,), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(scalars, xl, yl, wl, coeffs)
+
+
+def sgd_batch_terms(xl, yl, wl, coeffs, start, clip, lb: int, tile: int,
+                    loss_name: str, interpret: bool = False):
+    """Packed [grad sums | weight sum | loss sum] (d+2,) over the
+    contiguous batch window [start, start+lb) of this shard — fused
+    forward+terms+gradient, one pass over the window.
+
+    ``start`` must be a whole number of ``tile`` blocks (the
+    static-schedule gate ``sgd_round_tile`` guarantees it when lb and
+    local_n share the tile); rows whose window-relative index is below
+    ``clip`` weigh 0 (the clip-at-end round). ``start``/``clip`` may be
+    traced scalars — they ride the scalar-prefetch slot, so every round
+    reuses one compiled kernel.
+    """
+    scalars = jnp.stack([jnp.asarray(start, jnp.int32) // tile,
+                         jnp.asarray(clip, jnp.int32)])
+    return _sgd_terms_padded(xl, yl, wl, jnp.asarray(coeffs, jnp.float32),
+                             scalars, loss_name, lb, tile,
+                             interpret=interpret)
 
 
 # -- fused distance + top-k (KNN) -------------------------------------------
