@@ -69,7 +69,15 @@ def assign_nearest(x, centroids, interpret: bool = False):
 
 
 def pallas_supported() -> bool:
-    """True when the default backend can run compiled pallas kernels."""
+    """True when the default backend can run compiled pallas kernels.
+    FLINK_ML_TPU_DISABLE_PALLAS=1 is the central kill-switch — set by an
+    operator, or by scripts/tpu_kernel_check.py's caller when the
+    on-chip parity check fails (wrong RESULTS can't be caught by the
+    exception-driven fallbacks)."""
+    import os
+
+    if os.environ.get("FLINK_ML_TPU_DISABLE_PALLAS") == "1":
+        return False
     return jax.default_backend() == "tpu"
 
 

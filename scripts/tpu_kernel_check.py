@@ -1,0 +1,114 @@
+"""On-chip pallas kernel parity check.
+
+The interpret-mode tests prove the kernels' math on CPU; this script
+proves the MOSAIC LOWERING on the real chip before unattended benchmark
+runs trust it: every compiled kernel is run at small scale against its
+numpy oracle. Exit 0 = all kernels agree, 2 = a kernel produced wrong
+results (callers should export FLINK_ML_TPU_DISABLE_PALLAS=1 for
+subsequent runs), 3 = a kernel failed to compile/run (the in-tree
+exception fallbacks already cover that case).
+
+Run on the TPU backend: ``python scripts/tpu_kernel_check.py``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    import jax
+
+    if jax.default_backend() == "cpu":
+        print("kernel check needs the TPU backend", file=sys.stderr)
+        return 1
+    from flink_ml_tpu.ops import pallas_kernels as pk
+    from flink_ml_tpu.ops.losses import LossFunc
+
+    rng = np.random.default_rng(7)
+    failures, errors = [], []
+
+    def check(name, fn, oracle, rtol=1e-4, atol=1e-4):
+        try:
+            got = np.asarray(fn())
+        except Exception as e:  # noqa: BLE001 — record, keep checking
+            errors.append(f"{name}: {type(e).__name__}: {e}")
+            return
+        try:
+            np.testing.assert_allclose(got, oracle, rtol=rtol, atol=atol)
+            print(f"{name}: OK", flush=True)
+        except AssertionError as e:
+            failures.append(f"{name}: {e}")
+
+    # index checks are TIE-TOLERANT: the kernel's csq − 2·x·c matmul runs
+    # at TPU default precision, so near-equidistant points may pick a
+    # different (equally valid) winner — compare the DISTANCE at the
+    # chosen index against the oracle's best distance instead of the
+    # index itself.
+    x = rng.normal(size=(2048, 16)).astype(np.float32)
+    c = rng.normal(size=(5, 16)).astype(np.float32) * 4
+    d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+    check("assign_nearest(dist@chosen)",
+          lambda: d2[np.arange(len(x)), np.asarray(pk.assign_nearest(x, c))],
+          d2.min(1), rtol=1e-3, atol=1e-2)
+
+    train = rng.normal(size=(64, 16)).astype(np.float32)
+    dt = ((x[:, None, :] - train[None, :, :]) ** 2).sum(-1)
+
+    def knn_dists():
+        idx = np.asarray(pk.knn_topk_indices(x, train, 3))  # (n, 3)
+        return dt[np.arange(len(x))[:, None], idx]
+
+    # full top-k machinery (mask + dynamic_update_slice passes), not just
+    # column 0: distances at the chosen k indices must match the k
+    # smallest distances in order
+    check("knn_topk_indices(dists@chosen)", knn_dists,
+          np.sort(dt, axis=1)[:, :3], rtol=1e-3, atol=1e-2)
+
+    # WELL-SEPARATED clusters so assignment ties are implausible, and
+    # generous tolerances: the check hunts wrong lowerings (wrong
+    # tiles/accumulation), not TPU matmul rounding
+    cw = rng.normal(size=(5, 16)).astype(np.float32) * 10
+    xw = (cw[rng.integers(0, 5, 2048)]
+          + rng.normal(size=(2048, 16)).astype(np.float32) * 0.1) \
+        .astype(np.float32)
+    dw = ((xw[:, None, :] - cw[None, :, :]) ** 2).sum(-1)
+    v = (rng.random(2048) > 0.1).astype(np.float32)
+    one_hot = (dw.argmin(1)[:, None] == np.arange(5)[None, :]) * v[:, None]
+    lloyd_want = np.concatenate(
+        [one_hot.T @ xw, one_hot.sum(0)[:, None]], axis=1)
+    check("lloyd_partial_sums", lambda: pk.lloyd_partial_sums(xw, v, cw),
+          lloyd_want, rtol=5e-2, atol=0.5)
+
+    yl = (rng.random(2048) > 0.5).astype(np.float32)
+    wl = (rng.random(2048) + 0.5).astype(np.float32)
+    coeffs = rng.normal(size=16).astype(np.float32)
+    for loss_name in ("logistic", "hinge", "least_square"):
+        loss = LossFunc.by_name(loss_name)
+        lb, tile, start, clip = 512, 64, 1024, 3
+        wb = wl[start:start + lb] * (np.arange(lb) >= clip)
+        ls, grad = loss.loss_and_gradient(
+            coeffs, x[start:start + lb], yl[start:start + lb],
+            wb.astype(np.float32))
+        want = np.concatenate([np.asarray(grad), [wb.sum(), float(ls)]])
+        check(f"sgd_batch_terms[{loss_name}]",
+              lambda ln=loss_name: pk.sgd_batch_terms(
+                  x, yl, wl, coeffs, start, clip, lb, tile, ln),
+              want, rtol=5e-2, atol=0.5)
+
+    for f in failures:
+        print("PARITY FAILURE:", f, file=sys.stderr)
+    for e in errors:
+        print("KERNEL ERROR:", e, file=sys.stderr)
+    if failures:
+        return 2
+    if errors:
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,17 @@ from tpu_wait_and_remeasure import wait_backend  # noqa: E402 — one probe impl
 REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 
 
+def wait_or_abandon(proc, timeout_s: float, interval_s: float = 10.0):
+    """Poll ``proc`` until it exits or the timeout passes; an overdue
+    child is ABANDONED, never killed — a killed claimant wedges the
+    tunnel lease (bench.py). Returns the exit code, or None if
+    abandoned."""
+    deadline = time.monotonic() + timeout_s
+    while proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(interval_s)
+    return proc.poll()
+
+
 def main() -> int:
     budget = float(sys.argv[1]) if len(sys.argv) > 1 else 28800.0
     deadline = time.monotonic() + budget
@@ -30,28 +41,42 @@ def main() -> int:
         if not wait_backend(deadline):
             print("backend never came up within budget", flush=True)
             return 1
-        print(f"attempt {attempt}: backend live, sweeping", flush=True)
+        print(f"attempt {attempt}: backend live, checking kernels",
+              flush=True)
+        env = dict(os.environ)
+        # prove the Mosaic lowerings on the chip before unattended runs
+        # trust them: wrong RESULTS (exit 2) — or a check that never
+        # reports (fail-closed: it may be wedged holding the lease) —
+        # flip the central pallas kill-switch for the sweep; kernel
+        # ERRORS (exit 3) are already covered by the in-tree exception
+        # fallbacks.
+        chk_rc = wait_or_abandon(subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "tpu_kernel_check.py")]), 900)
+        if chk_rc == 2 or chk_rc is None:
+            env["FLINK_ML_TPU_DISABLE_PALLAS"] = "1"
+            print(f"kernel check rc={chk_rc} (2 = parity failed, None = "
+                  "overdue): pallas disabled for the sweep", flush=True)
+        print(f"attempt {attempt}: sweeping (kernel check rc={chk_rc})",
+              flush=True)
         rc = subprocess.call(
             [sys.executable,
              os.path.join(REPO, "scripts", "run_benchmark_sweep.py"),
              "--output-file", os.path.join(REPO,
                                            "benchmark_results_r4.json"),
              "--chart", os.path.join(REPO, "benchmark_results_r4.png"),
-             "--budget-s", "150", "--resume"])
+             "--budget-s", "150", "--resume"], env=env)
         print(f"attempt {attempt}: sweep rc={rc}", flush=True)
         if rc == 0:
             # same tunnel-up window: grab the north-star per-op traces +
-            # layout diagnosis before the tunnel can die again. Bounded
-            # wait, but an overdue child is ABANDONED, never killed — a
-            # killed claimant wedges the tunnel lease (bench.py).
-            prof = subprocess.Popen(
+            # layout diagnosis before the tunnel can die again (same env
+            # so a parity-failed pallas stays disabled here too)
+            prc = wait_or_abandon(subprocess.Popen(
                 [sys.executable,
-                 os.path.join(REPO, "scripts", "tpu_profile_r4.py")])
-            deadline2 = time.monotonic() + 2400
-            while prof.poll() is None and time.monotonic() < deadline2:
-                time.sleep(15)
-            print(f"profile rc={prof.poll()} (None = overdue, left "
-                  "running)", flush=True)
+                 os.path.join(REPO, "scripts", "tpu_profile_r4.py")],
+                env=env), 2400)
+            print(f"profile rc={prc} (None = overdue, left running)",
+                  flush=True)
             return 0
         time.sleep(90)
     return 1
