@@ -32,7 +32,6 @@ import io
 import os
 import pickle
 import struct
-import sys
 import traceback
 
 import numpy as np

@@ -34,7 +34,6 @@ from jax.sharding import PartitionSpec as P
 from flink_ml_tpu.api.stage import Estimator, Model
 from flink_ml_tpu.common.table import Table, as_dense_vector_column
 from flink_ml_tpu.linalg.distance import DistanceMeasure
-from flink_ml_tpu.linalg.vectors import DenseVector
 from flink_ml_tpu.parallel.collective import ensure_on_mesh, local_valid_mask
 from flink_ml_tpu.parallel.mesh import data_axes, data_pspec, default_mesh
 from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam
