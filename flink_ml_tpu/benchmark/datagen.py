@@ -84,12 +84,26 @@ def _codes_to_strings(ints: np.ndarray, k: int) -> np.ndarray:
     DISTINCT value then one vectorized gather — a 10M-row column never
     pays 10M Python str() calls, and a sparse draw from a huge domain
     (k >> draws) only materializes the codes actually drawn."""
+    if ints.size == 0:
+        return np.zeros(ints.shape, dtype="<U1")
     if k > ints.size:
         uniq = np.unique(ints)
         strs = np.array([str(v) for v in uniq])
-        return strs[np.searchsorted(uniq, ints)]
+        return _string_gather(strs, np.searchsorted(uniq, ints))
     tokens = np.array([str(v) for v in range(k)])
-    return tokens[ints]
+    return _string_gather(tokens, ints)
+
+
+def _string_gather(tokens: np.ndarray, ints: np.ndarray) -> np.ndarray:
+    """``tokens[ints]`` through an integer view of the fixed-width string
+    buffer: numpy's fancy indexing on '<U' dtypes copies element-wise and
+    is ~25-40% slower than the same gather on the int64/int32 view — at
+    the billion-token benchmark configs (10M rows × 100 tokens) that is
+    seconds of measured datagen."""
+    it = tokens.dtype.itemsize  # '<U' itemsize is 4·width: always %4 == 0
+    unit, step = (np.int64, it // 8) if it % 8 == 0 else (np.int32, it // 4)
+    out = tokens.view(unit).reshape(len(tokens), step)[ints.reshape(-1)]
+    return out.view(tokens.dtype).reshape(ints.shape)
 
 
 def _use_device_gen(n: int, total_elems: int) -> bool:

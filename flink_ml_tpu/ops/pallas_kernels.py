@@ -78,7 +78,10 @@ def pallas_supported() -> bool:
 
     if os.environ.get("FLINK_ML_TPU_DISABLE_PALLAS") == "1":
         return False
-    return jax.default_backend() == "tpu"
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # dead accelerator plugin raises here (mesh.py
+        return False      # _all_devices) — no backend, no pallas
 
 
 def is_pallas_failure(e: Exception) -> bool:

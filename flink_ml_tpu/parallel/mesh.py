@@ -22,8 +22,34 @@ DCN_AXIS = "dcn"      # cross-slice axis (slow network between TPU slices)
 _default_mesh: Optional[Mesh] = None
 
 
+def _all_devices():
+    """All default-backend devices, degrading to the host CPU backend when
+    an accelerator plugin registers but fails to initialize.
+
+    With an explicit platform list (the axon sitecustomize pins
+    ``jax_platforms="axon,cpu"``), a plugin whose init fails makes
+    ``jax.devices()`` RAISE rather than fall through — observed live when
+    the TPU tunnel dies: every host-tier op that touches ``default_mesh()``
+    (e.g. CountVectorizerModel.transform's device counts) crashed with
+    "Unable to initialize backend 'axon'". The framework's host tier must
+    keep working without the chip, so on that failure this process is
+    pinned to the CPU backend (config update — re-probing the broken
+    plugin via ``jax.devices("cpu")`` would re-enter the same failing
+    init) and the mesh comes up on host devices instead."""
+    try:
+        return jax.devices()
+    except RuntimeError as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "default JAX backend unavailable (%s); pinning this process "
+            "to the host CPU backend", e)
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()
+
+
 def local_device_count() -> int:
-    return len(jax.devices())
+    return len(_all_devices())
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -80,7 +106,7 @@ def create_mesh(shape: Sequence[int] = None,
     ``create_mesh()`` → 1-D data mesh over every device.
     ``create_mesh((4, 2), ("data", "model"))`` → 2-D mesh.
     """
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else _all_devices())
     if shape is None:
         shape = (len(devices),)
     arr = np.asarray(devices).reshape(shape)
@@ -107,7 +133,7 @@ def create_hybrid_mesh(ici_shape: Sequence[int] = None,
     runnable in tests — sharding semantics identical, only the physical
     transport differs.
     """
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else _all_devices())
     dcn_shape = tuple(dcn_shape or (1,))
     if ici_shape is None:
         ici_shape = (len(devices) // max(int(np.prod(dcn_shape)), 1),)

@@ -83,6 +83,25 @@ def test_string_generator_distinct():
     assert len(set(t["s"])) <= 5
 
 
+def test_codes_to_strings_matches_direct_gather():
+    """The int-view string gather must be byte-identical to the plain
+    tokens[ints] fancy-index across dense/sparse domains, widths whose
+    '<U' itemsize is and isn't a multiple of 8, and empty input."""
+    from flink_ml_tpu.benchmark.datagen import _codes_to_strings
+
+    rng = np.random.default_rng(0)
+    for k, shape in [(100, (1000, 7)), (3, (50,)), (100000, (20, 4)),
+                     (1, (5,)), (1000, (0, 3))]:
+        ints = rng.integers(0, k, shape)
+        got = _codes_to_strings(ints, k)
+        assert got.dtype.kind == "U"
+        assert got.shape == shape
+        if ints.size:
+            want = np.array([str(v) for v in range(k)])[ints]
+            assert np.array_equal(got, want)
+            assert got.dtype == want.dtype
+
+
 def test_resolve_java_class_names():
     assert resolve_generator(
         "org.apache.flink.ml.benchmark.datagenerator.common."

@@ -134,6 +134,29 @@ def test_fit_on_hybrid_mesh():
     np.testing.assert_allclose(hybrid, flat, rtol=1e-6)
 
 
+def test_mesh_falls_back_to_cpu_when_backend_init_raises(monkeypatch):
+    """A dead accelerator plugin makes jax.devices() RAISE (with an
+    explicit jax_platforms list a failing backend is fatal, not skipped) —
+    mesh construction must degrade to the host CPU backend instead of
+    crashing every host-tier op that touches default_mesh()."""
+    from flink_ml_tpu.parallel import mesh as mesh_mod
+
+    real_devices = jax.devices
+    calls = {"n": 0}
+
+    def flaky_devices(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError(
+                "Unable to initialize backend 'axon': UNAVAILABLE")
+        return real_devices(*args, **kwargs)
+
+    monkeypatch.setattr(jax, "devices", flaky_devices)
+    mesh = mesh_mod.create_mesh()
+    assert calls["n"] == 2
+    assert all(d.platform == "cpu" for d in mesh.devices.flat)
+
+
 def test_init_distributed_single_process_noop():
     from flink_ml_tpu.parallel import init_distributed
 
