@@ -127,6 +127,11 @@ def run_benchmark(name: str, spec: dict) -> dict:
         # rows running far below the memory bound
         "inputBytes": input_bytes,
         "achievedGBps": input_bytes / max(exec_ms, 1e-9) / 1e6,
+        # which execution path the stage actually took (e.g. KnnModel
+        # reports "pallas" vs "xla-chunked") — benchmark rows must name
+        # the code path their number measures
+        **({"executionPath": stage.last_execution_path}
+           if getattr(stage, "last_execution_path", None) else {}),
     }
 
 

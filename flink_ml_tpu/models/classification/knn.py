@@ -97,6 +97,10 @@ class KnnModel(Model, KnnModelParams):
         label_idx_d = jnp.asarray(label_idx)
 
         pred_idx = self._predict_pallas(x, train, label_idx_d, len(classes))
+        # benchmark provenance: which path produced this prediction
+        # (runner.py records it as the row's executionPath)
+        self.last_execution_path = ("pallas" if pred_idx is not None
+                                    else "xla-chunked")
         if pred_idx is None:
             # XLA fallback, memory-bounded: test rows in chunks so no
             # (chunk, n_train) block exceeds _MAX_DIST_ELEMS
@@ -115,16 +119,17 @@ class KnnModel(Model, KnnModelParams):
         """Fused distance+top-k kernel path: the (n, n_train) matrix never
         exists, even tile-wise, outside VMEM. None = not applicable."""
         from flink_ml_tpu.ops.pallas_kernels import (
-            KNN_TILE_N,
             KNN_VMEM_BUDGET_BYTES,
+            _knn_step_vmem_bytes,
             knn_topk_indices,
             pallas_supported,
         )
         global _pallas_knn_broken
         nt, d = train.shape
-        vmem_bytes = nt * (d + KNN_TILE_N) * 4  # train block + dist block
+        # n_train is streamed over the kernel's second grid axis, so only
+        # the per-step working set gates (d would have to reach thousands)
         if (_pallas_knn_broken or not pallas_supported()
-                or vmem_bytes > KNN_VMEM_BUDGET_BYTES):
+                or _knn_step_vmem_bytes(d, self.k) > KNN_VMEM_BUDGET_BYTES):
             return None
         try:
             idx = knn_topk_indices(jnp.asarray(x, jnp.float32), train,

@@ -296,36 +296,74 @@ def sgd_batch_terms(xl, yl, wl, coeffs, start, clip, lb: int, tile: int,
 
 # -- fused distance + top-k (KNN) -------------------------------------------
 
-KNN_TILE_N = 256
-#: VMEM the kernel may claim for the train block plus one (KNN_TILE_N,
-#: n_train) distance block — n_train*(d+KNN_TILE_N)*4 bytes must fit under
-#: it (callers gate on this); past it the chunked XLA path runs
-KNN_VMEM_BUDGET_BYTES = 8 << 20
+KNN_TILE_N = 256   # test rows per grid step
+KNN_TILE_T = 2048  # train rows streamed per grid step
+#: VMEM one grid step may claim — callers gate on
+#: _knn_step_vmem_bytes(d, k) (the authoritative per-step estimate);
+#: n_train itself is unbounded (streamed over the second grid axis)
+KNN_VMEM_BUDGET_BYTES = 32 << 20
 
 
-def _knn_kernel(k: int, x_ref, t_ref, tsq_ref, idx_ref):
-    """One test tile vs the FULL train block, entirely in VMEM: the
-    (tile_n, n_train) distance block never reaches HBM; only the (tile_n,
-    k) neighbor indices are written out. Top-k as k argmin+mask passes —
-    k is small (default 5) and Mosaic has no native top_k."""
+def _knn_step_vmem_bytes(d: int, k: int) -> int:
+    """Upper estimate of one grid step's VMEM working set (bytes): the
+    train/test tiles plus six (KNN_TILE_N, k + KNN_TILE_T)-ish blocks —
+    d2, cross, tile_idx, comb_d, comb_i, and the fori_loop's masked
+    comb_d copy. Deliberately generous: admitting a shape whose real
+    footprint overflows VMEM trips _pallas_knn_broken and degrades EVERY
+    later predict in the process to the XLA path."""
+    return 4 * (KNN_TILE_T * d + KNN_TILE_N * d
+                + 6 * KNN_TILE_N * (k + KNN_TILE_T))
+
+
+def _knn_kernel(k: int, x_ref, t_ref, tsq_ref, idx_ref, bd_ref):
+    """One test tile vs one STREAMED train tile: grid axis 1 walks the
+    train set; the (KNN_TILE_N, k) best-distance/best-index carries ride
+    in the revisited output blocks (the accumulate-across-grid idiom of
+    the Lloyd partials above), so the (n_test, n_train) distance matrix
+    never exists anywhere — not even tile-wise in HBM. Each step merges
+    the carried top-k with the new tile's candidates in k argmin+mask
+    passes (k is small; Mosaic has no native top_k).
+
+    Tie-break: carried candidates (all from earlier tiles, hence lower
+    train indices) sit BEFORE the new tile's columns in the merge block,
+    and argmin takes the first minimum — so equal distances resolve to
+    the lowest train index, matching lax.top_k. Padded train rows enter
+    with tsq = +inf so they can never win a pick while a finite candidate
+    remains (callers keep k ≤ n_train)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        bd_ref[:] = jnp.full(bd_ref.shape, jnp.inf, jnp.float32)
+        idx_ref[:] = jnp.zeros(idx_ref.shape, jnp.int32)
+
     x = x_ref[:]                        # (tile_n, d)
-    t = t_ref[:]                        # (n_train, d)
+    t = t_ref[:]                        # (tile_t, d)
     cross = jnp.dot(x, t.T, preferred_element_type=jnp.float32)
     # ‖x−t‖² up to the per-row constant ‖x‖² (rank-invariant)
     d2 = tsq_ref[:][None, :] - 2.0 * cross
-    n_train = d2.shape[1]
+    tile_n, tile_t = d2.shape
+    tile_idx = j * tile_t + jax.lax.broadcasted_iota(
+        jnp.int32, (tile_n, tile_t), 1)
+    comb_d = jnp.concatenate([bd_ref[:], d2], axis=1)
+    comb_i = jnp.concatenate([idx_ref[:], tile_idx], axis=1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (tile_n, k + tile_t), 1)
 
-    def pick(j, carry):
-        d2, best = carry
-        idx = jnp.argmin(d2, axis=1).astype(jnp.int32)
-        best = jax.lax.dynamic_update_slice(best, idx[:, None], (0, j))
-        taken = jax.nn.one_hot(idx, n_train, dtype=jnp.bool_)
-        d2 = jnp.where(taken, jnp.inf, d2)
-        return d2, best
+    def pick(p, carry):
+        comb_d, bd, bi = carry
+        m = jnp.min(comb_d, axis=1)
+        taken = cols == jnp.argmin(comb_d, axis=1).astype(
+            jnp.int32)[:, None]
+        chosen = jnp.sum(jnp.where(taken, comb_i, 0), axis=1)
+        bd = jax.lax.dynamic_update_slice(bd, m[:, None], (0, p))
+        bi = jax.lax.dynamic_update_slice(bi, chosen[:, None], (0, p))
+        return jnp.where(taken, jnp.inf, comb_d), bd, bi
 
-    best0 = jnp.zeros((x.shape[0], k), jnp.int32)
-    _, best = jax.lax.fori_loop(0, k, pick, (d2, best0))
-    idx_ref[:] = best
+    _, bd, bi = jax.lax.fori_loop(
+        0, k, pick, (comb_d, jnp.zeros((tile_n, k), jnp.float32),
+                     jnp.zeros((tile_n, k), jnp.int32)))
+    bd_ref[:] = bd
+    idx_ref[:] = bi
 
 
 @functools.partial(jax.jit, static_argnames=("k", "interpret"))
@@ -333,26 +371,34 @@ def _knn_padded(x, train, k, interpret=False):
     n, d = x.shape
     nt = train.shape[0]
     tsq = jnp.sum(train * train, axis=1)
+    pad_t = (-nt) % KNN_TILE_T
+    if pad_t:
+        train = jnp.pad(train, ((0, pad_t), (0, 0)))
+        tsq = jnp.pad(tsq, (0, pad_t), constant_values=jnp.inf)
     kernel = functools.partial(_knn_kernel, k)
-    return pl.pallas_call(
+    idx, _ = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n, k), jnp.int32),
-        grid=(n // KNN_TILE_N,),
+        out_shape=(jax.ShapeDtypeStruct((n, k), jnp.int32),
+                   jax.ShapeDtypeStruct((n, k), jnp.float32)),
+        grid=(n // KNN_TILE_N, (nt + pad_t) // KNN_TILE_T),
         in_specs=[
-            pl.BlockSpec((KNN_TILE_N, d), lambda i: (i, 0)),
-            pl.BlockSpec((nt, d), lambda i: (0, 0)),
-            pl.BlockSpec((nt,), lambda i: (0,)),
+            pl.BlockSpec((KNN_TILE_N, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((KNN_TILE_T, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((KNN_TILE_T,), lambda i, j: (j,)),
         ],
-        out_specs=pl.BlockSpec((KNN_TILE_N, k), lambda i: (i, 0)),
+        out_specs=(pl.BlockSpec((KNN_TILE_N, k), lambda i, j: (i, 0)),
+                   pl.BlockSpec((KNN_TILE_N, k), lambda i, j: (i, 0))),
         interpret=interpret,
     )(x, train, tsq)
+    return idx
 
 
 def knn_topk_indices(x, train, k: int, interpret: bool = False):
     """Indices of the k nearest train rows per test row — fused
-    distance+top-k; the (n_test, n_train) matrix exists only tile-wise in
-    VMEM. x: (n, d); train: (n_train, d) with n_train*(d+KNN_TILE_N)*4
-    within KNN_VMEM_BUDGET_BYTES (callers gate on it) → (n, k) int32.
+    distance+top-k streaming over train tiles; the distance matrix exists
+    only as one (KNN_TILE_N, KNN_TILE_T) block in VMEM. x: (n, d);
+    train: (n_train, d), ANY n_train — callers gate on
+    _knn_step_vmem_bytes(d, k) ≤ KNN_VMEM_BUDGET_BYTES → (n, k) int32.
     Ties resolve to the lowest index (argmin), matching lax.top_k."""
     x = jnp.asarray(x, jnp.float32)
     train = jnp.asarray(train, jnp.float32)

@@ -55,7 +55,10 @@ def main() -> int:
           lambda: d2[np.arange(len(x)), np.asarray(pk.assign_nearest(x, c))],
           d2.min(1), rtol=1e-3, atol=1e-2)
 
-    train = rng.normal(size=(64, 16)).astype(np.float32)
+    # train set spans MULTIPLE KNN_TILE_T tiles (with a ragged final
+    # tile) so the streamed carry/merge lowering is what gets proven,
+    # not just the single-tile case
+    train = rng.normal(size=(pk.KNN_TILE_T + 517, 16)).astype(np.float32)
     dt = ((x[:, None, :] - train[None, :, :]) ** 2).sum(-1)
 
     def knn_dists():

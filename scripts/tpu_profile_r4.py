@@ -141,6 +141,42 @@ def main() -> int:
     print("\nRoofline context: LR reads 20x40 MB batches = 800 MB; "
           "KMeans reads 10x400 MB = 4 GB (x2 if the one-hot matmul "
           "re-reads); v5e HBM ~800 GB/s.")
+
+    # ---- north-star LR fit WITH checkpointing on (VERDICT r3 ask #4:
+    # the fast path and fault tolerance must compose — report the real
+    # overhead of interval checkpoints on the measured benchmark) --------
+    import shutil
+    import tempfile
+
+    from flink_ml_tpu.benchmark.datagen import LabeledPointWithWeightGenerator
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.iteration.iteration import IterationConfig
+    from flink_ml_tpu.models.classification import LogisticRegression
+
+    gen = LabeledPointWithWeightGenerator()
+    gen.params_from_json({
+        "colNames": [["features", "label", "weight"]], "seed": 2,
+        "numValues": 10_000_000, "vectorDim": 100, "featureArity": 0,
+        "labelArity": 2})
+    table = gen.get_data()
+
+    def lr():
+        return LogisticRegression(max_iter=20, global_batch_size=100_000,
+                                  learning_rate=0.1, reg=0.0, tol=1e-6)
+
+    plain = timed(lambda: lr().fit(table).coefficients)
+    ckpt_dir = tempfile.mkdtemp(prefix="lr_ckpt_")
+    try:
+        def ck():
+            return lr().set_iteration_config(IterationConfig(
+                mode="device", checkpoint_interval=5,
+                checkpoint_manager=CheckpointManager(ckpt_dir)))
+        ckpted = timed(lambda: ck().fit(table).coefficients)
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    print(f"\nLR north-star fit: plain {plain * 1e3:.1f} ms; "
+          f"checkpoint_interval=5 (device segments) {ckpted * 1e3:.1f} ms; "
+          f"overhead {(ckpted / plain - 1) * 100:.1f}%")
     return 0
 
 

@@ -52,7 +52,7 @@ def main() -> int:
         # fallbacks.
         chk_rc = wait_or_abandon(subprocess.Popen(
             [sys.executable,
-             os.path.join(REPO, "scripts", "tpu_kernel_check.py")]), 900)
+             os.path.join(REPO, "scripts", "tpu_kernel_check.py")]), 2400)
         if chk_rc == 2 or chk_rc is None:
             env["FLINK_ML_TPU_DISABLE_PALLAS"] = "1"
             print(f"kernel check rc={chk_rc} (2 = parity failed, None = "

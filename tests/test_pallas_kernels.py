@@ -40,6 +40,28 @@ def test_knn_topk_matches_xla(rng):
     np.testing.assert_array_equal(got[:, 0], d2.argmin(1))
 
 
+def test_knn_topk_streams_train_tiles(rng):
+    """Train sets spanning several KNN_TILE_T tiles (including a ragged
+    final tile) must produce EXACTLY lax.top_k's indices: the streamed
+    merge keeps ascending-distance order and resolves ties to the lowest
+    train index across tile boundaries (planted duplicate rows force
+    cross-tile ties)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.ops.pallas_kernels import KNN_TILE_T, knn_topk_indices
+
+    for nt in (KNN_TILE_T, 2 * KNN_TILE_T + 517):
+        x = rng.normal(size=(300, 8)).astype(np.float32)
+        train = rng.normal(size=(nt, 8)).astype(np.float32)
+        train[50] = train[nt - 7]      # tie across first/last tile
+        train[51] = train[nt // 2]     # tie across first/middle tile
+        got = np.asarray(knn_topk_indices(x, train, 5, interpret=True))
+        d2 = ((x[:, None, :] - train[None, :, :]) ** 2).sum(-1)
+        want = np.asarray(jax.lax.top_k(-jnp.asarray(d2), 5)[1])
+        np.testing.assert_array_equal(got, want)
+
+
 def test_knn_topk_k_exceeds_train(rng):
     from flink_ml_tpu.ops.pallas_kernels import knn_topk_indices
 
