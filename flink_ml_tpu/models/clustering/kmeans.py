@@ -297,6 +297,9 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                     mesh, self.distance_measure, self.max_iter,
                     unroll=unroll, use_kernel=use_kernel)
                 packed = np.asarray(fit(xs, n_valid, jnp.asarray(init)))
+                # benchmark provenance (runner.py executionPath)
+                self.last_execution_path = (
+                    "pallas-lloyd" if use_kernel else "xla-lloyd")
             except Exception as e:
                 if not use_kernel or not _is_pallas_failure(e):
                     raise
@@ -315,6 +318,7 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                     mesh, self.distance_measure, self.max_iter,
                     unroll=unroll, use_kernel=False)
                 packed = np.asarray(fit(xs, n_valid, jnp.asarray(init)))
+                self.last_execution_path = "xla-lloyd"
             centroids, counts = packed[:, :-1], packed[:, -1]
         else:
 
@@ -333,6 +337,7 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                 body, max_iter=self.max_iter,
                 config=self._iteration_config,
                 listeners=self._iteration_listeners)
+            self.last_execution_path = "host-rounds"
 
         model = KMeansModel(centroids=np.asarray(centroids, np.float64),
                             weights=np.asarray(counts, np.float64))

@@ -175,16 +175,21 @@ class LinearEstimatorBase(Estimator, LinearTrainParams,
             max_iter=self.max_iter, tol=self.tol, reg=self.reg,
             elastic_net=self.elastic_net)
         init = np.zeros(x.shape[1], np.float32)
+        sgd = SGD(params)
         if sparse.is_csr(x):
-            coeffs, _ = SGD(params).optimize_csr(
+            coeffs, _ = sgd.optimize_csr(
                 self.loss, init, x, y, w,
                 config=self._iteration_config,
                 listeners=self._iteration_listeners)
         else:
-            coeffs, _ = SGD(params).optimize(
+            coeffs, _ = sgd.optimize(
                 self.loss, init, x, y, w,
                 config=self._iteration_config,
                 listeners=self._iteration_listeners)
+        # benchmark provenance (runner.py executionPath): which SGD
+        # program shape actually trained this model
+        self.last_execution_path = getattr(sgd, "last_execution_path",
+                                           None)
         model = self.model_class(coefficients=coeffs)
         return self.copy_params_to(model)
 

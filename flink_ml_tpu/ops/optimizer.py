@@ -428,6 +428,7 @@ class SGD:
             init, round_body, max_iter=prm.max_iter,
             terminate=lambda carry, epoch: carry[2] < prm.tol,
             config=config, listeners=listeners, jit_round=False)
+        self.last_execution_path = "csr-host"
         return coeffs, float(mean_loss)
 
     def optimize(self, loss_func: LossFunc, init_coeffs: np.ndarray,
@@ -533,6 +534,8 @@ class SGD:
                     # kernel-execution failures only here
                     coeffs, _, mean_loss, _, _ = prog(xs, ys, ws, init[0],
                                                       init[1])
+                    self.last_execution_path = (
+                        "pallas-unrolled" if use_kernel else "xla-unrolled")
                     return (np.asarray(coeffs, np.float64)[:d],
                             float(mean_loss))
                 except Exception as e:
@@ -549,6 +552,7 @@ class SGD:
                         use_kernel=False)
                     coeffs, _, mean_loss, _, _ = prog(xs, ys, ws, init[0],
                                                       init[1])
+                self.last_execution_path = "xla-unrolled"
                 return np.asarray(coeffs, np.float64)[:d], float(mean_loss)
             seg_prog = _build_sgd_segment_program(type(loss_func), mesh,
                                                   self.params)
@@ -567,6 +571,8 @@ class SGD:
             else:
                 (coeffs, _, mean_loss), _, _ = run_segment(
                     init, 0, self.params.max_iter)
+            self.last_execution_path = ("xla-while-segments" if seg_k
+                                        else "xla-while")
             return np.asarray(coeffs, np.float64)[:d], float(mean_loss)
 
         from flink_ml_tpu.iteration.iteration import iterate_bounded
@@ -585,4 +591,5 @@ class SGD:
             terminate=lambda carry, epoch: carry[2] < self.params.tol,
             config=config, listeners=listeners)
         coeffs, _, mean_loss = final
+        self.last_execution_path = "host-rounds"
         return np.asarray(coeffs, np.float64)[:d], float(mean_loss)
