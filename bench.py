@@ -119,18 +119,34 @@ def _cpu_env(n_devices: int = 8) -> dict:
     return env
 
 
-def _run_worker_child(role: str, deadline_s: float):
+def _run_worker_child(role: str, deadline_s: float,
+                      capture_partial: bool = False):
     """Run this script as a worker child; return its stdout bytes, or None
     on failure/deadline (an over-deadline child is abandoned, not killed —
-    it may hold a live device claim)."""
+    it may hold a live device claim). With ``capture_partial`` the child's
+    stdout goes through a temp file and whatever it printed before an
+    overrun/failure is returned instead of None — used by the north-star
+    child, which re-prints its accumulated JSON after every config."""
+    import tempfile
+
     env = _cpu_env() if role == "cpu" else dict(os.environ)
     env[_ROLE_ENV] = role
+    sink = tempfile.TemporaryFile() if capture_partial else subprocess.PIPE
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
-                            env=env, stdout=subprocess.PIPE)
+                            env=env, stdout=sink)
     try:
         out, _ = proc.communicate(timeout=deadline_s)
     except subprocess.TimeoutExpired:
-        return None
+        if not capture_partial:
+            return None
+        sink.seek(0)
+        return sink.read() or None  # abandoned child may append later;
+        # every line it prints is a complete JSON document, and the
+        # parser takes the last complete line
+    if capture_partial:
+        sink.seek(0)
+        out = sink.read()
+        return out or None
     return out if proc.returncode == 0 else None
 
 
@@ -151,17 +167,24 @@ def _worker(role: str) -> int:
     if role == "tpu_northstar":
         # The judged workloads (BASELINE.md): the reference's own vendored
         # north-star configs — LR 10Mx100 batch-100k 20-iter SGD and
-        # KMeans 1Mx100 k=10. Runs as its OWN child so a hang here can
-        # never cost the already-measured headline (the orchestrator
-        # merges this JSON into the headline line if and only if this
-        # child succeeds within its deadline).
+        # KMeans 1Mx100 k=10 — plus the two rows VERDICT r3 flagged as
+        # never driver-captured on chip: the 10M KNN predict (streamed
+        # pallas kernel) and the FTRL online fit. Runs as its OWN child so
+        # a hang here can never cost the already-measured headline (the
+        # orchestrator merges this JSON into the headline line if and only
+        # if this child succeeds within its deadline). Configs are ordered
+        # most- to least-important, and the accumulated JSON re-prints
+        # after EVERY config (the orchestrator parses the last complete
+        # line) so a deadline overrun only costs the rows not yet run.
         from flink_ml_tpu.benchmark.runner import load_config
 
         cfg_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "flink_ml_tpu", "benchmark", "configs")
         out = {}
         for cfg_file in ("logisticregression-benchmark.json",
-                         "kmeans-benchmark.json"):
+                         "kmeans-benchmark.json",
+                         "knn-benchmark.json",
+                         "onlinelogisticregression-benchmark.json"):
             for name, spec in load_config(
                     os.path.join(cfg_dir, cfg_file)).items():
                 best = best_of(name, spec)
@@ -170,7 +193,9 @@ def _worker(role: str) -> int:
                     "totalTimeMs": round(best["totalTimeMs"], 1),
                     "inputThroughput": round(best["inputThroughput"], 1),
                 }
-        print(json.dumps(out))
+                if "executionPath" in best:
+                    out[name]["executionPath"] = best["executionPath"]
+                print(json.dumps(out), flush=True)
         return 0
 
     best = best_of("KMeans-demo", DEMO_SPEC)
@@ -217,15 +242,25 @@ def main() -> int:
         # while the attached north-star numbers carry the real scale.
         # Any parse failure below degrades to emitting the headline
         # verbatim — merging must never cost the measured number.
-        ns = _run_worker_child("tpu_northstar", run_deadline)
+        ns = _run_worker_child("tpu_northstar", run_deadline,
+                               capture_partial=True)
         try:
             line = json.loads(out)
-            try:
-                line["northstar"] = json.loads(ns)
-            except (TypeError, ValueError):
-                line["northstar"] = {"error": "north-star child failed, "
-                                     "exceeded deadline, or emitted "
-                                     "unparseable output"}
+            # the child re-prints cumulative JSON per config; walk the
+            # lines in reverse and keep the first that PARSES — the final
+            # line of an abandoned child's snapshot can be a torn write
+            ns_doc = None
+            for raw in reversed((ns or b"").splitlines()):
+                if not raw.strip():
+                    continue
+                try:
+                    ns_doc = json.loads(raw)
+                    break
+                except ValueError:
+                    continue
+            line["northstar"] = ns_doc if ns_doc is not None else {
+                "error": "north-star child failed, exceeded deadline, "
+                "or emitted unparseable output"}
             out = (json.dumps(line) + "\n").encode()
         except ValueError:
             pass  # headline child printed something unexpected: ship as-is
