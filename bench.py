@@ -187,15 +187,24 @@ def _worker(role: str) -> int:
                          "onlinelogisticregression-benchmark.json"):
             for name, spec in load_config(
                     os.path.join(cfg_dir, cfg_file)).items():
-                best = best_of(name, spec)
-                out[name] = {
-                    "inputRecordNum": best["inputRecordNum"],
-                    "totalTimeMs": round(best["totalTimeMs"], 1),
-                    "inputThroughput": round(best["inputThroughput"], 1),
-                }
-                if "executionPath" in best:
-                    out[name]["executionPath"] = best["executionPath"]
+                try:
+                    best = best_of(name, spec)
+                    out[name] = {
+                        "inputRecordNum": best["inputRecordNum"],
+                        "totalTimeMs": round(best["totalTimeMs"], 1),
+                        "inputThroughput": round(best["inputThroughput"],
+                                                 1),
+                    }
+                    if "executionPath" in best:
+                        out[name]["executionPath"] = best["executionPath"]
+                except Exception as e:  # noqa: BLE001 — one failing
+                    # config must not cost the remaining rows
+                    out[name] = {"exception": f"{type(e).__name__}: {e}"}
                 print(json.dumps(out), flush=True)
+        # completeness marker: a snapshot missing this final doc was cut
+        # short (the orchestrator labels it "_partial")
+        out["_complete"] = True
+        print(json.dumps(out), flush=True)
         return 0
 
     best = best_of("KMeans-demo", DEMO_SPEC)
@@ -258,6 +267,9 @@ def main() -> int:
                     break
                 except ValueError:
                     continue
+            if ns_doc is not None and not ns_doc.pop("_complete", False):
+                # crashed or overran after some rows: keep them, say so
+                ns_doc["_partial"] = True
             line["northstar"] = ns_doc if ns_doc is not None else {
                 "error": "north-star child failed, exceeded deadline, "
                 "or emitted unparseable output"}

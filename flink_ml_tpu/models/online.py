@@ -358,6 +358,9 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
         # the mesh initializes the device backend — only on the first DENSE
         # batch, so an all-sparse stream trains with no device at all
         mesh = axes = None
+        n_dense = n_sparse = 0  # benchmark provenance (executionPath)
+        self.last_execution_path = None  # a zero-batch refit must not
+        # inherit the previous fit's label
 
         for batch in _as_stream(data, self.global_batch_size):
             # float32 request: a device-resident dense column passes
@@ -384,6 +387,7 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                                  jnp.asarray(z, jnp.float32),
                                  jnp.asarray(n, jnp.float32))
                 state_dev = program(xb, yb, jnp.float32(n_rows), *state_dev)
+                n_dense += 1
                 version += 1
                 dev_pending.append(len(history))
                 history.append((version, state_dev[0]))
@@ -421,12 +425,21 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                 np.abs(z) <= l1, 0.0,
                 (np.sign(z) * l1 - z) / ((beta + np.sqrt(n)) / alpha + l2))
             version += 1
+            n_sparse += 1
             history.append((version, coeffs.copy()))
             ckpt.after_batch(pack)
 
         ckpt.complete(pack)
         to_host()
         materialize_history()
+        # benchmark provenance (runner.py executionPath): where the FTRL
+        # batch updates actually ran
+        if n_dense and n_sparse:
+            self.last_execution_path = (f"mixed(device={n_dense},"
+                                        f"host-csr={n_sparse})")
+        elif n_dense or n_sparse:
+            self.last_execution_path = ("device-batches" if n_dense
+                                        else "host-csr-batches")
         model.coefficients = coeffs
         model.model_version = version
         model.history = history
