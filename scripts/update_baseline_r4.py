@@ -83,6 +83,8 @@ def build_section(results: dict) -> str:
             continue
         res = entry["results"]
         plat = entry.get("platform", "?")
+        if res.get("executionPath"):
+            plat = f"{plat} ({res['executionPath']})"
         lines.append(
             f"| {label} | **{fmt_throughput(res['inputThroughput'])}** "
             f"| {res['totalTimeMs'] / 1000.0:.2f} s | {plat} | {r3} |")
