@@ -1,0 +1,132 @@
+"""bench.py orchestrator logic, stubbed at the worker boundary.
+
+The orchestrator process never imports jax (its docstring contract), so
+these tests exercise the real main() with fake worker children: headline
+merging, north-star partial-snapshot recovery (torn writes, overruns),
+the completeness marker, and the both-workers-failed labeled line. The
+measured workloads themselves are covered by the benchmark runner tests.
+"""
+
+import importlib
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+import bench  # noqa: E402
+
+
+HEADLINE = (json.dumps({"metric": "m", "value": 1.0, "unit": "r/s",
+                        "vs_baseline": 2.0, "platform": "tpu"})
+            + "\n").encode()
+
+
+class _FakeOut:
+    """stdout stand-in exposing both .write(str) and .buffer.write(bytes)."""
+
+    def __init__(self):
+        self.b = b""
+
+    class _Buf:
+        def __init__(self, o):
+            self.o = o
+
+        def write(self, data):
+            self.o.b += data
+
+    @property
+    def buffer(self):
+        return _FakeOut._Buf(self)
+
+    def write(self, s):
+        self.b += s.encode()
+        return len(s)
+
+    def flush(self):
+        pass
+
+
+@pytest.fixture()
+def orchestrate(monkeypatch):
+    importlib.reload(bench)
+    monkeypatch.setattr(bench, "_wait_for_backend", lambda budget: True)
+
+    def run(ns_bytes, tpu_out=HEADLINE):
+        def fake_child(role, deadline, capture_partial=False):
+            if role == "tpu":
+                return tpu_out
+            assert role == "tpu_northstar" and capture_partial
+            return ns_bytes
+        bench._run_worker_child = fake_child
+        fo = _FakeOut()
+        old = sys.stdout
+        sys.stdout = fo
+        try:
+            rc = bench.main()
+        finally:
+            sys.stdout = old
+        return rc, json.loads(fo.b)
+
+    return run
+
+
+def test_complete_northstar_merges_without_partial_flag(orchestrate):
+    full = {"lr": {"inputThroughput": 1}, "km": {"inputThroughput": 2}}
+    done = dict(full, _complete=True)
+    rc, line = orchestrate(
+        (json.dumps(full) + "\n" + json.dumps(done) + "\n").encode())
+    assert rc == 0 and line["platform"] == "tpu"
+    ns = line["northstar"]
+    assert set(ns) == {"lr", "km"}
+    assert "_partial" not in ns and "_complete" not in ns
+
+
+def test_overrun_keeps_measured_rows_and_flags_partial(orchestrate):
+    rc, line = orchestrate(
+        (json.dumps({"lr": {"inputThroughput": 1}}) + "\n").encode())
+    assert rc == 0
+    assert line["northstar"]["_partial"] is True
+    assert line["northstar"]["lr"]["inputThroughput"] == 1
+
+
+def test_torn_final_write_falls_back_to_previous_line(orchestrate):
+    good = json.dumps({"lr": {"inputThroughput": 1}})
+    torn = '{"lr": {"inputThroughput": 1}, "km": {"inpu'
+    rc, line = orchestrate((good + "\n" + torn).encode())
+    assert rc == 0
+    assert line["northstar"]["lr"]["inputThroughput"] == 1
+    assert line["northstar"]["_partial"] is True
+
+
+def test_missing_northstar_degrades_to_labeled_error(orchestrate):
+    rc, line = orchestrate(None)
+    assert rc == 0
+    assert "error" in line["northstar"]
+    # headline survives untouched
+    assert line["value"] == 1.0 and line["platform"] == "tpu"
+
+
+def test_exception_rows_ride_along(orchestrate):
+    doc = {"lr": {"inputThroughput": 1},
+           "knn": {"exception": "RuntimeError: boom"}, "_complete": True}
+    rc, line = orchestrate((json.dumps(doc) + "\n").encode())
+    ns = line["northstar"]
+    assert ns["knn"]["exception"].startswith("RuntimeError")
+    assert "_partial" not in ns
+
+
+def test_both_workers_failed_emits_labeled_failure(monkeypatch):
+    importlib.reload(bench)
+    monkeypatch.setattr(bench, "_wait_for_backend", lambda budget: False)
+    bench._run_worker_child = (
+        lambda role, deadline, capture_partial=False: None)
+    fo = _FakeOut()
+    old = sys.stdout
+    sys.stdout = fo
+    try:
+        rc = bench.main()
+    finally:
+        sys.stdout = old
+    line = json.loads(fo.b)
+    assert rc == 1 and line["platform"] == "failed" and "error" in line
