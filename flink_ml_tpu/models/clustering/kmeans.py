@@ -227,8 +227,16 @@ class KMeansModel(Model, KMeansModelParams):
                 # fused distance+argmin pallas kernel: no (n, k) in HBM
                 labels = np.asarray(assign_nearest(
                     x, np.asarray(self.centroids, np.float32)))
-            except Exception:
+            except Exception as e:
+                # same policy as fit below: only a pallas/Mosaic failure
+                # disables the kernel; a capacity error (HBM OOM) must
+                # surface, not silently demote every later transform
+                if not _is_pallas_failure(e):
+                    raise
                 _pallas_assign_broken = True  # lowering failed; use XLA
+        # benchmark provenance (runner.py executionPath)
+        self.last_execution_path = ("pallas-assign" if labels is not None
+                                    else "xla-assign")
         if labels is None:
             assign = _build_assign_program(self.distance_measure)
             labels = np.asarray(assign(
