@@ -121,6 +121,7 @@ class KnnModel(Model, KnnModelParams):
         from flink_ml_tpu.ops.pallas_kernels import (
             KNN_VMEM_BUDGET_BYTES,
             _knn_step_vmem_bytes,
+            is_surrounding_failure,
             knn_topk_indices,
             pallas_supported,
         )
@@ -137,10 +138,17 @@ class KnnModel(Model, KnnModelParams):
             vote = _build_vote_program(num_classes)
             return np.asarray(vote(idx, label_idx_d))
         except Exception as e:
-            # any kernel failure falls back to the (correct, slower) XLA
-            # path rather than crashing predict; the process flag stops
-            # re-tracing the same failure each call, and the warning keeps
-            # the cause visible (same policy as the KMeans assign kernel)
+            # kernel failures fall back to the (correct, slower) XLA path
+            # rather than crashing predict; the process flag stops
+            # re-tracing the same failure each call, and the warning
+            # keeps the cause visible (same policy as the KMeans assign
+            # kernel). This try wraps only the kernel call, so the
+            # default for an unrecognized error is fall-back-and-flag;
+            # only a positively identified surrounding failure (HBM OOM
+            # placing the test set) re-raises instead of being
+            # misattributed to the kernel.
+            if is_surrounding_failure(e):
+                raise
             import logging
 
             logging.getLogger(__name__).warning(

@@ -228,11 +228,20 @@ class KMeansModel(Model, KMeansModelParams):
                 labels = np.asarray(assign_nearest(
                     x, np.asarray(self.centroids, np.float32)))
             except Exception as e:
-                # same policy as fit below: only a pallas/Mosaic failure
-                # disables the kernel; a capacity error (HBM OOM) must
-                # surface, not silently demote every later transform
-                if not _is_pallas_failure(e):
+                # this try wraps only the kernel call, so an unrecognized
+                # error defaults to fall-back-and-flag (KNN predict's
+                # policy); only a positively identified surrounding
+                # failure — an HBM OOM placing the input — re-raises
+                from flink_ml_tpu.ops.pallas_kernels import (
+                    is_surrounding_failure)
+
+                if is_surrounding_failure(e):
                     raise
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "pallas assign kernel failed; using the XLA path for "
+                    "the rest of this process: %s: %s", type(e).__name__, e)
                 _pallas_assign_broken = True  # lowering failed; use XLA
         # benchmark provenance (runner.py executionPath)
         self.last_execution_path = ("pallas-assign" if labels is not None

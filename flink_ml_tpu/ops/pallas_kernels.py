@@ -100,6 +100,19 @@ def is_pallas_failure(e: Exception) -> bool:
                                    "memory space vmem"))
 
 
+def is_surrounding_failure(e: Exception) -> bool:
+    """Positive identification of a failure in the SURROUNDING program —
+    today an HBM RESOURCE_EXHAUSTED (without a VMEM marker) from placing
+    the inputs. Predict paths whose ``try`` wraps only the kernel call
+    use this as the re-raise test: there, an unrecognized error is far
+    more likely a kernel failure than a program one, so the default is
+    fall-back-and-flag (the inverse of the fit paths, whose ``try``
+    spans the whole program and which re-raise on
+    ``not is_pallas_failure``)."""
+    text = f"{type(e).__name__}: {e}"
+    return "RESOURCE_EXHAUSTED" in text and "vmem" not in text.lower()
+
+
 # -- fused Lloyd round: assign + accumulate (KMeans fit) ---------------------
 
 #: VMEM the kernel's working set may claim: double-buffered (TILE_N, d)
