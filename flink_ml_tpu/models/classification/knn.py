@@ -142,15 +142,21 @@ class KnnModel(Model, KnnModelParams):
             # rather than crashing predict; the process flag stops
             # re-tracing the same failure each call, and the warning
             # keeps the cause visible (same policy as the KMeans assign
-            # kernel). This try wraps only the kernel call, so the
-            # default for an unrecognized error is fall-back-and-flag;
-            # only a positively identified surrounding failure (HBM OOM
-            # placing the test set) re-raises instead of being
-            # misattributed to the kernel.
-            if is_surrounding_failure(e):
-                raise
+            # kernel). An HBM RESOURCE_EXHAUSTED here is ALSO a
+            # kernel-path failure: knn_topk_indices places and pads full
+            # copies of x and train that the chunked XLA fallback never
+            # materializes (it slices numpy and places chunk by chunk),
+            # so the fallback can succeed where the kernel path OOMed —
+            # but it is a size-specific failure, not a broken lowering,
+            # so it does not burn the process-wide flag.
             import logging
 
+            if is_surrounding_failure(e):
+                logging.getLogger(__name__).warning(
+                    "pallas KNN path exhausted HBM placing its padded "
+                    "inputs; using the memory-bounded XLA path for this "
+                    "call: %s: %s", type(e).__name__, e)
+                return None
             logging.getLogger(__name__).warning(
                 "pallas KNN kernel failed; using the XLA path for the "
                 "rest of this process: %s: %s", type(e).__name__, e)

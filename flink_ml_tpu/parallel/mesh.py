@@ -21,6 +21,51 @@ DCN_AXIS = "dcn"      # cross-slice axis (slow network between TPU slices)
 
 _default_mesh: Optional[Mesh] = None
 
+#: the jax_platforms value in force before the CPU fallback pinned it, so
+#: reset_backend_fallback() can deliberately retry the accelerator later
+_platforms_before_pin = None
+
+
+def _distributed_client_live() -> bool:
+    """True when this process joined a multi-host JAX runtime (no public
+    API; same probe as init_distributed)."""
+    try:
+        from jax._src import distributed as _distributed
+        return _distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+def reset_backend_fallback() -> None:
+    """Undo the CPU pin applied by ``_all_devices`` so the next mesh
+    construction retries the accelerator plugin. Deliberate-retry only:
+    the pin is not retried automatically because a broken axon init can
+    hang for many minutes per attempt.
+
+    Restoring the config string alone is not enough — once
+    ``jax.devices()`` succeeds on the pinned CPU platform JAX caches
+    that backend set (and this module caches a CPU default mesh), so
+    both caches are dropped here too; the next ``jax.devices()`` call
+    re-probes the accelerator plugin for real."""
+    global _platforms_before_pin, _default_mesh
+    if _platforms_before_pin is not None:
+        jax.config.update("jax_platforms", _platforms_before_pin)
+        _platforms_before_pin = None
+        _default_mesh = None
+        _clear_jax_backends()
+
+
+def _clear_jax_backends() -> None:
+    """Drop JAX's cached backend set so the next ``jax.devices()``
+    re-probes the plugin list. NOTE: invalidates live device arrays —
+    only called from the deliberate-retry path, never mid-computation."""
+    try:
+        import jax.extend.backend
+        jax.extend.backend.clear_backends()
+    except Exception:  # pragma: no cover — older jax layouts
+        from jax._src import xla_bridge
+        xla_bridge.backends.cache_clear()
+
 
 def _all_devices():
     """All default-backend devices, degrading to the host CPU backend when
@@ -35,15 +80,35 @@ def _all_devices():
     keep working without the chip, so on that failure this process is
     pinned to the CPU backend (config update — re-probing the broken
     plugin via ``jax.devices("cpu")`` would re-enter the same failing
-    init) and the mesh comes up on host devices instead."""
+    init) and the mesh comes up on host devices instead.
+
+    The fallback is single-process only: a worker inside a multi-host
+    runtime that silently came up on CPU while its peers run on the
+    accelerator would build a divergent mesh and hang or corrupt the
+    collectives, so there the error propagates. Set
+    ``FLINK_ML_TPU_NO_CPU_FALLBACK=1`` to disable the fallback entirely,
+    and call :func:`reset_backend_fallback` to retry the accelerator
+    after a pin."""
+    global _platforms_before_pin
     try:
         return jax.devices()
     except RuntimeError as e:
         import logging
+        import os
 
+        if _distributed_client_live():
+            raise RuntimeError(
+                "default JAX backend unavailable in a multi-process "
+                "runtime; refusing the CPU fallback (peers would run a "
+                "divergent mesh)") from e
+        if os.environ.get("FLINK_ML_TPU_NO_CPU_FALLBACK"):
+            raise
         logging.getLogger(__name__).warning(
             "default JAX backend unavailable (%s); pinning this process "
-            "to the host CPU backend", e)
+            "to the host CPU backend (reset_backend_fallback() retries "
+            "the accelerator)", e)
+        if _platforms_before_pin is None:
+            _platforms_before_pin = jax.config.jax_platforms
         jax.config.update("jax_platforms", "cpu")
         return jax.devices()
 

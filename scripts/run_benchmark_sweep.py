@@ -147,10 +147,17 @@ def main(argv=None) -> int:
                     "--title", "flink-ml-tpu benchmark sweep"])
     # nonzero when any row is still unmeasured (exception recorded, e.g.
     # the tunnel died mid-sweep) so wait-and-retry wrappers keep retrying;
-    # the demo's intentional-error entries count as measured
+    # the demo's intentional-error entries count as measured.
+    # unexpectedSuccess rows are NOT retryable: --resume skips them (they
+    # carry "results"), so counting them here would make every retry
+    # return 2 without progress and burn the wrapper's whole budget —
+    # report them loudly instead.
+    regressed = [n for n, e in results.items() if e.get("unexpectedSuccess")]
+    if regressed:
+        print(f"VALIDATION REGRESSION (ran without error, should have "
+              f"raised): {regressed}")
     failed = [n for n, e in results.items()
-              if ("results" not in e and not e.get("expectedFailure"))
-              or e.get("unexpectedSuccess")]
+              if "results" not in e and not e.get("expectedFailure")]
     if failed:
         print(f"{len(failed)} benchmarks unmeasured: {failed}")
         return 2

@@ -2,6 +2,7 @@
 BroadcastUtilsTest.java run on MiniCluster)."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -152,9 +153,54 @@ def test_mesh_falls_back_to_cpu_when_backend_init_raises(monkeypatch):
         return real_devices(*args, **kwargs)
 
     monkeypatch.setattr(jax, "devices", flaky_devices)
-    mesh = mesh_mod.create_mesh()
-    assert calls["n"] == 2
-    assert all(d.platform == "cpu" for d in mesh.devices.flat)
+    # recording stub: really clearing JAX's backend cache mid-suite would
+    # invalidate every live array in this pytest process
+    cleared = []
+    monkeypatch.setattr(mesh_mod, "_clear_jax_backends",
+                        lambda: cleared.append(True))
+    platforms_before = jax.config.jax_platforms
+    try:
+        mesh = mesh_mod.create_mesh()
+        assert calls["n"] == 2
+        assert all(d.platform == "cpu" for d in mesh.devices.flat)
+        # the pin must be reversible (a deliberate retry can reach the
+        # accelerator again): config restored AND the cached backend set
+        # + default mesh dropped so jax.devices() really re-probes —
+        # and reversing it here also keeps the CPU pin from leaking into
+        # later backend-sensitive tests
+        mesh_mod.reset_backend_fallback()
+        assert jax.config.jax_platforms == platforms_before
+        assert cleared and mesh_mod._default_mesh is None
+    finally:
+        jax.config.update("jax_platforms", platforms_before)
+        mesh_mod._platforms_before_pin = None
+
+
+def test_mesh_fallback_refuses_in_multiprocess_runtime(monkeypatch):
+    """Inside a multi-host runtime a worker silently coming up on CPU
+    would diverge from its peers — the fallback must re-raise instead."""
+    from flink_ml_tpu.parallel import mesh as mesh_mod
+
+    def dead_devices(*args, **kwargs):
+        raise RuntimeError("Unable to initialize backend 'axon'")
+
+    monkeypatch.setattr(jax, "devices", dead_devices)
+    monkeypatch.setattr(mesh_mod, "_distributed_client_live", lambda: True)
+    with pytest.raises(RuntimeError, match="multi-process"):
+        mesh_mod._all_devices()
+
+
+def test_mesh_fallback_env_opt_out(monkeypatch):
+    """FLINK_ML_TPU_NO_CPU_FALLBACK=1 disables the CPU pin entirely."""
+    from flink_ml_tpu.parallel import mesh as mesh_mod
+
+    def dead_devices(*args, **kwargs):
+        raise RuntimeError("Unable to initialize backend 'axon'")
+
+    monkeypatch.setattr(jax, "devices", dead_devices)
+    monkeypatch.setenv("FLINK_ML_TPU_NO_CPU_FALLBACK", "1")
+    with pytest.raises(RuntimeError, match="axon"):
+        mesh_mod._all_devices()
 
 
 def test_init_distributed_single_process_noop():
