@@ -2,7 +2,9 @@
 """Headline benchmark. Prints ONE JSON line:
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "platform": ...}
 ("platform" records provenance: "axon" = real TPU, "cpu-fallback" = the
-8-device CPU mesh used when the TPU tunnel is unavailable.)
+8-device CPU mesh used when the TPU tunnel is unavailable. On
+cpu-fallback vs_baseline is null — a host-CPU ratio is not comparable to
+on-chip rounds; the raw ratio moves to "vs_baseline_cpu_raw".)
 
 Workload: the reference's own benchmark demo (flink-ml-benchmark
 benchmark-demo.json "KMeans-1": KMeans with default params on 10,000 uniform
@@ -209,23 +211,30 @@ def _worker(role: str) -> int:
 
     best = best_of("KMeans-demo", DEMO_SPEC)
     value = best["inputThroughput"]
+    ratio = round(value / REFERENCE_DEMO_THROUGHPUT, 2)
     line = {
         "metric": "kmeans_demo_input_throughput_10kx10",
         "value": round(value, 1),
         "unit": "records/s",
-        "vs_baseline": round(value / REFERENCE_DEMO_THROUGHPUT, 2),
+        "vs_baseline": ratio,
         "platform": ("cpu-fallback" if role == "cpu"
                      else jax.default_backend()),
     }
     if role == "cpu":
         # a host-CPU demo beating the README sample says nothing about
         # the TPU framework (VERDICT r3 weak #6: the r3 cpu ratio read
-        # HIGHER than the r2 on-chip one) — label it so nobody quotes it.
+        # HIGHER than the r2 on-chip one; VERDICT r4 next-#8: the r02
+        # tpu → r03/r04 cpu headline series read as cross-platform
+        # regression noise). The headline ratio is therefore null on
+        # this platform — the raw host-CPU ratio survives in a side
+        # field for diagnosis only.
         # Generic cause: this worker can't tell an unreachable tunnel
         # from a crashed/overdue TPU child.
-        line["note"] = ("vs_baseline on cpu-fallback is not comparable "
-                        "to on-chip rounds; the TPU worker was "
-                        "unavailable or failed")
+        line["vs_baseline"] = None
+        line["vs_baseline_cpu_raw"] = ratio
+        line["note"] = ("vs_baseline is null on cpu-fallback: a host-CPU "
+                        "ratio is not comparable to on-chip rounds; the "
+                        "TPU worker was unavailable or failed")
     print(json.dumps(line))
     return 0
 
@@ -283,7 +292,7 @@ def main() -> int:
         # records a diagnosable entry, but exit nonzero.
         print(json.dumps({
             "metric": "kmeans_demo_input_throughput_10kx10",
-            "value": 0, "unit": "records/s", "vs_baseline": 0,
+            "value": 0, "unit": "records/s", "vs_baseline": None,
             "platform": "failed", "error": "tpu and cpu workers both failed "
             "or exceeded deadline; see stderr"}))
         return 1

@@ -116,6 +116,30 @@ def test_exception_rows_ride_along(orchestrate):
     assert "_partial" not in ns
 
 
+def test_cpu_fallback_worker_nulls_vs_baseline(monkeypatch):
+    """A cpu-fallback headline must not feed the cross-round vs_baseline
+    series (VERDICT r4 next-#8): the ratio moves to vs_baseline_cpu_raw
+    and the headline field is null."""
+    import flink_ml_tpu.benchmark.runner as runner
+
+    importlib.reload(bench)
+    monkeypatch.setattr(runner, "best_of", lambda name, spec: {
+        "inputRecordNum": 10_000, "totalTimeMs": 10.0,
+        "inputThroughput": 1_000_000.0})
+    fo = _FakeOut()
+    old = sys.stdout
+    sys.stdout = fo
+    try:
+        rc = bench._worker("cpu")
+    finally:
+        sys.stdout = old
+    line = json.loads(fo.b)
+    assert rc == 0 and line["platform"] == "cpu-fallback"
+    assert line["vs_baseline"] is None
+    assert line["vs_baseline_cpu_raw"] > 0
+    assert "note" in line
+
+
 def test_both_workers_failed_emits_labeled_failure(monkeypatch):
     importlib.reload(bench)
     monkeypatch.setattr(bench, "_wait_for_backend", lambda budget: False)
