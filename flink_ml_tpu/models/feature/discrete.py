@@ -124,31 +124,68 @@ class StringIndexerModel(Model, StringIndexerModelParams):
         self.string_arrays = rw.load_model_json(path, "model")["stringArrays"]
 
 
+def _si_shard_counts(col: np.ndarray, lo: int, hi: int):
+    """Per-shard StringIndexer partial: (distinct values, counts, first
+    global occurrence index) over rows [lo, hi) — the per-task count map
+    of StringIndexer.java:117-122, merged by :func:`_merge_si_counts`.
+    '<U' columns hash-factorize (no string sort of the shard); first
+    occurrence comes from one reversed scatter (last write wins → first
+    occurrence survives)."""
+    from flink_ml_tpu.models.feature.text import _token_codes
+
+    sub = col[lo:hi]
+    if sub.dtype.kind == "U" and len(sub):
+        uniq, codes = _token_codes(sub)
+        cnts = np.bincount(codes, minlength=len(uniq))
+        first_idx = np.empty(len(uniq), np.int64)
+        first_idx[codes[::-1]] = np.arange(hi - lo - 1, -1, -1,
+                                           dtype=np.int64)
+    else:
+        uniq, first_idx, cnts = np.unique(
+            sub, return_index=True, return_counts=True)
+    return uniq, cnts.astype(np.int64, copy=False), first_idx + lo
+
+
+def _merge_si_counts(parts):
+    """Reduce-merge of per-shard (values, counts, first index) — the
+    reference's DataStreamUtils.reduce map merge
+    (StringIndexer.java:125-142). Counts sum; first occurrence is the
+    minimum global index. The merged distinct set comes back sorted
+    (np.unique), matching the single-shard _token_codes order."""
+    if len(parts) == 1:
+        return parts[0]
+    all_u = np.concatenate([p[0] for p in parts])
+    uniq, inv = np.unique(all_u, return_inverse=True)
+    cnts = np.zeros(len(uniq), np.int64)
+    first = np.full(len(uniq), np.iinfo(np.int64).max)
+    k = 0
+    for pu, pc, pf in parts:
+        idx = inv[k:k + len(pu)]
+        np.add.at(cnts, idx, pc)
+        np.minimum.at(first, idx, pf)
+        k += len(pu)
+    return uniq, cnts, first
+
+
 class StringIndexer(Estimator, StringIndexerParams):
     """Learns per-column string→index dictionaries (ref: StringIndexer.java:
-    per-task count maps → global merge → ordering by freq/alphabet)."""
+    per-task count maps → global merge → ordering by freq/alphabet). The
+    per-task shape is literal here: homogeneous columns fan over the host
+    pool on row shards; per-shard count maps merge reduce-style."""
 
     def fit(self, table: Table) -> StringIndexerModel:
+        from flink_ml_tpu.common.hostpool import map_row_shards
+
         arrays = []
         order = self.string_order_type
         for name in self.input_cols:
             col = table.column(name)
             if isinstance(col, np.ndarray) and col.dtype != object:
-                # homogeneous column: count/order once per DISTINCT value;
-                # '<U' columns hash-factorize (no global string sort) with
-                # first-occurrence via one reversed scatter (last write
-                # wins → first occurrence survives)
-                if col.dtype.kind == "U" and len(col):
-                    from flink_ml_tpu.models.feature.text import \
-                        _token_codes
-                    uniq, codes = _token_codes(col)
-                    cnts = np.bincount(codes, minlength=len(uniq))
-                    first_idx = np.empty(len(uniq), np.int64)
-                    first_idx[codes[::-1]] = np.arange(
-                        len(col) - 1, -1, -1, dtype=np.int64)
-                else:
-                    uniq, first_idx, cnts = np.unique(
-                        col, return_index=True, return_counts=True)
+                # homogeneous column: count/order once per DISTINCT value,
+                # counted per shard in forked workers, merged reduce-style
+                uniq, cnts, first_idx = _merge_si_counts(map_row_shards(
+                    lambda lo, hi: _si_shard_counts(col, lo, hi),
+                    len(col)))
                 svals = np.array([str(v) for v in uniq])
                 if order == self.FREQUENCY_DESC_ORDER:
                     pick = np.lexsort((svals, -cnts))
@@ -370,9 +407,11 @@ class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
         raw = table.column(self.input_col)
         if columnar.is_device_array(raw):
             # slice BEFORE the host off-ramp: only subSamples rows cross
-            # D2H (the reference likewise fits on the subsample)
+            # D2H (the reference likewise fits on the subsample). Compiled
+            # static slice — eager [:n] on a sharded array is ~2 s warm
+            # (columnar.head_rows)
             n = min(raw.shape[0], self.sub_samples)
-            x = np.asarray(raw[:n], np.float64)
+            x = np.asarray(columnar.head_rows(raw, n), np.float64)
             if x.ndim == 1:
                 x = x[:, None]
         else:
@@ -513,7 +552,8 @@ class VectorIndexer(Estimator, VectorIndexerParams):
             # doing both
             n, d = x.shape
             s_cand, _ = columnar.apply(
-                _sized_unique_kernel, x[: min(n, 4096)], static=(k,))
+                _sized_unique_kernel, columnar.head_rows(x, min(n, 4096)),
+                static=(k,))
             s_cand = np.asarray(s_cand)
             possible = [dim for dim in range(d)
                         if np.isnan(s_cand[dim]).any()]
@@ -525,7 +565,7 @@ class VectorIndexer(Estimator, VectorIndexerParams):
                 # that directly; dims with non-finite or fractional
                 # values re-fit from ONE shared host off-ramp so NaN/inf
                 # and fractional keys get exact np.unique semantics.
-                sub = x[:, np.asarray(possible)]
+                sub = columnar.take_dims(x, possible)
                 cand, nonfinite = columnar.apply(
                     _sized_unique_kernel, sub, static=(k,))
                 cand = np.asarray(cand, np.float64)

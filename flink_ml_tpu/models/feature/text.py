@@ -314,31 +314,75 @@ def _tokenize_distinct(col: np.ndarray, tokenize):
     return uniq_objs[codes]
 
 
+def _merge_token_shards(parts):
+    """Merge per-shard tokenization results (host-pool reduce step).
+
+    Equal-width 2-D token matrices vstack back into one matrix (numpy
+    promotes differing '<U' itemsizes); anything else — ragged shards,
+    object columns, mixed widths across shards — becomes one object
+    column. Cells of a matrix shard land as read-only row views, which
+    downstream ops treat like the token lists they replace (both are
+    sized iterables of strings)."""
+    if len(parts) == 1:
+        return parts[0]
+    if all(isinstance(p, np.ndarray) and p.ndim == 2 for p in parts) \
+            and len({p.shape[1] for p in parts}) == 1:
+        return np.vstack(parts)
+    out = np.empty(sum(len(p) for p in parts), dtype=object)
+    k = 0
+    for p in parts:
+        if isinstance(p, np.ndarray) and p.ndim == 2:
+            for row in p:
+                out[k] = row
+                k += 1
+        else:
+            out[k:k + len(p)] = p
+            k += len(p)
+    return out
+
+
 class Tokenizer(Transformer, HasInputCol, HasOutputCol):
-    """Lowercase + whitespace split (ref: feature/tokenizer/Tokenizer.java)."""
+    """Lowercase + whitespace split (ref: feature/tokenizer/Tokenizer.java).
+
+    Fanned over the host pool on row shards (the reference runs every
+    string op on defaultParallelism subtasks); each worker lowercases and
+    tokenizes its shard, the parent merges (_merge_token_shards)."""
 
     def transform(self, table: Table) -> Tuple[Table]:
+        from flink_ml_tpu.common.hostpool import map_row_shards
+
         col = table.column(self.input_col)
         if isinstance(col, np.ndarray) and col.dtype.kind == "U" and len(col):
-            low = np.char.lower(col)
-            # single-token fast path: all-alphanumeric strings contain no
-            # whitespace of ANY kind (str.split semantics incl. \r \v \f
-            # and unicode spaces) and are non-empty — each is its own
-            # token, a vectorized (n, 1) token matrix
-            if np.char.isalnum(low).all():
-                return (table.with_column(self.output_col, low[:, None]),)
+            def shard(lo, hi):
+                low = np.char.lower(col[lo:hi])
+                # single-token fast path: all-alphanumeric strings contain
+                # no whitespace of ANY kind (str.split semantics incl.
+                # \r \v \f and unicode spaces) and are non-empty — each is
+                # its own token, a vectorized (m, 1) token matrix
+                if np.char.isalnum(low).all():
+                    return low[:, None]
+                return _tokenize_distinct(low, str.split)
+
             return (table.with_column(
-                self.output_col, _tokenize_distinct(low, str.split)),)
-        out = np.empty(len(col), dtype=object)
-        for i, text in enumerate(col):
-            out[i] = str(text).lower().split()
-        return (table.with_column(self.output_col, out),)
+                self.output_col,
+                _merge_token_shards(map_row_shards(shard, len(col)))),)
+
+        def shard(lo, hi):
+            out = np.empty(hi - lo, dtype=object)
+            for i in range(lo, hi):
+                out[i - lo] = str(col[i]).lower().split()
+            return out
+
+        return (table.with_column(
+            self.output_col,
+            _merge_token_shards(map_row_shards(shard, len(col)))),)
 
 
 class RegexTokenizer(Transformer, HasInputCol, HasOutputCol):
     """Regex split/match tokenization (ref: feature/regextokenizer/):
     gaps=True → pattern is the delimiter; gaps=False → pattern matches
-    tokens. minTokenLength filters, toLowercase lowercases first."""
+    tokens. minTokenLength filters, toLowercase lowercases first.
+    Row shards fan over the host pool like Tokenizer."""
 
     PATTERN = StringParam("pattern", "Regex pattern used for tokenizing.",
                           "\\s+")
@@ -353,33 +397,50 @@ class RegexTokenizer(Transformer, HasInputCol, HasOutputCol):
         "before tokenizing.", True)
 
     def transform(self, table: Table) -> Tuple[Table]:
+        from flink_ml_tpu.common.hostpool import map_row_shards
+
         pattern = re.compile(self.pattern)
         min_len = self.min_token_length
+        lower = self.to_lowercase
+        gaps = self.gaps
 
         def tokenize(text):
-            if self.to_lowercase:
+            if lower:
                 text = text.lower()
-            tokens = (pattern.split(text) if self.gaps
+            tokens = (pattern.split(text) if gaps
                       else pattern.findall(text))
             return [t for t in tokens if len(t) >= min_len]
 
         col = table.column(self.input_col)
         if isinstance(col, np.ndarray) and col.dtype.kind == "U" and len(col):
-            return (table.with_column(self.output_col,
-                                      _tokenize_distinct(col, tokenize)),)
-        out = np.empty(len(col), dtype=object)
-        for i, text in enumerate(col):
-            out[i] = tokenize(str(text))
-        return (table.with_column(self.output_col, out),)
+            return (table.with_column(
+                self.output_col,
+                _merge_token_shards(map_row_shards(
+                    lambda lo, hi: _tokenize_distinct(col[lo:hi], tokenize),
+                    len(col)))),)
+
+        def shard(lo, hi):
+            out = np.empty(hi - lo, dtype=object)
+            for i in range(lo, hi):
+                out[i - lo] = tokenize(str(col[i]))
+            return out
+
+        return (table.with_column(
+            self.output_col,
+            _merge_token_shards(map_row_shards(shard, len(col)))),)
 
 
 class NGram(Transformer, HasInputCol, HasOutputCol):
-    """Space-joined n-grams over a token array (ref: feature/ngram/)."""
+    """Space-joined n-grams over a token array (ref: feature/ngram/).
+    Row shards fan over the host pool; shard outputs share the uniform
+    gram width, so the merge is one vstack."""
 
     N = IntParam("n", "Number of elements per n-gram (>=1).", 2,
                  ParamValidators.gt_eq(1))
 
     def transform(self, table: Table) -> Tuple[Table]:
+        from flink_ml_tpu.common.hostpool import map_row_shards
+
         n = self.n
         col = table.column(self.input_col)
         if _is_token_matrix(col):
@@ -388,18 +449,31 @@ class NGram(Transformer, HasInputCol, HasOutputCol):
             s = col.shape[1]
             if s < n:
                 grams = np.empty((len(col), 0), dtype=col.dtype)
-            else:
-                grams = col[:, : s - n + 1]
+                return (table.with_column(self.output_col, grams),)
+
+            def shard(lo, hi):
+                sub = col[lo:hi]
+                grams = sub[:, : s - n + 1]
                 for j in range(1, n):
                     grams = np.char.add(np.char.add(grams, " "),
-                                        col[:, j: s - n + 1 + j])
-            return (table.with_column(self.output_col, grams),)
-        out = np.empty(len(col), dtype=object)
-        for i, tokens in enumerate(col):
-            tokens = list(tokens)
-            out[i] = [" ".join(tokens[j:j + n])
-                      for j in range(len(tokens) - n + 1)]
-        return (table.with_column(self.output_col, out),)
+                                        sub[:, j: s - n + 1 + j])
+                return grams
+
+            return (table.with_column(
+                self.output_col,
+                _merge_token_shards(map_row_shards(shard, len(col)))),)
+
+        def shard(lo, hi):
+            out = np.empty(hi - lo, dtype=object)
+            for i in range(lo, hi):
+                tokens = list(col[i])
+                out[i - lo] = [" ".join(tokens[j:j + n])
+                               for j in range(len(tokens) - n + 1)]
+            return out
+
+        return (table.with_column(
+            self.output_col,
+            _merge_token_shards(map_row_shards(shard, len(col)))),)
 
 
 class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
@@ -460,6 +534,8 @@ class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
     _ALLOWED_CACHE: dict = {}
 
     def transform(self, table: Table) -> Tuple[Table]:
+        from flink_ml_tpu.common.hostpool import map_row_shards
+
         if self.case_sensitive:
             stop = set(self.stop_words)
             keep = lambda t: t not in stop
@@ -478,25 +554,47 @@ class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
                 # first char.  One int32 pass over the raw '<U' buffer
                 # finds the candidate tokens; only those pay the
                 # fold-and-compare.  A corpus with no candidates (e.g.
-                # numeric-string tokens) is an O(n) identity.
+                # numeric-string tokens) is an O(n) identity.  The screen
+                # and the per-distinct fold fan over the host pool on row
+                # shards; each worker returns its shard's keep mask (None
+                # = nothing to remove) and the parent assembles the
+                # output representation once, globally.
                 n_r, w_r = col.shape
                 nints = col.dtype.itemsize // 4
-                first = col.view("<i4").reshape(n_r, w_r, nints)[:, :, 0]
                 allowed = self._allowed_first_cps(
                     stop, self.locale, self.case_sensitive)
-                cand = np.isin(first, allowed) | (first > 0xFFFF)
-                cand_flat = cand.reshape(-1)
-                if not cand_flat.any():
+                stop_sorted = np.array(sorted(stop))
+                case_sensitive, locale_ = self.case_sensitive, self.locale
+                fold = self._fold
+
+                def shard(lo, hi):
+                    sub = col[lo:hi]
+                    first = sub.view("<i4").reshape(
+                        hi - lo, w_r, nints)[:, :, 0]
+                    cand = np.isin(first, allowed) | (first > 0xFFFF)
+                    cand_flat = cand.reshape(-1)
+                    if not cand_flat.any():
+                        return hi - lo, None  # all kept: no mask payload
+                    # fold/compare ONLY the candidate tokens, per distinct
+                    cand_tokens = sub.reshape(-1)[cand_flat]
+                    cu, cc = _token_codes(cand_tokens)
+                    cfold = (cu if case_sensitive else np.array(
+                        [fold(str(t), locale_) for t in cu]))
+                    is_stop = np.isin(cfold, stop_sorted)[cc]
+                    if not is_stop.any():
+                        return hi - lo, None
+                    kf = np.ones((hi - lo) * w_r, np.bool_)
+                    kf[cand_flat] = ~is_stop
+                    return hi - lo, kf
+
+                parts = map_row_shards(shard, n_r)
+                if all(kf is None for _, kf in parts):
                     outs[out_name] = col
                     continue
-                # fold/compare ONLY the candidate tokens, per distinct
-                cand_tokens = col.reshape(-1)[cand_flat]
-                cu, cc = _token_codes(cand_tokens)
-                cfold = (cu if self.case_sensitive else np.array(
-                    [self._fold(str(t), self.locale) for t in cu]))
-                is_stop = np.isin(cfold, np.array(sorted(stop)))[cc]
-                keep_flat = np.ones(n_r * w_r, np.bool_)
-                keep_flat[cand_flat] = ~is_stop
+                keep_flat = np.concatenate(
+                    [kf if kf is not None
+                     else np.ones(rows * w_r, np.bool_)
+                     for rows, kf in parts])
                 if keep_flat.all():
                     # nothing filtered: the input token matrix IS the
                     # output (the benchmark corpus of numeric-string
@@ -514,9 +612,14 @@ class StopWordsRemover(Transformer, HasInputCols, HasOutputCols):
                 out[:] = np.split(kept, np.cumsum(counts[:-1]))
                 outs[out_name] = out
                 continue
-            for i, tokens in enumerate(col):
-                out[i] = [t for t in tokens if keep(t)]
-            outs[out_name] = out
+            def obj_shard(lo, hi):
+                part = np.empty(hi - lo, dtype=object)
+                for i in range(lo, hi):
+                    part[i - lo] = [t for t in col[i] if keep(t)]
+                return part
+
+            outs[out_name] = _merge_token_shards(
+                map_row_shards(obj_shard, len(col)))
         return (table.with_columns(**outs),)
 
 
@@ -794,39 +897,63 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
         # aggregation — same bulk shape as HashingTF.transform
         min_tf = self.min_tf
         if _is_token_matrix(col):
-            uniq, codes = _token_codes(col)
-            vocab_ids = np.fromiter((index.get(str(t), -1) for t in uniq),
-                                    np.int64, len(uniq))
+            # both branches fan over the host pool on row shards (workers
+            # are host-numpy only; the device scatter below runs in the
+            # parent): each worker factorizes its shard and maps distinct
+            # tokens through the vocab index ONCE per shard-distinct
+            from flink_ml_tpu.common.hostpool import map_row_shards
+
             w = col.shape[1]
             if (size + 1 < (1 << 16)
                     and n * size * 4 <= _dense_counts_budget()):
                 # small vocab → dense (n, size) f32 counts ON DEVICE
                 # (deviation doc: device tier emits a dense device column
                 # where the reference emits SparseVector)
-                ids1 = (vocab_ids + 1).astype(
-                    narrow_uint(size + 2))[codes].reshape(n, w)
+                dt = narrow_uint(size + 2)
+
+                def dense_shard(lo, hi):
+                    uniq, codes = _token_codes(col[lo:hi])
+                    vocab_ids = np.fromiter(
+                        (index.get(str(t), -1) for t in uniq),
+                        np.int64, len(uniq))
+                    return (vocab_ids + 1).astype(dt)[codes] \
+                        .reshape(hi - lo, w)
+
+                ids1 = np.concatenate(map_row_shards(dense_shard, n))
                 out = _device_token_counts(ids1, size, min_tf,
                                            self.binary, w)
                 return (table.with_column(self.output_col, out),)
-            # count over codes RANKED by vocab id (small domain → the
-            # bincount engine applies) — run values map back to vocab ids
-            # still ascending within each row; OOV (-1) ranks first
-            u = len(uniq)
-            order = np.argsort(vocab_ids, kind="stable")
-            rank_of_code = np.empty(u, np.int64)
-            rank_of_code[order] = np.arange(u)
-            row_of, rank, counts = _rowwise_counts(
-                rank_of_code[codes].reshape(col.shape), domain=u)
-            vocab_id = vocab_ids[order][rank]
-            in_vocab = vocab_id >= 0  # OOV runs sort first in each row
-            row_of, vocab_id, counts = (row_of[in_vocab],
-                                        vocab_id[in_vocab],
-                                        counts[in_vocab])
-            thresholds = (min_tf if min_tf >= 1.0
-                          else min_tf * col.shape[1])
-            keep = counts >= thresholds
-            row_of, vocab_id, counts = (row_of[keep], vocab_id[keep],
-                                        counts[keep])
+
+            def csr_shard(lo, hi):
+                # count over codes RANKED by vocab id (small domain → the
+                # bincount engine applies) — run values map back to vocab
+                # ids still ascending within each row; OOV (-1) ranks
+                # first. Per-shard triples are CSR-canonical and rows are
+                # shard-ordered, so concatenation stays canonical.
+                sub = col[lo:hi]
+                uniq, codes = _token_codes(sub)
+                vocab_ids = np.fromiter(
+                    (index.get(str(t), -1) for t in uniq),
+                    np.int64, len(uniq))
+                u = len(uniq)
+                order = np.argsort(vocab_ids, kind="stable")
+                rank_of_code = np.empty(u, np.int64)
+                rank_of_code[order] = np.arange(u)
+                row_of, rank, counts = _rowwise_counts(
+                    rank_of_code[codes].reshape(sub.shape), domain=u)
+                vocab_id = vocab_ids[order][rank]
+                in_vocab = vocab_id >= 0  # OOV runs sort first per row
+                row_of, vocab_id, counts = (row_of[in_vocab],
+                                            vocab_id[in_vocab],
+                                            counts[in_vocab])
+                thresholds = min_tf if min_tf >= 1.0 else min_tf * w
+                keep = counts >= thresholds
+                return (row_of[keep] + lo, vocab_id[keep], counts[keep])
+
+            parts = map_row_shards(csr_shard, n)
+            row_of = np.concatenate([p[0] for p in parts])
+            vocab_id = np.concatenate([p[1] for p in parts])
+            counts = np.concatenate([p[2] for p in parts])
             values = np.ones(len(vocab_id)) if self.binary \
                 else counts.astype(np.float64)
             out = _build_sparse_rows(n, size, row_of, vocab_id, values)

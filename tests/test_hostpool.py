@@ -128,6 +128,141 @@ def test_hashingtf_pool_parity(monkeypatch):
     assert (serial != pooled).nnz == 0
 
 
+def _tokens_equal(a_col, b_col):
+    """Token-column equality up to cell representation (list vs ndarray
+    row vs matrix row) — the pool merge may change the container, never
+    the tokens."""
+    assert len(a_col) == len(b_col)
+    for a, b in zip(a_col, b_col):
+        assert [str(t) for t in a] == [str(t) for t in b]
+
+
+def test_stringindexer_fit_pool_parity(monkeypatch):
+    """Forced multi-worker fit == inline fit for every ordering (per-shard
+    count maps merge counts and first-occurrence indices exactly)."""
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import StringIndexer
+
+    rng = np.random.default_rng(11)
+    vals = np.array([f"v{v}" for v in rng.integers(0, 23, 4000)])
+    nums = rng.integers(0, 9, 4000).astype(np.float64)
+    t = Table.from_columns(s=vals, x=nums)
+    for order in ("arbitrary", "frequencyDesc", "frequencyAsc",
+                  "alphabetDesc", "alphabetAsc"):
+        si = StringIndexer(input_cols=["s", "x"], output_cols=["si", "xi"],
+                           string_order_type=order)
+        monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "1")
+        serial = si.fit(t).string_arrays
+        _forced_pool(monkeypatch)
+        pooled = si.fit(t).string_arrays
+        assert serial == pooled, order
+
+
+def test_countvectorizer_model_transform_pool_parity(monkeypatch):
+    """Forced multi-worker transform == inline transform on the host CSR
+    path (per-shard triples concatenate CSR-canonically)."""
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import CountVectorizer
+
+    rng = np.random.default_rng(5)
+    toks = np.array([f"w{v}" for v in range(41)])
+    col = toks[rng.integers(0, 41, (3000, 7))]
+    t = Table.from_columns(docs=col)
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "1")
+    model = CountVectorizer(input_col="docs", output_col="v",
+                            min_tf=2.0).fit(t)
+    # force the CSR path regardless of vocab size
+    monkeypatch.setenv("FLINK_ML_TPU_DENSE_COUNTS_MAX_BYTES", "1")
+    serial = model.transform(t)[0].column("v").matrix
+    _forced_pool(monkeypatch)
+    pooled = model.transform(t)[0].column("v").matrix
+    assert (serial != pooled).nnz == 0
+
+
+def test_countvectorizer_model_dense_pool_parity(monkeypatch):
+    """The dense device branch's host side (vocab-id mapping) pools too:
+    forced multi-worker output == inline output."""
+    import numpy.testing as npt
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import CountVectorizer
+
+    rng = np.random.default_rng(6)
+    toks = np.array([f"w{v}" for v in range(17)])
+    col = toks[rng.integers(0, 17, (2000, 5))]
+    t = Table.from_columns(docs=col)
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "1")
+    model = CountVectorizer(input_col="docs", output_col="v").fit(t)
+    serial = np.asarray(model.transform(t)[0].vectors("v"))
+    _forced_pool(monkeypatch)
+    pooled = np.asarray(model.transform(t)[0].vectors("v"))
+    npt.assert_array_equal(serial, pooled)
+
+
+def test_tokenizer_pool_parity(monkeypatch):
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import Tokenizer
+
+    rng = np.random.default_rng(2)
+    texts = np.array([f"Alpha beta w{v} gamma" if v % 3 else f"solo{v}"
+                      for v in rng.integers(0, 50, 3000)])
+    t = Table.from_columns(text=texts)
+    tok = Tokenizer(input_col="text", output_col="tok")
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "1")
+    serial = tok.transform(t)[0].column("tok")
+    _forced_pool(monkeypatch)
+    pooled = tok.transform(t)[0].column("tok")
+    _tokens_equal(serial, pooled)
+
+
+def test_regextokenizer_pool_parity(monkeypatch):
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import RegexTokenizer
+
+    rng = np.random.default_rng(3)
+    texts = np.array([f"a{v},b{v % 5},ccc" for v in
+                      rng.integers(0, 60, 3000)])
+    t = Table.from_columns(text=texts)
+    tok = RegexTokenizer(input_col="text", output_col="tok", pattern=",",
+                         min_token_length=2)
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "1")
+    serial = tok.transform(t)[0].column("tok")
+    _forced_pool(monkeypatch)
+    pooled = tok.transform(t)[0].column("tok")
+    _tokens_equal(serial, pooled)
+
+
+def test_stopwordsremover_pool_parity(monkeypatch):
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import StopWordsRemover
+
+    rng = np.random.default_rng(4)
+    words = np.array(["the", "quick", "a", "fox", "is", "fast", "not"])
+    col = words[rng.integers(0, len(words), (3000, 6))]
+    t = Table.from_columns(tok=col)
+    sw = StopWordsRemover(input_cols=["tok"], output_cols=["clean"])
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "1")
+    serial = sw.transform(t)[0].column("clean")
+    _forced_pool(monkeypatch)
+    pooled = sw.transform(t)[0].column("clean")
+    _tokens_equal(serial, pooled)
+
+
+def test_ngram_pool_parity(monkeypatch):
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.feature import NGram
+
+    rng = np.random.default_rng(8)
+    toks = np.array([f"t{v}" for v in range(12)])
+    t = Table.from_columns(tok=toks[rng.integers(0, 12, (3000, 5))])
+    ng = NGram(input_col="tok", output_col="grams", n=2)
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "1")
+    serial = ng.transform(t)[0].column("grams")
+    _forced_pool(monkeypatch)
+    pooled = ng.transform(t)[0].column("grams")
+    _tokens_equal(serial, pooled)
+
+
 def test_sliding_window_refill_many_shards():
     """shard_cap forcing many more shards than workers: the window must
     refill as children finish, preserve shard order, and lose nothing."""
