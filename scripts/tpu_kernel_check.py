@@ -102,6 +102,109 @@ def main() -> int:
                   x, yl, wl, coeffs, start, clip, lb, tile, ln),
               want, rtol=5e-2, atol=0.5)
 
+    # -- benchmark-scale phase (VERDICT r4 next-#2 / weak-#5): kernel
+    # path vs the XLA path at north-star shapes, both ON CHIP.  The
+    # small-shape phase above proves the lowering against numpy; this
+    # phase bounds kernel-vs-XLA drift at the scales the sweep actually
+    # claims (SGD 100k-row batch window at d=100, Lloyd partials at
+    # 1M x 100 k=10, KNN over a multi-tile 200k train set).  Skipped via
+    # FLINK_ML_TPU_KERNEL_CHECK_SMALL_ONLY=1 if a window is short.
+    if not os.environ.get("FLINK_ML_TPU_KERNEL_CHECK_SMALL_ONLY"):
+        import jax.numpy as jnp
+
+        # Lloyd partials, north-star KMeans shape (1M x 100, k=10)
+        nL, dL, kL = 1 << 20, 100, 10
+        cw2 = rng.normal(size=(kL, dL)).astype(np.float32) * 10
+        xw2 = (cw2[rng.integers(0, kL, nL)]
+               + rng.normal(size=(nL, dL)).astype(np.float32) * 0.1) \
+            .astype(np.float32)
+        v2 = np.ones(nL, np.float32)
+
+        @jax.jit
+        def lloyd_xla(x, v, c):
+            # matmul distance form (what measure.pairwise lowers to) — the
+            # (n, k, d) broadcast form would materialize 4 GB here
+            d2 = (jnp.sum(x * x, axis=1, keepdims=True)
+                  - 2.0 * (x @ c.T) + jnp.sum(c * c, axis=1)[None, :])
+            one_hot = jax.nn.one_hot(jnp.argmin(d2, axis=1), c.shape[0],
+                                     dtype=x.dtype) * v[:, None]
+            return jnp.concatenate(
+                [one_hot.T @ x, jnp.sum(one_hot, axis=0)[:, None]], axis=1)
+
+        xd, vd, cd = (jnp.asarray(xw2), jnp.asarray(v2), jnp.asarray(cw2))
+        want = np.asarray(lloyd_xla(xd, vd, cd))
+        lloyd_got = {}
+
+        def lloyd_run():
+            lloyd_got["v"] = np.asarray(pk.lloyd_partial_sums(xd, vd, cd))
+            return lloyd_got["v"][:, :-1]
+
+        # relative tolerance on the accumulated sums; the counts column
+        # is checked SEPARATELY with atol 0 — on well-separated clusters
+        # any count drift means dropped/double-counted rows (the
+        # wrong-tiles/accumulation bug class this phase hunts), so it
+        # must not hide under a sums-scaled tolerance
+        check("lloyd_partial_sums@1Mx100(sums)", lloyd_run, want[:, :-1],
+              rtol=1e-3, atol=np.abs(want[:, :-1]).max() * 1e-4)
+        if "v" in lloyd_got:
+            check("lloyd_partial_sums@1Mx100(counts)",
+                  lambda: lloyd_got["v"][:, -1], want[:, -1],
+                  rtol=0, atol=0)
+
+        # SGD batch terms, north-star LR shape (window 100k of 1M, d=100)
+        nS, dS, lbS = 1 << 20, 100, 100_000
+        xs = rng.normal(size=(nS, dS)).astype(np.float32)
+        ys = (rng.random(nS) > 0.5).astype(np.float32)
+        ws = np.ones(nS, np.float32)
+        cfs = (rng.normal(size=dS) * 0.1).astype(np.float32)
+        tile = pk.sgd_round_tile(lbS, nS, dS)
+        if tile:
+            loss = LossFunc.by_name("logistic")
+            xd2, yd2, wd2 = (jnp.asarray(xs), jnp.asarray(ys),
+                             jnp.asarray(ws))
+
+            @jax.jit
+            def sgd_xla(x, y, w, c):
+                ls, grad = loss.loss_and_gradient(
+                    c, jax.lax.dynamic_slice_in_dim(x, lbS, lbS),
+                    jax.lax.dynamic_slice_in_dim(y, lbS, lbS),
+                    jax.lax.dynamic_slice_in_dim(w, lbS, lbS))
+                return jnp.concatenate(
+                    [grad, jnp.stack([jnp.sum(
+                        jax.lax.dynamic_slice_in_dim(w, lbS, lbS)), ls])])
+
+            want = np.asarray(sgd_xla(xd2, yd2, wd2, jnp.asarray(cfs)))
+            check("sgd_batch_terms@100kx100",
+                  lambda: pk.sgd_batch_terms(xd2, yd2, wd2, cfs, lbS, 0,
+                                             lbS, tile, "logistic"),
+                  want, rtol=1e-3, atol=np.abs(want).max() * 1e-4)
+        else:
+            errors.append("sgd_batch_terms@100kx100: no admissible tile")
+
+        # KNN streamed top-k over a multi-tile train set vs lax.top_k
+        nK, dK, ntK, kK = 4096, 100, 200_000, 5
+        xk = rng.normal(size=(nK, dK)).astype(np.float32)
+        tk = rng.normal(size=(ntK, dK)).astype(np.float32)
+        xkd, tkd = jnp.asarray(xk), jnp.asarray(tk)
+
+        @jax.jit
+        def knn_xla(x, t):
+            d2 = (jnp.sum(x * x, axis=1, keepdims=True)
+                  - 2.0 * (x @ t.T) + jnp.sum(t * t, axis=1)[None, :])
+            return jax.lax.top_k(-d2, kK)[1]
+
+        idx_want = np.asarray(knn_xla(xkd, tkd))
+        # index-tolerant at scale: compare the exact distances at the
+        # chosen indices (float ties may legally pick different rows)
+        dk_want = ((xk[:, None, :] - tk[idx_want][:, :, :]) ** 2).sum(-1)
+
+        def knn_scale_dists():
+            idx = np.asarray(pk.knn_topk_indices(xkd, tkd, kK))
+            return ((xk[:, None, :] - tk[idx][:, :, :]) ** 2).sum(-1)
+
+        check("knn_topk_indices@4kx200k", knn_scale_dists, dk_want,
+              rtol=1e-3, atol=1e-2)
+
     for f in failures:
         print("PARITY FAILURE:", f, file=sys.stderr)
     for e in errors:
