@@ -80,6 +80,20 @@ _HISTORY_DEV_CAP = 128
 import functools
 
 
+def _ftrl_apply(xp, g, coeffs, z, n, alpha, beta, l1, l2):
+    """The FTRL-proximal elementwise update (UpdateModel:295-319), shared
+    by the dense device program, the sparse device program and the host
+    CSR engine — ``xp`` is jnp or np; one copy of the math keeps the
+    three paths in lockstep by construction."""
+    sigma = (xp.sqrt(n + g * g) - xp.sqrt(n)) / alpha
+    z = z + g - sigma * coeffs
+    n = n + g * g
+    coeffs = xp.where(
+        xp.abs(z) <= l1, 0.0,
+        (xp.sign(z) * l1 - z) / ((beta + xp.sqrt(n)) / alpha + l2))
+    return coeffs, z, n
+
+
 @functools.lru_cache(maxsize=32)
 def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float):
     """ONE FTRL global-batch update as a compiled SPMD program: batch
@@ -105,18 +119,118 @@ def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float):
         # dense-path reference semantics: weight sum = batch row count at
         # every coordinate
         g = grad / jnp.maximum(n_valid.astype(grad.dtype), 1.0)
-        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / alpha
-        z = z + g - sigma * coeffs
-        n = n + g * g
-        coeffs = jnp.where(
-            jnp.abs(z) <= l1, 0.0,
-            (jnp.sign(z) * l1 - z) / ((beta + jnp.sqrt(n)) / alpha + l2))
-        return coeffs, z, n
+        return _ftrl_apply(jnp, g, coeffs, z, n, alpha, beta, l1, l2)
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, None), P(spec0), P(), P(), P(), P()),
         out_specs=(P(), P(), P()), check_vma=False))
+
+
+@functools.lru_cache(maxsize=32)
+def _ftrl_sparse_program(mesh, alpha: float, beta: float, l1: float,
+                         l2: float):
+    """ONE sparse-batch FTRL update as a compiled SPMD program — the
+    device twin of the host CSR branch (ref CalculateLocalGradient:
+    364-388: gradient and weight sums accumulate ONLY at a sample's
+    non-zero coordinates, unlike the dense program's batch-count
+    denominator).
+
+    The CSR batch arrives as per-shard padded quads (values, column ids,
+    local row ids, validity) sharded over the mesh's data axes plus
+    per-shard (y, w) row blocks; the forward matvec and the
+    per-coordinate sums are segment-sums over the shard's nnz, psum'd
+    across shards; the FTRL elementwise update runs replicated. Padded
+    nnz slots carry validity 0 so they contribute nothing; padded rows
+    own no nnz so their p never enters a sum."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from flink_ml_tpu.parallel.mesh import data_axes, data_pspec
+
+    axes = data_axes(mesh)
+    spec0 = data_pspec(mesh)
+
+    def per_shard(vals, col, row, valid, yb, wb, coeffs, z, n):
+        vals, col, row, valid = vals[0], col[0], row[0], valid[0]
+        yb, wb = yb[0], wb[0]
+        rows_s = yb.shape[0]
+        d = coeffs.shape[0]
+        dots = jax.ops.segment_sum(vals * coeffs[col] * valid, row,
+                                   num_segments=rows_s)
+        p = 1.0 / (1.0 + jnp.exp(-dots))
+        grad = jax.lax.psum(jax.ops.segment_sum(
+            vals * (p - yb)[row] * valid, col, num_segments=d), axes)
+        wsum = jax.lax.psum(jax.ops.segment_sum(
+            wb[row] * valid, col, num_segments=d), axes)
+        g = jnp.where(wsum != 0, grad / jnp.where(wsum != 0, wsum, 1.0),
+                      0.0)
+        return _ftrl_apply(jnp, g, coeffs, z, n, alpha, beta, l1, l2)
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(spec0, None),) * 6 + (P(), P(), P()),
+        out_specs=(P(), P(), P()), check_vma=False))
+
+
+def _pack_csr_shards(x, y, w, n_shards: int):
+    """Split a scipy CSR batch into ``n_shards`` row ranges and pack each
+    as padded (values, col, local row, valid) rows of one (S, nnz_s)
+    quad plus (S, rows_s) y/w blocks — the host marshalling for
+    :func:`_ftrl_sparse_program`. nnz_s / rows_s round up to powers of
+    two so jit recompiles per size bucket, not per batch."""
+    n_rows = x.shape[0]
+    base, rem = divmod(n_rows, n_shards)
+    bounds, lo = [], 0
+    for i in range(n_shards):
+        hi = lo + base + (1 if i < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    max_nnz = max((x.indptr[hi] - x.indptr[lo] for lo, hi in bounds),
+                  default=0)
+    max_rows = max((hi - lo for lo, hi in bounds), default=0)
+    nnz_s = 1 << max(3, int(max_nnz - 1).bit_length())
+    rows_s = 1 << max(3, int(max_rows - 1).bit_length())
+    vals = np.zeros((n_shards, nnz_s), np.float32)
+    col = np.zeros((n_shards, nnz_s), np.int32)
+    row = np.zeros((n_shards, nnz_s), np.int32)
+    valid = np.zeros((n_shards, nnz_s), np.float32)
+    yb = np.zeros((n_shards, rows_s), np.float32)
+    wb = np.zeros((n_shards, rows_s), np.float32)
+    for s, (lo, hi) in enumerate(bounds):
+        a, b = x.indptr[lo], x.indptr[hi]
+        nz = b - a
+        vals[s, :nz] = x.data[a:b]
+        col[s, :nz] = x.indices[a:b]
+        row[s, :nz] = np.repeat(np.arange(hi - lo, dtype=np.int32),
+                                np.diff(x.indptr[lo:hi + 1]))
+        valid[s, :nz] = 1.0
+        yb[s, : hi - lo] = y[lo:hi]
+        wb[s, : hi - lo] = w[lo:hi]
+    return vals, col, row, valid, yb, wb
+
+
+#: sparse batches with at least this many stored values update on device
+#: (below it, per-batch dispatch overhead beats the segment-sum win and
+#:  the float64 host math preserves the fine-grained reference semantics
+#:  the unit tests pin); override with FLINK_ML_TPU_FTRL_SPARSE_MIN_NNZ
+_FTRL_SPARSE_MIN_NNZ = 4096
+
+
+def _ftrl_sparse_min_nnz() -> int:
+    import os
+
+    env = os.environ.get("FLINK_ML_TPU_FTRL_SPARSE_MIN_NNZ")
+    try:
+        return int(env) if env else _FTRL_SPARSE_MIN_NNZ
+    except ValueError:
+        return _FTRL_SPARSE_MIN_NNZ
+
+
+# set on the first device-sparse failure so later batches skip straight to
+# the host engine instead of re-tracing the same exception
+_ftrl_sparse_broken = False
 
 
 # ---------------------------------------------------------------------------
@@ -355,12 +469,39 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
         from flink_ml_tpu.parallel.collective import ensure_on_mesh
         from flink_ml_tpu.parallel.mesh import data_axes, default_mesh
 
-        # the mesh initializes the device backend — only on the first DENSE
-        # batch, so an all-sparse stream trains with no device at all
+        # the mesh initializes the device backend — only on the first
+        # device-eligible batch (dense, or sparse above the nnz gate), so
+        # a small-sparse stream trains with no device at all
         mesh = axes = None
-        n_dense = n_sparse = 0  # benchmark provenance (executionPath)
+        n_dense = n_sparse = n_sparse_dev = 0  # provenance (executionPath)
         self.last_execution_path = None  # a zero-batch refit must not
         # inherit the previous fit's label
+
+        def device_state():
+            """(coeffs, z, n) as the float32 device triple WITHOUT
+            committing it to state_dev — callers assign state_dev only
+            after their device step succeeds, so a failed attempt leaves
+            the float64 host state untruncated for the host engine."""
+            import jax.numpy as jnp
+
+            if state_dev is not None:
+                return state_dev
+            return (jnp.asarray(coeffs, jnp.float32),
+                    jnp.asarray(z, jnp.float32),
+                    jnp.asarray(n, jnp.float32))
+
+        def commit_device_state(new_state):
+            """Shared device-batch bookkeeping (dense + sparse paths):
+            adopt the new state, version it, snapshot coefficients into
+            the history (drained in stacked D2H past the cap), checkpoint."""
+            nonlocal state_dev, version
+            state_dev = new_state
+            version += 1
+            dev_pending.append(len(history))
+            history.append((version, state_dev[0]))
+            if len(dev_pending) >= _HISTORY_DEV_CAP:
+                materialize_history()
+            ckpt.after_batch(pack)
 
         for batch in _as_stream(data, self.global_batch_size):
             # float32 request: a device-resident dense column passes
@@ -382,30 +523,77 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                 if isinstance(ycol, np.ndarray):
                     ycol = batch.scalars(self.label_col)
                 yb, _ = ensure_on_mesh(mesh, ycol, axes, jnp.float32)
-                if state_dev is None:
-                    state_dev = (jnp.asarray(coeffs, jnp.float32),
-                                 jnp.asarray(z, jnp.float32),
-                                 jnp.asarray(n, jnp.float32))
-                state_dev = program(xb, yb, jnp.float32(n_rows), *state_dev)
+                commit_device_state(
+                    program(xb, yb, jnp.float32(n_rows), *device_state()))
                 n_dense += 1
-                version += 1
-                dev_pending.append(len(history))
-                history.append((version, state_dev[0]))
-                if len(dev_pending) >= _HISTORY_DEV_CAP:
-                    materialize_history()
-                ckpt.after_batch(pack)
                 continue
-            to_host()  # sparse math is host numpy against float64 state
             y = batch.scalars(self.label_col, np.float64)
+            w_col = (batch.scalars(self.weight_col, np.float64)
+                     if self.weight_col is not None
+                     and self.weight_col in batch
+                     else np.ones(x.shape[0], np.float64))
+            global _ftrl_sparse_broken
+            if x.nnz >= _ftrl_sparse_min_nnz() and not _ftrl_sparse_broken:
+                # large sparse batches update ON DEVICE: segment-sums
+                # over the sharded nnz (the device twin of the host CSR
+                # branch below); state stays device-resident like the
+                # dense path
+                try:
+                    import jax
+                    from jax.sharding import (NamedSharding,
+                                              PartitionSpec as P)
+
+                    from flink_ml_tpu.parallel.mesh import (
+                        data_pspec,
+                        data_shard_count,
+                    )
+
+                    if mesh is None:
+                        mesh = default_mesh()
+                        axes = data_axes(mesh)
+                    program = _ftrl_sparse_program(mesh, alpha, beta,
+                                                   l1, l2)
+                    packed = _pack_csr_shards(x, y, w_col,
+                                              data_shard_count(mesh))
+                    sh = NamedSharding(mesh, P(data_pspec(mesh), None))
+                    packed_dev = tuple(jax.device_put(a, sh)
+                                       for a in packed)
+                    new_state = program(*packed_dev, *device_state())
+                    if n_sparse_dev == 0:
+                        # first sparse-device batch runs SYNCHRONOUSLY:
+                        # dispatch is async, so without this an execution
+                        # failure (e.g. OOM) would surface much later at
+                        # a blocking fetch outside this try and crash the
+                        # fit instead of degrading. Later batches reuse
+                        # the proven program shape and stay async.
+                        jax.block_until_ready(new_state)
+                    commit_device_state(new_state)
+                    n_sparse_dev += 1
+                    continue
+                except Exception:
+                    # a synchronous device-sparse failure (backend down,
+                    # lowering, first-batch execution error) degrades to
+                    # the host engine for the rest of the process,
+                    # loudly; the float64 host state is untouched (the
+                    # device triple is committed only on success).
+                    # A failure surfacing asynchronously on a LATER
+                    # batch still propagates — by then earlier device
+                    # results are already woven into the state and
+                    # silently re-training them host-side would be
+                    # wrong.
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "device sparse FTRL failed; using the host CSR "
+                        "engine for the rest of this process",
+                        exc_info=True)
+                    _ftrl_sparse_broken = True
+            to_host()  # sparse math is host numpy against float64 state
             # sparse branch (ref CalculateLocalGradient:364-388): the
             # gradient and the weight sum accumulate ONLY at a sample's
             # non-zero coordinates; weightSum adds the sample weight
             # there (dense adds 1.0 everywhere). Never densifies: CSR
             # matvec + bincount scatter at 2^18 dims stays O(nnz).
-            w_col = (batch.scalars(self.weight_col, np.float64)
-                     if self.weight_col is not None
-                     and self.weight_col in batch
-                     else np.ones(x.shape[0], np.float64))
             p = 1.0 / (1.0 + np.exp(-(x @ coeffs)))
             row_nnz = np.diff(x.indptr)
             d = x.shape[1]
@@ -418,12 +606,8 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                 minlength=d)
             g = np.where(weight_sum != 0, grad / np.where(weight_sum != 0,
                                                           weight_sum, 1), 0)
-            sigma = (np.sqrt(n + g * g) - np.sqrt(n)) / alpha
-            z += g - sigma * coeffs
-            n += g * g
-            coeffs = np.where(
-                np.abs(z) <= l1, 0.0,
-                (np.sign(z) * l1 - z) / ((beta + np.sqrt(n)) / alpha + l2))
+            coeffs, z, n = _ftrl_apply(np, g, coeffs, z, n, alpha, beta,
+                                       l1, l2)
             version += 1
             n_sparse += 1
             history.append((version, coeffs.copy()))
@@ -434,12 +618,14 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
         materialize_history()
         # benchmark provenance (runner.py executionPath): where the FTRL
         # batch updates actually ran
-        if n_dense and n_sparse:
-            self.last_execution_path = (f"mixed(device={n_dense},"
-                                        f"host-csr={n_sparse})")
-        elif n_dense or n_sparse:
-            self.last_execution_path = ("device-batches" if n_dense
-                                        else "host-csr-batches")
+        parts = (("device", n_dense), ("device-csr", n_sparse_dev),
+                 ("host-csr", n_sparse))
+        active = [(k, v) for k, v in parts if v]
+        if len(active) > 1:
+            self.last_execution_path = "mixed(" + ",".join(
+                f"{k}={v}" for k, v in active) + ")"
+        elif active:
+            self.last_execution_path = f"{active[0][0]}-batches"
         model.coefficients = coeffs
         model.model_version = version
         model.history = history

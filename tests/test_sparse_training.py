@@ -302,3 +302,69 @@ def test_csr_vector_column_indexing_and_concat():
     assert both.column("v")[3].to_array().tolist() == [9.0, 0.0]
     assert rev.column("v")[0].to_array().tolist() == [9.0, 0.0]
     assert rev.column("v")[2].to_array().tolist() == [0.0, 1.0]
+
+
+def test_ftrl_sparse_device_path_matches_host(rng, monkeypatch):
+    """Large sparse batches (>= the nnz gate) update on DEVICE via the
+    segment-sum SPMD program; the result must match the float64 host CSR
+    engine within float32 tolerance, with executionPath provenance."""
+    from flink_ml_tpu.models.online import OnlineLogisticRegression
+    import flink_ml_tpu.models.online as online_mod
+
+    n, d = 600, 40
+    x = rng.normal(size=(n, d))
+    x[rng.random((n, d)) < 0.5] = 0.0
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    col = _sparse_column_from_dense(x, keep_all=False)
+    init = Table.from_columns(coefficient=[DenseVector(np.zeros(d))])
+
+    def fit():
+        est = OnlineLogisticRegression(features_col="f", label_col="l",
+                                       global_batch_size=200)
+        est.set_initial_model_data(init)
+        m = est.fit(Table.from_columns(f=col, l=y))
+        return est.last_execution_path, m
+
+    monkeypatch.setattr(online_mod, "_ftrl_sparse_broken", False)
+    monkeypatch.setenv("FLINK_ML_TPU_FTRL_SPARSE_MIN_NNZ", "1")
+    path_dev, m_dev = fit()
+    assert path_dev == "device-csr-batches"
+    monkeypatch.setenv("FLINK_ML_TPU_FTRL_SPARSE_MIN_NNZ", str(1 << 60))
+    path_host, m_host = fit()
+    assert path_host == "host-csr-batches"
+    np.testing.assert_allclose(m_dev.coefficients, m_host.coefficients,
+                               rtol=1e-3, atol=1e-5)
+    assert m_dev.model_version == m_host.model_version
+    # versioned history snapshots materialize from device identically
+    np.testing.assert_allclose(m_dev.history[-1][1],
+                               m_host.history[-1][1], rtol=1e-3,
+                               atol=1e-5)
+
+
+def test_ftrl_sparse_device_weighted_rows(rng, monkeypatch):
+    """weightCol flows into the device path's per-coordinate weight sums."""
+    from flink_ml_tpu.models.online import OnlineLogisticRegression
+    import flink_ml_tpu.models.online as online_mod
+
+    n, d = 300, 12
+    x = rng.normal(size=(n, d))
+    x[rng.random((n, d)) < 0.6] = 0.0
+    y = (rng.random(n) > 0.5).astype(np.float64)
+    w = rng.random(n) + 0.5
+    col = _sparse_column_from_dense(x, keep_all=False)
+    init = Table.from_columns(coefficient=[DenseVector(np.zeros(d))])
+
+    def fit():
+        est = OnlineLogisticRegression(features_col="f", label_col="l",
+                                       weight_col="w",
+                                       global_batch_size=150)
+        est.set_initial_model_data(init)
+        return est.fit(Table.from_columns(f=col, l=y, w=w))
+
+    monkeypatch.setattr(online_mod, "_ftrl_sparse_broken", False)
+    monkeypatch.setenv("FLINK_ML_TPU_FTRL_SPARSE_MIN_NNZ", "1")
+    m_dev = fit()
+    monkeypatch.setenv("FLINK_ML_TPU_FTRL_SPARSE_MIN_NNZ", str(1 << 60))
+    m_host = fit()
+    np.testing.assert_allclose(m_dev.coefficients, m_host.coefficients,
+                               rtol=1e-3, atol=1e-5)
