@@ -112,8 +112,14 @@ def main() -> int:
     if not os.environ.get("FLINK_ML_TPU_KERNEL_CHECK_SMALL_ONLY"):
         import jax.numpy as jnp
 
+        # scale shrink factor (power of two) — lets CI exercise this whole
+        # phase in interpreter mode on tiny shapes, so a chip window is
+        # never burned by a plain bug here
+        shrink = int(os.environ.get(
+            "FLINK_ML_TPU_KERNEL_CHECK_SHRINK", "1"))
+
         # Lloyd partials, north-star KMeans shape (1M x 100, k=10)
-        nL, dL, kL = 1 << 20, 100, 10
+        nL, dL, kL = (1 << 20) // shrink, 100, 10
         cw2 = rng.normal(size=(kL, dL)).astype(np.float32) * 10
         xw2 = (cw2[rng.integers(0, kL, nL)]
                + rng.normal(size=(nL, dL)).astype(np.float32) * 0.1) \
@@ -151,8 +157,10 @@ def main() -> int:
                   lambda: lloyd_got["v"][:, -1], want[:, -1],
                   rtol=0, atol=0)
 
-        # SGD batch terms, north-star LR shape (window 100k of 1M, d=100)
-        nS, dS, lbS = 1 << 20, 100, 100_000
+        # SGD batch terms, north-star LR shape (window 100k of 1M, d=100);
+        # the shrunk window stays a multiple of 8 so a valid tile exists
+        nS, dS = (1 << 20) // shrink, 100
+        lbS = max(64, (100_000 // shrink) & ~7)
         xs = rng.normal(size=(nS, dS)).astype(np.float32)
         ys = (rng.random(nS) > 0.5).astype(np.float32)
         ws = np.ones(nS, np.float32)
@@ -182,7 +190,8 @@ def main() -> int:
             errors.append("sgd_batch_terms@100kx100: no admissible tile")
 
         # KNN streamed top-k over a multi-tile train set vs lax.top_k
-        nK, dK, ntK, kK = 4096, 100, 200_000, 5
+        nK, dK, ntK, kK = (max(256, 4096 // shrink), 100,
+                           max(pk.KNN_TILE_T + 257, 200_000 // shrink), 5)
         xk = rng.normal(size=(nK, dK)).astype(np.float32)
         tk = rng.normal(size=(ntK, dK)).astype(np.float32)
         xkd, tkd = jnp.asarray(xk), jnp.asarray(tk)
