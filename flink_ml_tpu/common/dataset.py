@@ -28,7 +28,9 @@ from flink_ml_tpu.iteration.streaming import StreamTable
 def partition(table: Table, num_partitions: int) -> List[Table]:
     """Split a table into contiguous shards (subtask-partition analog)."""
     bounds = np.linspace(0, table.num_rows, num_partitions + 1).astype(int)
-    return [table.take(np.arange(bounds[i], bounds[i + 1]))
+    # slices, not index arrays: contiguous unit-step takes hit Table.take's
+    # compiled device fast path instead of the eager sharded-array gather
+    return [table.take(slice(int(bounds[i]), int(bounds[i + 1])))
             for i in range(num_partitions)]
 
 

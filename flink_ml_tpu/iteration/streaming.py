@@ -41,8 +41,8 @@ class StreamTable:
         equivalent of the examples' PeriodicSourceFunction)."""
         def gen():
             for start in range(0, table.num_rows, chunk_size):
-                yield table.take(np.arange(start, min(start + chunk_size,
-                                                      table.num_rows)))
+                yield table.take(slice(start, min(start + chunk_size,
+                                                  table.num_rows)))
         return StreamTable(gen())
 
 
@@ -64,14 +64,14 @@ def generate_batches(stream: StreamTable, global_batch_size: int,
             # of a different vector representation would fail)
             buffer, cursor = chunk, 0
         else:
-            remaining = buffer.take(np.arange(cursor, buffer.num_rows)) \
+            remaining = buffer.take(slice(cursor, buffer.num_rows)) \
                 if cursor else buffer
             buffer, cursor = remaining.concat(chunk), 0
         while buffer.num_rows - cursor >= global_batch_size:
-            yield buffer.take(np.arange(cursor, cursor + global_batch_size))
+            yield buffer.take(slice(cursor, cursor + global_batch_size))
             cursor += global_batch_size
     if buffer is not None and buffer.num_rows - cursor > 0 and not drop_remainder:
-        yield buffer.take(np.arange(cursor, buffer.num_rows))
+        yield buffer.take(slice(cursor, buffer.num_rows))
 
 
 def window_stream(stream: StreamTable, windows,

@@ -156,6 +156,34 @@ def head_rows(x, n: int):
     return _jitted(_head_rows_kernel, 1, 2)(x, int(min(n, x.shape[0])))
 
 
+def _dynamic_rows_kernel(x, start, size):
+    return jax.lax.dynamic_slice_in_dim(x, start, size)
+
+
+def dynamic_rows(x, start: int, size: int):
+    """Rows ``[start, start+size)`` of a device array (Table.take's
+    device fast path).
+
+    Single-device arrays (the real-chip benchmark case) slice through
+    one compiled dynamic-slice per (shape, dtype, size): the start rides
+    as a traced scalar, so a batch loop walking the column reuses a
+    single program for every offset — no per-offset compile through the
+    TPU tunnel. ``dynamic_slice`` clamps starts, so callers keep
+    start+size <= n.
+
+    Mesh-SHARDED arrays keep the eager gather: every sliced-program
+    variant tried (traced-start dynamic slice, static slice) reshards
+    through a runtime collective whose 8-thread rendezvous STARVES on
+    this single-core host at benchmark scale (hard 40 s timeout crash,
+    rendezvous.cc) — the gather is slower per call but collective-free
+    at dispatch and was the long-standing streaming behavior on the
+    CPU mesh."""
+    if len(getattr(x.sharding, "device_set", ())) <= 1:
+        return _jitted(_dynamic_rows_kernel, 1, 3)(
+            x, jnp.asarray(start, jnp.int32), int(size))
+    return x[np.arange(start, start + size)]
+
+
 def _take_dims_kernel(x, dims):
     return x[:, np.asarray(dims)]
 

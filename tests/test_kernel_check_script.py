@@ -39,7 +39,11 @@ def test_kernel_check_main_passes_in_interpret_mode(monkeypatch):
             pk, name,
             lambda *a, _orig=orig, **kw: _orig(*a, **{**kw,
                                                       "interpret": True}))
-    # shrink the scale phase ~64x so interpreter mode finishes in seconds
+    # shrink the scale phase ~64x so interpreter mode finishes in seconds;
+    # clear the skip knob so the scale phase really runs even when the
+    # shell exported the short-window workflow's env
+    monkeypatch.delenv("FLINK_ML_TPU_KERNEL_CHECK_SMALL_ONLY",
+                       raising=False)
     monkeypatch.setenv("FLINK_ML_TPU_KERNEL_CHECK_SHRINK", "64")
     assert mod.main() == 0
 
