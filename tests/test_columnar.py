@@ -178,3 +178,39 @@ def test_head_rows_and_take_dims_on_sharded_array():
     np.testing.assert_array_equal(np.asarray(columnar.head_rows(xd, 99)), x)
     np.testing.assert_array_equal(
         np.asarray(columnar.take_dims(xd, [0, 3])), x[:, [0, 3]])
+
+
+def test_table_take_slice_matches_arange_paths():
+    """take(slice) must equal take(arange) on host, object, CSR and
+    device columns (the slice fast path added for streaming batch loops),
+    and head() clamps negative n to empty as before."""
+    import scipy.sparse as sp
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.linalg.sparse import CsrVectorColumn
+
+    x = np.arange(40, dtype=np.float64).reshape(10, 4)
+    obj = np.empty(10, dtype=object)
+    for i in range(10):
+        obj[i] = [f"t{i}"]
+    t = Table.from_columns(
+        dense=x, scalars=x[:, 0].copy(), tokens=obj,
+        sparse=CsrVectorColumn(sp.csr_matrix(x)),
+        dev=columnar.to_device(np.asarray(x, np.float32)))
+    a = t.take(slice(3, 8))
+    b = t.take(np.arange(3, 8))
+    assert a.num_rows == b.num_rows == 5
+    for name in ("dense", "scalars", "dev"):
+        np.testing.assert_array_equal(np.asarray(a.column(name)),
+                                      np.asarray(b.column(name)))
+    assert [list(r) for r in a.column("tokens")] == \
+        [list(r) for r in b.column("tokens")]
+    assert (a.column("sparse").to_csr() != b.column("sparse").to_csr()).nnz \
+        == 0
+    # step != 1 falls back to the gather path
+    s = t.take(slice(0, 10, 2))
+    np.testing.assert_array_equal(np.asarray(s.column("scalars")),
+                                  x[::2, 0])
+    assert t.head(-1).num_rows == 0
+    assert t.head(3).num_rows == 3
+    assert t.head(99).num_rows == 10
