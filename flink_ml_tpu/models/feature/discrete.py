@@ -76,7 +76,7 @@ class StringIndexerModel(Model, StringIndexerModelParams):
                 # dict probes ('<U' columns hash-factorize inside
                 # _token_codes; other dtypes fall back to np.unique there)
                 from flink_ml_tpu.models.feature.text import _token_codes
-                uniq, inv = _token_codes(col)
+                uniq, inv = _token_codes(col, sort=False)
                 ids = np.fromiter(
                     (index.get(str(v), -1) for v in uniq), np.int64,
                     len(uniq))
@@ -135,7 +135,7 @@ def _si_shard_counts(col: np.ndarray, lo: int, hi: int):
 
     sub = col[lo:hi]
     if sub.dtype.kind == "U" and len(sub):
-        uniq, codes = _token_codes(sub)
+        uniq, codes = _token_codes(sub, sort=False)
         cnts = np.bincount(codes, minlength=len(uniq))
         first_idx = np.empty(len(uniq), np.int64)
         first_idx[codes[::-1]] = np.arange(hi - lo - 1, -1, -1,
