@@ -72,6 +72,11 @@ def _build() -> Optional[ctypes.CDLL]:
         lib.csv_parse_numeric.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
             ctypes.c_int64, ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
+        lib.factorize_i64.restype = ctypes.c_int64
+        lib.factorize_i64.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64]
         return lib
     except (OSError, subprocess.CalledProcessError):
         # a concurrent builder may have published a valid library even if
@@ -155,3 +160,31 @@ def csv_parse_numeric(data: bytes, n_cols: int, delimiter: str = ","):
     if n < 0:
         return None
     return out[:n]
+
+
+#: distinct-set cap for the native factorizer: past this many distinct
+#: keys (mostly-distinct corpora) the hash-table win evaporates and the
+#: uniq buffer would get large — callers fall back to their Python engine
+FACTORIZE_UNIQ_CAP = 1 << 24
+
+
+def factorize_i64(keys: np.ndarray):
+    """First-appearance factorization of a 1-D int64 array via the native
+    open-addressing kernel: returns (uniq_keys, codes) with uniq in
+    appearance order, or None when the native tier is unavailable or the
+    distinct count exceeds FACTORIZE_UNIQ_CAP (callers fall back to
+    pandas/np.unique)."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, np.int64)
+    n = len(keys)
+    cap = int(min(n, FACTORIZE_UNIQ_CAP)) + 1
+    codes = np.empty(n, np.int64)
+    uniq = np.empty(cap, np.int64)
+    nu = lib.factorize_i64(_ptr(keys, ctypes.c_int64), ctypes.c_int64(n),
+                           _ptr(codes, ctypes.c_int64),
+                           _ptr(uniq, ctypes.c_int64), ctypes.c_int64(cap))
+    if nu < 0:
+        return None
+    return uniq[:nu].copy(), codes

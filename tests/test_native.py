@@ -162,3 +162,53 @@ def test_csv_edge_cases(tmp_path):
     t2 = Table.from_csv(str(p2), names=["x", "y"])
     assert t2.column_names == ["x", "y"]
     np.testing.assert_allclose(t2["x"], [1.0])
+
+
+def test_factorize_i64_matches_pandas_oracle():
+    """Native factorize must produce EXACTLY pandas' first-appearance
+    labels and distinct order (the contract _token_codes relies on),
+    across collisions, duplicates, negatives and edge sizes."""
+    import pytest
+
+    pd = pytest.importorskip("pandas")
+
+    from flink_ml_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native tier unavailable")
+    rng = np.random.default_rng(3)
+    cases = [
+        rng.integers(-(1 << 62), 1 << 62, 10_000),
+        rng.integers(0, 7, 50_000),              # tiny domain, many dups
+        np.arange(1000)[::-1].astype(np.int64),  # all distinct, reversed
+        np.zeros(17, np.int64),
+        np.asarray([], np.int64),
+        np.asarray([np.iinfo(np.int64).min, -1, 0, 1,
+                    np.iinfo(np.int64).max] * 3, np.int64),
+    ]
+    for keys in cases:
+        res = native.factorize_i64(keys)
+        assert res is not None
+        uniq, codes = res
+        inv, pu = pd.factorize(keys, sort=False)
+        np.testing.assert_array_equal(uniq, np.asarray(pu))
+        np.testing.assert_array_equal(codes, np.asarray(inv, np.int64))
+
+
+def test_factorize_i64_cap_falls_back():
+    from flink_ml_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native tier unavailable")
+    old = native.FACTORIZE_UNIQ_CAP
+    native.FACTORIZE_UNIQ_CAP = 4
+    try:
+        assert native.factorize_i64(np.arange(100, dtype=np.int64)) is None
+        uniq, codes = native.factorize_i64(
+            np.asarray([5, 5, 9, 9], np.int64))
+        np.testing.assert_array_equal(uniq, [5, 9])
+        np.testing.assert_array_equal(codes, [0, 0, 1, 1])
+    finally:
+        native.FACTORIZE_UNIQ_CAP = old
