@@ -1063,19 +1063,23 @@ def _cv_shard_counts(col: np.ndarray, lo: int, hi: int):
     over rows [lo, hi) of a token matrix — the per-task count map of the
     reference's dictionary-learning shape (StringIndexer.java:117-122),
     merged by :func:`_merge_shard_counts`."""
+    from flink_ml_tpu import native
+
     shard = col[lo:hi]
     uniq, codes = _token_codes(shard, sort=False)
     u = len(uniq)
     tc = np.bincount(codes, minlength=u)
     mat = codes.reshape(shard.shape)
-    # same width-relative gate as _rowwise_counts: the dense count-matrix
-    # pass is O(n·u) and only beats the row-sort engine while u ~ O(w)
-    if u <= max(4 * shard.shape[1], 1024):
-        df = _doc_freq_small_domain(mat, u)
-    else:  # huge vocab: row-sorted run starts, one per (doc, token) pair
-        # (mat is freshly owned — the in-place row sort is fine)
-        _, start_codes, _ = _rowwise_counts(mat, with_counts=False)
-        df = np.bincount(start_codes, minlength=u)
+    df = native.doc_freq_i64(mat, u)  # one stamped pass, any u
+    if df is None:
+        # same width-relative gate as _rowwise_counts: the dense
+        # count-matrix pass is O(n·u), only beats row-sort while u ~ O(w)
+        if u <= max(4 * shard.shape[1], 1024):
+            df = _doc_freq_small_domain(mat, u)
+        else:  # huge vocab: row-sorted run starts, one per (doc, token)
+            # pair (mat is freshly owned — the in-place row sort is fine)
+            _, start_codes, _ = _rowwise_counts(mat, with_counts=False)
+            df = np.bincount(start_codes, minlength=u)
     return uniq, tc, df
 
 

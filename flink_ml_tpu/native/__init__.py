@@ -77,6 +77,10 @@ def _build() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64]
+        lib.doc_freq_i64.restype = None
+        lib.doc_freq_i64.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
         return lib
     except (OSError, subprocess.CalledProcessError):
         # a concurrent builder may have published a valid library even if
@@ -188,3 +192,20 @@ def factorize_i64(keys: np.ndarray):
     if nu < 0:
         return None
     return uniq[:nu].copy(), codes
+
+
+def doc_freq_i64(codes_mat: np.ndarray, u: int):
+    """Per-code document frequency of an (n_rows, w) int64 code matrix
+    with domain [0, u) — one native pass with a last-seen-row stamp; or
+    None when the native tier is unavailable (callers fall back to the
+    bincount/row-sort python engines)."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    codes_mat = np.ascontiguousarray(codes_mat, np.int64)
+    n_rows, w = codes_mat.shape
+    df = np.zeros(u, np.int64)
+    lib.doc_freq_i64(_ptr(codes_mat, ctypes.c_int64),
+                     ctypes.c_int64(n_rows), ctypes.c_int64(w),
+                     ctypes.c_int64(u), _ptr(df, ctypes.c_int64))
+    return df

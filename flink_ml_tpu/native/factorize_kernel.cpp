@@ -61,3 +61,24 @@ extern "C" int64_t factorize_i64(const int64_t* keys, int64_t n,
     }
     return nu;
 }
+
+// Document frequency over an (n_rows, w) matrix of codes in [0, u):
+// df[c] = number of rows containing code c at least once. One pass with a
+// per-code last-seen-row stamp — replaces the per-chunk bincount-matrix
+// (small u) and row-sort (large u) python engines in the CountVectorizer
+// fit (text.py _doc_freq_small_domain / _rowwise_counts), both of which
+// materialize large temporaries this kernel never needs.
+extern "C" void doc_freq_i64(const int64_t* codes, int64_t n_rows,
+                             int64_t w, int64_t u, int64_t* df) {
+    std::vector<int64_t> last(u, -1);
+    for (int64_t r = 0; r < n_rows; ++r) {
+        const int64_t* row = codes + r * w;
+        for (int64_t j = 0; j < w; ++j) {
+            const int64_t c = row[j];
+            if (last[c] != r) {
+                last[c] = r;
+                ++df[c];
+            }
+        }
+    }
+}

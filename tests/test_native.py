@@ -212,3 +212,30 @@ def test_factorize_i64_cap_falls_back():
         np.testing.assert_array_equal(codes, [0, 0, 1, 1])
     finally:
         native.FACTORIZE_UNIQ_CAP = old
+
+
+def test_doc_freq_i64_matches_python_engines():
+    """Native doc-freq must equal both python engines (bincount-matrix
+    and row-sort) across small and large domains, including rows with
+    repeated codes and u larger than any code present."""
+    from flink_ml_tpu import native
+    from flink_ml_tpu.models.feature.text import (
+        _doc_freq_small_domain,
+        _rowwise_counts,
+    )
+
+    if not native.available():
+        import pytest
+        pytest.skip("native tier unavailable")
+    rng = np.random.default_rng(5)
+    for n, w, u in [(200, 7, 5), (500, 3, 2000), (1, 1, 1), (50, 4, 4)]:
+        mat = rng.integers(0, u, (n, w)).astype(np.int64)
+        got = native.doc_freq_i64(mat, u)
+        want_small = _doc_freq_small_domain(mat, u)
+        _, starts, _ = _rowwise_counts(mat.copy(), with_counts=False)
+        want_sort = np.bincount(starts, minlength=u)
+        np.testing.assert_array_equal(got, want_small)
+        np.testing.assert_array_equal(got, want_sort)
+    # empty matrix
+    np.testing.assert_array_equal(
+        native.doc_freq_i64(np.zeros((0, 3), np.int64), 4), np.zeros(4))
