@@ -252,6 +252,21 @@ def _rowwise_counts(mat: np.ndarray, with_counts: bool = True,
         return empty, np.zeros(0, mat.dtype), \
             (empty if with_counts else None)
 
+    if domain is not None and 0 < domain <= 128:
+        # native stamped per-row counter: one pass, CSR-canonical
+        # triples — ~1.8x the k-pass engine on the small domains where
+        # both apply (A/B at 1Mx32 u=50: 2.4 s vs 4.5 s); larger domains
+        # keep the vectorized bincount engine, which wins past ~10^3
+        # (native 11.8 s vs 9.3 s at u=2000). Leaves ``mat`` unmodified,
+        # which the in-place contract permits.
+        from flink_ml_tpu import native
+
+        res = native.rowwise_counts(mat, domain)
+        if res is not None:
+            row_of, values, counts = res
+            return (row_of, values.astype(mat.dtype, copy=False),
+                    counts if with_counts else None)
+
     row_parts, val_parts, cnt_parts = [], [], []
 
     if domain is not None and 0 < domain <= 64:

@@ -82,3 +82,75 @@ extern "C" void doc_freq_i64(const int64_t* codes, int64_t n_rows,
         }
     }
 }
+
+// Per-row value counts of an (n_rows, w) code matrix with domain [0, u):
+// emits CSR-canonical triples (row ascending, value ascending within each
+// row) in one pass — a per-row count array plus a touched-value list,
+// reset per row. Replaces text.py _rowwise_counts' k-pass / bincount /
+// row-sort python engines on the HashingTF/CountVectorizer transform hot
+// path. Returns nnz, or -1 if more than cap triples would be written
+// (caller falls back). Templated over the narrow code dtypes the callers
+// actually store (relabeled bucket alphabets are uint8/uint16).
+#include <algorithm>
+
+template <typename T>
+static int64_t rowwise_counts_impl(const T* codes, int64_t n_rows,
+                                   int64_t w, int64_t u, int64_t* row_out,
+                                   int64_t* val_out, int64_t* cnt_out,
+                                   int64_t cap) {
+    std::vector<int64_t> cnt(u, 0);
+    std::vector<int64_t> touched;
+    touched.reserve((size_t)std::min<int64_t>(w, u));
+    int64_t nnz = 0;
+    for (int64_t r = 0; r < n_rows; ++r) {
+        const T* row = codes + r * w;
+        for (int64_t j = 0; j < w; ++j) {
+            const int64_t c = (int64_t)row[j];
+            if (cnt[c] == 0) touched.push_back(c);
+            ++cnt[c];
+        }
+        std::sort(touched.begin(), touched.end());
+        if (nnz + (int64_t)touched.size() > cap) return -1;
+        for (const int64_t c : touched) {
+            row_out[nnz] = r;
+            val_out[nnz] = c;
+            cnt_out[nnz] = cnt[c];
+            cnt[c] = 0;
+            ++nnz;
+        }
+        touched.clear();
+    }
+    return nnz;
+}
+
+extern "C" int64_t rowwise_counts_u8(const uint8_t* codes, int64_t n_rows,
+                                     int64_t w, int64_t u, int64_t* row_out,
+                                     int64_t* val_out, int64_t* cnt_out,
+                                     int64_t cap) {
+    return rowwise_counts_impl(codes, n_rows, w, u, row_out, val_out,
+                               cnt_out, cap);
+}
+
+extern "C" int64_t rowwise_counts_u16(const uint16_t* codes, int64_t n_rows,
+                                      int64_t w, int64_t u,
+                                      int64_t* row_out, int64_t* val_out,
+                                      int64_t* cnt_out, int64_t cap) {
+    return rowwise_counts_impl(codes, n_rows, w, u, row_out, val_out,
+                               cnt_out, cap);
+}
+
+extern "C" int64_t rowwise_counts_u32(const uint32_t* codes, int64_t n_rows,
+                                      int64_t w, int64_t u,
+                                      int64_t* row_out, int64_t* val_out,
+                                      int64_t* cnt_out, int64_t cap) {
+    return rowwise_counts_impl(codes, n_rows, w, u, row_out, val_out,
+                               cnt_out, cap);
+}
+
+extern "C" int64_t rowwise_counts_i64(const int64_t* codes, int64_t n_rows,
+                                      int64_t w, int64_t u,
+                                      int64_t* row_out, int64_t* val_out,
+                                      int64_t* cnt_out, int64_t cap) {
+    return rowwise_counts_impl(codes, n_rows, w, u, row_out, val_out,
+                               cnt_out, cap);
+}
