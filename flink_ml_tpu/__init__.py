@@ -22,6 +22,9 @@ Layers (bottom-up, see SURVEY.md §7):
   models    — the algorithm library (ref: flink-ml-lib)
   servable  — engine-free online inference (ref: flink-ml-servable-*)
   benchmark — JSON-config benchmark harness (ref: flink-ml-benchmark)
+  analysis  — jaxlint static analyzer for JAX/TPU hazards (docs/jaxlint.md;
+              no reference equivalent: the JVM had a type system where we
+              have tracing)
 """
 
 __version__ = "0.1.0"

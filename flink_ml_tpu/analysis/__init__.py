@@ -1,0 +1,22 @@
+"""jaxlint — repo-native static analysis for JAX/TPU correctness hazards.
+
+The round-5 advisor findings (ADVICE.md) were all *mechanical*: unbounded
+native indexing, aliasing views, silent clamping, swallowed error codes.
+``jit`` erases the runtime evidence of exactly these classes, so this
+package catches them in the source instead: an AST rule registry
+(``flink_ml_tpu.analysis.rules``), per-line suppressions with mandatory
+justifications, and text/JSON reports. CLI: ``scripts/jaxlint.py``;
+rule catalogue: ``docs/jaxlint.md``.
+"""
+
+from flink_ml_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Report,
+    Rule,
+    all_rules,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    iter_python_files,
+    register,
+)

@@ -1,0 +1,63 @@
+"""jaxlint command line (also installed as ``flink-ml-tpu-jaxlint``).
+
+Exit codes: 0 = clean (every finding suppressed with a justification),
+1 = unsuppressed findings, 2 = usage error. CI runs this over the whole
+package (``.github/workflows/tests.yml`` job ``jaxlint``); the rule
+catalogue and suppression syntax live in docs/jaxlint.md.
+
+Usage:
+    python scripts/jaxlint.py flink_ml_tpu/ [paths...]
+        [--format text|json] [--output FILE] [--rules r1,r2]
+        [--show-suppressed] [--list-rules]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="jaxlint")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to analyze")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--output", default=None,
+                        help="also write the report (in --format) here")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated subset of rule names")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="include suppressed findings in text output")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    from flink_ml_tpu.analysis import Report, all_rules, analyze_paths
+
+    if args.list_rules:
+        for name, rule in sorted(all_rules().items()):
+            print(f"{rule.code}  {name}: {rule.rationale}")
+        return 0
+    if not args.paths:
+        parser.error("no paths given (or use --list-rules)")
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        report = Report(analyze_paths(args.paths, rules))
+    except ValueError as e:  # unknown rule name
+        parser.error(str(e))
+
+    rendered = report.render_json() if args.format == "json" \
+        else report.render_text(show_suppressed=args.show_suppressed)
+    print(rendered)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(rendered + "\n")
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,11 @@
+"""Rule modules self-register on import (flink_ml_tpu.analysis.core
+``register``); importing this package loads the full rule set."""
+
+from flink_ml_tpu.analysis.rules import (  # noqa: F401
+    aliasing,
+    hostsync,
+    native_contract,
+    recompile,
+    rng,
+    tracing,
+)

@@ -1,0 +1,100 @@
+"""Helpers shared by rules: recognizing jit-like wrappers and their
+static-argument declarations, in both decorator and call-site form."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from flink_ml_tpu.analysis.core import FileContext, call_name, dotted_name
+
+#: callables that trace their operand (matched on the final component, so
+#: jax.jit / jit / jax.experimental.shard_map.shard_map all count)
+JIT_NAMES = {"jit", "pjit", "pmap", "vmap", "shard_map"}
+
+
+def _is_jit_callee(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name is not None and name.rsplit(".", 1)[-1] in JIT_NAMES
+
+
+def _literal_statics(keywords: List[ast.keyword]
+                     ) -> Tuple[Set[int], Set[str]]:
+    """static_argnums / static_argnames from literal keyword values."""
+    argnums: Set[int] = set()
+    argnames: Set[str] = set()
+
+    def ints(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            argnums.add(node.value)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                ints(e)
+
+    def strs(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            argnames.add(node.value)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                strs(e)
+
+    for kw in keywords:
+        if kw.arg == "static_argnums":
+            ints(kw.value)
+        elif kw.arg == "static_argnames":
+            strs(kw.value)
+    return argnums, argnames
+
+
+def jit_decorator_statics(dec: ast.AST
+                          ) -> Optional[Tuple[Set[int], Set[str]]]:
+    """(static_argnums, static_argnames) when ``dec`` is a jit-like
+    decorator (bare, called, or via functools.partial); None otherwise."""
+    if _is_jit_callee(dec):
+        return set(), set()
+    if isinstance(dec, ast.Call):
+        if _is_jit_callee(dec.func):
+            return _literal_statics(dec.keywords)
+        fname = call_name(dec)
+        if fname in ("functools.partial", "partial") and dec.args \
+                and _is_jit_callee(dec.args[0]):
+            return _literal_statics(dec.keywords)
+    return None
+
+
+def jitted_functions(ctx: FileContext
+                     ) -> Iterator[Tuple[ast.FunctionDef,
+                                         Set[int], Set[str]]]:
+    """Every FunctionDef traced by jit/shard_map — via decorator, or via a
+    call-site wrap ``jax.jit(fn, ...)`` resolving to a def of that name
+    anywhere in the file (the local-``def gen`` + ``return jax.jit(gen)``
+    idiom used throughout this codebase)."""
+    defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                statics = jit_decorator_statics(dec)
+                if statics is not None:
+                    yield node, statics[0], statics[1]
+    seen = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_jit_callee(node.func) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            argnums, argnames = _literal_statics(node.keywords)
+            for fn in defs_by_name.get(node.args[0].id, ()):
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    yield fn, argnums, argnames
+
+
+def traced_params(fn: ast.FunctionDef, static_argnums: Set[int],
+                  static_argnames: Set[str]) -> Set[str]:
+    """Parameter names that receive tracers (non-static args)."""
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    traced = {n for i, n in enumerate(names)
+              if i not in static_argnums and n not in static_argnames}
+    traced |= {a.arg for a in args.kwonlyargs
+               if a.arg not in static_argnames}
+    return traced

@@ -1,0 +1,132 @@
+"""Rule JL106 ``alias-mutation``: in-place writes through Table views.
+
+Slice-path ``Table.take()``/``head()`` return columns that are VIEWS of
+the source table's buffers (common/table.py ``take`` docstring — the
+copy was removed deliberately: the arange path's copy measured as the
+dominant cost of every streaming batch loop). An in-place mutation of a
+view column therefore silently corrupts the source table and every
+sibling batch. The rule tracks names bound to ``.take(...)``/
+``.head(...)`` results (and columns pulled out of them) within a scope
+and flags subscript assignment / augmented assignment through them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from flink_ml_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    call_name,
+    register,
+)
+
+
+def _is_view_producer(value: ast.AST) -> bool:
+    """A ``<expr>.take(...)`` / ``<expr>.head(...)`` method call that is
+    not an explicit numpy call (np.take copies)."""
+    if not isinstance(value, ast.Call) \
+            or not isinstance(value.func, ast.Attribute) \
+            or value.func.attr not in ("take", "head"):
+        return False
+    name = call_name(value) or ""
+    return not name.startswith(("np.", "numpy."))
+
+
+def _is_column_of(value: ast.AST, views: Set[str]) -> bool:
+    """``view["col"]`` or ``view.column("col")`` for a tracked view."""
+    if isinstance(value, ast.Subscript) \
+            and isinstance(value.value, ast.Name):
+        return value.value.id in views
+    if isinstance(value, ast.Call) \
+            and isinstance(value.func, ast.Attribute) \
+            and value.func.attr in ("column", "scalars") \
+            and isinstance(value.func.value, ast.Name):
+        return value.func.value.id in views
+    return False
+
+
+@register
+class AliasMutationRule(Rule):
+    name = "alias-mutation"
+    code = "JL106"
+    rationale = (
+        "columns of a slice-path Table.take()/head() are views of the "
+        "source buffers; in-place mutation corrupts the source and all "
+        "sibling batches — copy first (common/table.py contract)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        scopes = [n for n in ast.walk(ctx.tree)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        scopes.append(ctx.tree)
+        for scope in scopes:
+            yield from self._check_scope(ctx, scope)
+
+    def _own_nodes(self, scope):
+        """Nodes of this scope in SOURCE ORDER (alias tracking is a
+        forward pass: `view = t.head(n)` must register before
+        `col = view.column(...)`), not descending into nested defs."""
+        for child in ast.iter_child_nodes(scope):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            yield child
+            yield from self._own_nodes(child)
+
+    def _check_scope(self, ctx, scope) -> Iterator[Finding]:
+        # ONE forward pass: a write is judged against the alias state AT
+        # THAT POINT, so `c[0] = 1` before `c = view["a"]` is clean, and
+        # rebinding a name to ANYTHING that is not itself a view/column
+        # (not just `.copy()`) clears its alias status — `col = col * 2`
+        # owns a fresh array.
+        views: Set[str] = set()
+        cols: Set[str] = set()
+        for node in self._own_nodes(scope):
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign):
+                value, targets = node.value, [node.target]
+            elif isinstance(node, ast.AugAssign):
+                value, targets = None, [node.target]
+            else:
+                continue
+            for tgt in targets:  # writes through the CURRENT aliases
+                if isinstance(tgt, ast.Subscript):
+                    base = tgt.value
+                    hit = (isinstance(base, ast.Name) and base.id in cols) \
+                        or _is_column_of(base, views)
+                elif isinstance(node, ast.AugAssign):
+                    # col += 1 on an ndarray mutates in place too
+                    hit = isinstance(tgt, ast.Name) and tgt.id in cols
+                else:
+                    hit = False
+                if hit:
+                    yield self.finding(
+                        ctx, tgt,
+                        "in-place write through a Table.take()/head() "
+                        "view column: slice-path columns alias the "
+                        "source table's buffers (common/table.py take() "
+                        "docstring) — .copy() the column before "
+                        "mutating")
+            if isinstance(node, ast.AugAssign) or value is None:
+                continue  # augmented assign never rebinds to a new object
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            if _is_view_producer(value):
+                views.update(names)
+                for n in names:
+                    cols.discard(n)
+            elif _is_column_of(value, views) or (
+                    isinstance(value, ast.Name) and value.id in cols):
+                cols.update(names)
+                for n in names:
+                    views.discard(n)
+            elif isinstance(value, ast.Name) and value.id in views:
+                views.update(names)
+            else:  # rebound to an owned value: alias chain broken
+                for n in names:
+                    views.discard(n)
+                    cols.discard(n)

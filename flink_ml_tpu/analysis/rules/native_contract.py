@@ -1,0 +1,147 @@
+"""Rule JL105 ``native-contract``: fallible native kernels and clamped
+gathers used without their guard.
+
+Every fallible ``flink_ml_tpu.native`` wrapper returns ``None`` when the
+native tier is unavailable or a domain/uniq cap trips (native/__init__.py
+module contract) — a caller that uses the result without a ``None``
+check crashes exactly on the hosts where the C++ tier is the thing being
+worked around. And ``np.take(..., mode='clip')`` — used for speed on the
+benchmark hot path — silently clamps out-of-range indices where fancy
+indexing would raise, so it must sit behind a bounds assert
+(benchmark/datagen.py is the reference pattern, per ADVICE r5 #5).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from flink_ml_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    call_name,
+    dotted_name,
+    register,
+)
+
+#: native wrappers whose None return is the fallback signal
+FALLIBLE = {"factorize_i64", "doc_freq_i64", "rowwise_counts",
+            "csv_parse_numeric"}
+
+
+def _fallible_native_call(node: ast.Call) -> Optional[str]:
+    name = call_name(node)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if len(parts) >= 2 and parts[-2] == "native" and parts[-1] in FALLIBLE:
+        return name
+    return None
+
+
+def _scope_of(ctx: FileContext, node: ast.AST) -> ast.AST:
+    return ctx.enclosing_function(node) or ctx.tree
+
+
+def _none_checked(scope: ast.AST, varname: str) -> bool:
+    """Is ``varname`` compared against None anywhere in ``scope``?"""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.Is, ast.IsNot)):
+            sides = [node.left, node.comparators[0]]
+            names = [dotted_name(s) for s in sides]
+            consts = [isinstance(s, ast.Constant) and s.value is None
+                      for s in sides]
+            if varname in names and any(consts):
+                return True
+    return False
+
+
+def _names_in(node: ast.AST):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _take_indices(node: ast.Call) -> Optional[ast.AST]:
+    """The indices operand of a ``take`` call: second positional for the
+    module form ``np.take(a, idx, ...)``, first for the method form
+    ``a.take(idx, ...)``."""
+    name = call_name(node) or ""
+    if name in ("np.take", "numpy.take"):
+        return node.args[1] if len(node.args) > 1 else None
+    return node.args[0] if node.args else None
+
+
+def _bounds_assert_before(scope: ast.AST, call: ast.Call) -> bool:
+    """An assert EARLIER in the scope that mentions (a name from) the
+    indices operand — an unrelated precondition assert must not satisfy
+    the bounds-check requirement (the whole point is that clip's clamp
+    is silent)."""
+    idx = _take_indices(call)
+    idx_names = _names_in(idx) if idx is not None else set()
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Assert) and n.lineno < call.lineno:
+            if not idx_names or idx_names & _names_in(n.test):
+                return True
+    return False
+
+
+@register
+class NativeContractRule(Rule):
+    name = "native-contract"
+    code = "JL105"
+    rationale = (
+        "fallible native wrappers signal fallback by returning None; "
+        "np.take(mode='clip') silently clamps bad indices — both need "
+        "their guard at the call site")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            native_name = _fallible_native_call(node)
+            if native_name is not None:
+                yield from self._check_native(ctx, node, native_name)
+                continue
+            name = call_name(node) or ""
+            if name.rsplit(".", 1)[-1] == "take" and any(
+                    kw.arg == "mode"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value == "clip"
+                    for kw in node.keywords):
+                scope = _scope_of(ctx, node)
+                if not _bounds_assert_before(scope, node):
+                    yield self.finding(
+                        ctx, node,
+                        "np.take(mode='clip') without a preceding bounds "
+                        "assert in this scope: clip silently clamps "
+                        "out-of-range indices where fancy indexing "
+                        "raised (assert indices.max() < len(table) "
+                        "first — see benchmark/datagen.py)")
+
+    def _check_native(self, ctx, node: ast.Call,
+                      name: str) -> Iterator[Finding]:
+        # climb to the statement consuming the call result; the only
+        # accepted shape is `x = native.f(...)` (possibly via a
+        # conditional expression) followed by a None check on x in scope
+        cur, parent = node, ctx.parents.get(node)
+        while isinstance(parent, (ast.IfExp, ast.BoolOp)):
+            cur, parent = parent, ctx.parents.get(parent)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name):
+            var = parent.targets[0].id
+            if not _none_checked(_scope_of(ctx, node), var):
+                yield self.finding(
+                    ctx, node,
+                    f"result of fallible `{name}` is never None-checked: "
+                    "the wrapper returns None when the native tier is "
+                    "unavailable or a cap trips (native/__init__.py "
+                    "contract) — fall back to the Python engine")
+        elif isinstance(parent, ast.Compare):
+            pass  # direct `native.f(...) is None` probe is fine
+        else:
+            yield self.finding(
+                ctx, node,
+                f"result of fallible `{name}` used inline: assign it "
+                "and None-check before use (returns None on fallback "
+                "— native/__init__.py contract)")

@@ -1,0 +1,168 @@
+"""Rule JL103 ``rng-reuse``: the same PRNG key consumed by two draws.
+
+``jax.random`` keys are pure values: drawing twice with one key returns
+perfectly correlated samples — no error, no warning, just silently
+broken statistics (the exact bug class functional-MapReduce formulations
+like DrJAX avoid by threading fresh splits). The rule walks each
+function in statement order tracking key freshness: a key name becomes
+FRESH when assigned from ``PRNGKey``/``key``/``split``/``fold_in`` and
+CONSUMED by any other ``jax.random.*`` draw; a second draw on a consumed
+key is a finding. Loop bodies are walked twice so a draw on a
+loop-invariant key (fresh on iteration 1, reused on every later one) is
+caught; ``if``/``else`` branches merge conservatively.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional
+
+from flink_ml_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    call_name,
+    register,
+)
+
+#: jax.random members that PRODUCE keys rather than consume randomness
+_KEY_PRODUCERS = {"PRNGKey", "key", "split", "fold_in", "wrap_key_data",
+                  "clone", "key_data", "key_impl"}
+
+_FRESH, _CONSUMED = "fresh", "consumed"
+
+
+def _random_member(name: Optional[str]) -> Optional[str]:
+    """'normal' for jax.random.normal / random.normal / jrandom.normal."""
+    if not name:
+        return None
+    parts = name.split(".")
+    if len(parts) >= 2 and parts[-2] in ("random", "jrandom", "jr"):
+        return parts[-1]
+    return None
+
+
+def _key_expr(node: ast.AST) -> Optional[str]:
+    """Stable textual id for a key operand (Name or constant subscript of
+    a Name, e.g. ``keys[0]``); None for anything we can't track."""
+    if isinstance(node, (ast.Name, ast.Subscript, ast.Attribute)):
+        try:
+            return ast.unparse(node)
+        except Exception:  # pragma: no cover - unparse is total on exprs
+            return None
+    return None
+
+
+@register
+class RngReuseRule(Rule):
+    name = "rng-reuse"
+    code = "JL103"
+    rationale = (
+        "two jax.random draws from one key return correlated samples "
+        "with no error — split/fold_in between draws")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        scopes = [n for n in ast.walk(ctx.tree)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        reported: Dict[int, Finding] = {}
+        for fn in scopes:
+            state: Dict[str, str] = {}
+            self._walk(ctx, fn.body, state, reported)
+        # module-level statements (outside any def)
+        top = [s for s in ctx.tree.body
+               if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef))]
+        self._walk(ctx, top, {}, reported)
+        yield from reported.values()
+
+    def _walk(self, ctx, stmts: List[ast.stmt], state: Dict[str, str],
+              reported: Dict[int, Finding]):
+        for stmt in stmts:
+            self._stmt(ctx, stmt, state, reported)
+
+    def _stmt(self, ctx, stmt, state, reported):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate scope, handled at the top level
+        if isinstance(stmt, ast.Assign):
+            self._consume_draws(ctx, stmt.value, state, reported)
+            fresh = self._produces_key(stmt.value)
+            for tgt in stmt.targets:
+                self._bind(tgt, fresh, state)
+        elif isinstance(stmt, ast.AugAssign):
+            self._consume_draws(ctx, stmt.value, state, reported)
+        elif isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.While):
+                self._consume_draws(ctx, stmt.test, state, reported)
+            else:
+                self._consume_draws(ctx, stmt.iter, state, reported)
+            # two passes: pass 2 sees pass 1's consumed keys, catching
+            # draws on keys that are not refreshed inside the loop
+            self._walk(ctx, stmt.body, state, reported)
+            self._walk(ctx, stmt.body, state, reported)
+            self._walk(ctx, stmt.orelse, state, reported)
+        elif isinstance(stmt, ast.If):
+            self._consume_draws(ctx, stmt.test, state, reported)
+            s_then = dict(state)
+            s_else = dict(state)
+            self._walk(ctx, stmt.body, s_then, reported)
+            self._walk(ctx, stmt.orelse, s_else, reported)
+            for k in set(s_then) | set(s_else):
+                if _CONSUMED in (s_then.get(k), s_else.get(k)):
+                    state[k] = _CONSUMED
+                else:
+                    state[k] = s_then.get(k, s_else.get(k))
+        elif isinstance(stmt, (ast.With, ast.Try)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._consume_draws(ctx, child, state, reported)
+            for body in ([stmt.body] if isinstance(stmt, ast.With) else
+                         [stmt.body, *[h.body for h in stmt.handlers],
+                          stmt.orelse, stmt.finalbody]):
+                self._walk(ctx, body, state, reported)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._consume_draws(ctx, child, state, reported)
+
+    def _bind(self, target, fresh: bool, state):
+        if isinstance(target, ast.Name):
+            if fresh:
+                state[target.id] = _FRESH
+            else:
+                state.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, fresh, state)
+
+    def _produces_key(self, value: ast.AST) -> bool:
+        if isinstance(value, ast.Call):
+            member = _random_member(call_name(value))
+            if member in _KEY_PRODUCERS:
+                return True
+            # nested: jax.random.fold_in(jax.random.key(seed), i)
+        if isinstance(value, ast.Subscript):
+            return self._produces_key(value.value)
+        return False
+
+    def _consume_draws(self, ctx, expr: ast.AST, state, reported):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            member = _random_member(call_name(node))
+            if member is None or member in _KEY_PRODUCERS:
+                continue
+            if not node.args:
+                continue
+            key = _key_expr(node.args[0])
+            if key is None:
+                continue
+            if state.get(key) == _CONSUMED:
+                if id(node) not in reported:
+                    reported[id(node)] = self.finding(
+                        ctx, node,
+                        f"key `{key}` already consumed by an earlier "
+                        f"jax.random draw — draws from one key are "
+                        "correlated; jax.random.split it first")
+            else:
+                state[key] = _CONSUMED

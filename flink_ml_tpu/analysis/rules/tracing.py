@@ -1,0 +1,165 @@
+"""Rule JL101 ``tracer-leak``: host-side concretization of traced values.
+
+Inside a jit/shard_map-traced function, ``float(x)``/``int(x)``/
+``bool(x)`` and ``np.*`` calls on a value that flows from a traced
+parameter either raise ``TracerConversionError`` at trace time or — far
+worse — silently bake a trace-time constant into the compiled program.
+A Python ``if``/``while`` on a traced value is the same hazard: the
+branch is resolved once, at trace time. The rule runs a simple forward
+taint pass (parameters taint assignments that mention them) so derived
+values are covered, and treats ``.shape``/``.dtype``/``len()``/
+``isinstance()`` as static (they are concrete under tracing).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from flink_ml_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    call_name,
+    register,
+)
+from flink_ml_tpu.analysis.rules._shared import jitted_functions, traced_params
+
+#: attribute accesses that are concrete (static) under tracing
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding",
+                "aval", "weak_type"}
+
+#: host builtins whose call concretizes its operand
+HOST_CASTS = {"float", "int", "bool", "complex"}
+
+#: builtins that stay static even on tracers
+STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr", "repr",
+                "str"}
+
+
+def _mentions_traced(node: ast.AST, tainted: Set[str]) -> bool:
+    """Does ``node`` reference a tainted name in a way that is traced
+    (i.e. not through a static attribute or static builtin)?"""
+    if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+        return False
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in STATIC_CALLS:
+            return False
+        if name is not None and name.rsplit(".", 1)[-1] in STATIC_ATTRS:
+            return False
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    return any(_mentions_traced(c, tainted)
+               for c in ast.iter_child_nodes(node))
+
+
+@register
+class TracerLeakRule(Rule):
+    name = "tracer-leak"
+    code = "JL101"
+    rationale = (
+        "float()/int()/bool()/np.* or a Python branch on a traced value "
+        "inside jit/shard_map bakes a trace-time constant (or dies only "
+        "at trace time) — the compiled program silently stops depending "
+        "on the input")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn, argnums, argnames in jitted_functions(ctx):
+            tainted = traced_params(fn, argnums, argnames)
+            findings: List[Finding] = []
+            self._walk_body(ctx, fn.body, set(tainted), findings)
+            seen = set()
+            for f in findings:
+                key = (f.line, f.col, f.message)
+                if key not in seen:
+                    seen.add(key)
+                    yield f
+
+    # -- statement-ordered taint walk ---------------------------------------
+    def _walk_body(self, ctx, stmts, tainted: Set[str], findings):
+        for stmt in stmts:
+            self._walk_stmt(ctx, stmt, tainted, findings)
+
+    def _walk_stmt(self, ctx, stmt, tainted: Set[str], findings):
+        if isinstance(stmt, ast.Assign):
+            self._scan_expr(ctx, stmt.value, tainted, findings)
+            is_tainted = _mentions_traced(stmt.value, tainted)
+            for tgt in stmt.targets:
+                self._bind(tgt, is_tainted, tainted)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._scan_expr(ctx, stmt.value, tainted, findings)
+            self._bind(stmt.target,
+                       _mentions_traced(stmt.value, tainted), tainted)
+        elif isinstance(stmt, ast.AugAssign):
+            self._scan_expr(ctx, stmt.value, tainted, findings)
+            if _mentions_traced(stmt.value, tainted):
+                self._bind(stmt.target, True, tainted)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(ctx, stmt.test, tainted, findings)
+            if _mentions_traced(stmt.test, tainted):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                findings.append(self.finding(
+                    ctx, stmt,
+                    f"Python `{kind}` on a traced value: the branch is "
+                    "resolved once at trace time (use jnp.where/"
+                    "lax.cond)"))
+            self._walk_body(ctx, stmt.body, tainted, findings)
+            self._walk_body(ctx, stmt.orelse, tainted, findings)
+        elif isinstance(stmt, ast.For):
+            self._scan_expr(ctx, stmt.iter, tainted, findings)
+            self._bind(stmt.target,
+                       _mentions_traced(stmt.iter, tainted), tainted)
+            self._walk_body(ctx, stmt.body, tainted, findings)
+            self._walk_body(ctx, stmt.orelse, tainted, findings)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def closes over the traced environment
+            self._walk_body(ctx, stmt.body, set(tainted), findings)
+        elif isinstance(stmt, (ast.With,)):
+            self._walk_body(ctx, stmt.body, tainted, findings)
+        elif isinstance(stmt, (ast.Try,)):
+            self._walk_body(ctx, stmt.body, tainted, findings)
+            for h in stmt.handlers:
+                self._walk_body(ctx, h.body, tainted, findings)
+            self._walk_body(ctx, stmt.orelse, tainted, findings)
+            self._walk_body(ctx, stmt.finalbody, tainted, findings)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._scan_expr(ctx, child, tainted, findings)
+
+    def _bind(self, target, is_tainted: bool, tainted: Set[str]):
+        if isinstance(target, ast.Name):
+            if is_tainted:
+                tainted.add(target.id)
+            else:
+                tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, is_tainted, tainted)
+
+    def _scan_expr(self, ctx, expr, tainted: Set[str], findings):
+        """Flag host casts / np.* calls on traced operands and traced
+        ternary tests anywhere inside ``expr``."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in HOST_CASTS and any(
+                        _mentions_traced(a, tainted) for a in node.args):
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"host cast `{name}()` on a traced value "
+                        "concretizes at trace time"))
+                elif name and (name.startswith("np.")
+                               or name.startswith("numpy.")) and any(
+                        _mentions_traced(a, tainted) for a in node.args):
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"`{name}` on a traced value forces host "
+                        "concretization under jit (use jnp)"))
+            elif isinstance(node, ast.IfExp) and _mentions_traced(
+                    node.test, tainted):
+                findings.append(self.finding(
+                    ctx, node,
+                    "conditional expression on a traced value is "
+                    "resolved at trace time (use jnp.where)"))

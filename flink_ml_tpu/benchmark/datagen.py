@@ -106,11 +106,20 @@ def _string_gather(tokens: np.ndarray, ints: np.ndarray) -> np.ndarray:
     measured 26 s on this page-fault-punishing host, the ~8M-element
     chunked take 5.6 s (the output chunk stays cache/TLB-resident).
     mode='clip' skips take's per-call bounds pass; codes come from
-    rng.integers/searchsorted so they are in range by construction."""
+    rng.integers/searchsorted so they are in range by construction — and
+    the one-time assert below makes that construction-time claim fail
+    loudly if a future datagen change breaks it, instead of clip
+    clamping to the last token and producing a silently wrong corpus
+    (ADVICE r5 #5). One O(n) max over int codes, negligible next to the
+    gather itself."""
     it = tokens.dtype.itemsize  # '<U' itemsize is 4·width: always %4 == 0
     unit, step = (np.int64, it // 8) if it % 8 == 0 else (np.int32, it // 4)
     tv = np.ascontiguousarray(tokens.view(unit).reshape(len(tokens), step))
     flat = ints.reshape(-1)
+    assert flat.size == 0 or (int(flat.max()) < len(tokens)
+                              and int(flat.min()) >= 0), (
+        f"token codes out of range: [{flat.min()}, {flat.max()}] vs "
+        f"{len(tokens)} tokens — clip would silently clamp these")
     out = np.empty((flat.shape[0], step), unit)
     chunk = 8 << 20
     if step == 1:

@@ -270,7 +270,18 @@ class Table:
         lowers to a gather that measured ~1.5 s WARM per call on the
         8-device mesh, which dominated every streaming fit's batch loop
         (same pathology as columnar.head_rows). Array indices keep the
-        general gather path."""
+        general gather path.
+
+        ALIASING CONTRACT: the slice path returns host columns that are
+        VIEWS (``col[start:stop]``) of this table's buffers — the copy
+        the old arange path paid was the dominant batch-loop cost, so it
+        is deliberately gone. Mutating a slice-take/``head`` column in
+        place silently corrupts the source table and every sibling
+        batch; callers must ``.copy()`` a column before writing to it
+        (mirrors the IN-PLACE note on text.py ``_rowwise_counts``; lint
+        rule ``alias-mutation`` in flink_ml_tpu.analysis enforces this
+        at the call site). Array-index takes copy, as numpy fancy
+        indexing always does."""
         if isinstance(indices, slice):
             start, stop, step = indices.indices(self._num_rows)
             if step == 1:
@@ -280,6 +291,9 @@ class Table:
         return Table({n: c[indices] for n, c in self._columns.items()})
 
     def head(self, n: int) -> "Table":
+        """First ``n`` rows via the slice-take fast path. Host columns of
+        the result are VIEWS of this table's buffers — see the aliasing
+        contract on :meth:`take`; copy before mutating."""
         # clamp below too: slice(0, -1) would mean "all but the last row",
         # while head(-1) has always meant 0 rows
         return self.take(slice(0, max(0, min(n, self._num_rows))))

@@ -133,7 +133,7 @@ def window_stream(stream: StreamTable, windows,
     pending_window = None
     for chunk in stream:
         if event_time:
-            wids = np.asarray(chunk.column(timestamp_col),
+            wids = np.asarray(chunk.column(timestamp_col),  # jaxlint: disable=host-sync -- window assignment must read timestamps on host; once per arriving chunk, not per training round
                               np.int64) // size_ms
             chunk_windows = [(wid, chunk.take(np.nonzero(wids == wid)[0]))
                              for wid in np.unique(wids)]
@@ -161,7 +161,7 @@ def _session_windows(stream, gap_ms, event_time, timestamp_col, emit, _time):
         if chunk.num_rows == 0:
             continue
         if event_time:
-            ts = np.asarray(chunk.column(timestamp_col), np.int64)
+            ts = np.asarray(chunk.column(timestamp_col), np.int64)  # jaxlint: disable=host-sync -- session gaps are defined over host timestamps; one read per arriving chunk, not per training round
             # split the chunk at internal gaps; prepend the pending session
             starts = np.nonzero(np.diff(ts) > gap_ms)[0] + 1
             bounds = [0, *starts.tolist(), len(ts)]

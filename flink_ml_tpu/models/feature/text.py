@@ -1085,7 +1085,7 @@ def _cv_shard_counts(col: np.ndarray, lo: int, hi: int):
     u = len(uniq)
     tc = np.bincount(codes, minlength=u)
     mat = codes.reshape(shard.shape)
-    df = native.doc_freq_i64(mat, u)  # one stamped pass, any u
+    df = native.doc_freq_i64(mat, u)  # stamped pass, u-capped (None above)
     if df is None:
         # same width-relative gate as _rowwise_counts: the dense
         # count-matrix pass is O(n·u), only beats row-sort while u ~ O(w)

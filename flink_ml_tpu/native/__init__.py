@@ -77,7 +77,7 @@ def _build() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64]
-        lib.doc_freq_i64.restype = None
+        lib.doc_freq_i64.restype = ctypes.c_int64
         lib.doc_freq_i64.argtypes = [
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
@@ -206,22 +206,36 @@ def factorize_i64(keys: np.ndarray):
 def doc_freq_i64(codes_mat: np.ndarray, u: int):
     """Per-code document frequency of an (n_rows, w) int64 code matrix
     with domain [0, u) — one native pass with a last-seen-row stamp; or
-    None when the native tier is unavailable (callers fall back to the
-    bincount/row-sort python engines)."""
+    None when the native tier is unavailable, any code falls outside
+    [0, u) (the kernel bounds-checks and returns -1 rather than corrupt
+    the heap), or the domain exceeds ROWWISE_DOMAIN_CAP (callers fall
+    back to the bincount/row-sort python engines).
+
+    The cap mirrors the counter siblings: the last-seen stamp is 8*u
+    bytes PER FORKED WORKER, and _cv_shard_counts calls this with
+    u = shard-distinct tokens, so a mostly-distinct corpus (u up to
+    rows*w) would otherwise allocate gigabytes across the host pool on
+    exactly the degenerate vocabularies the chunked python engines were
+    built to survive."""
+    if u <= 0 or u > ROWWISE_DOMAIN_CAP:
+        return None
     lib = _get_lib()
     if lib is None:
         return None
     codes_mat = np.ascontiguousarray(codes_mat, np.int64)
     n_rows, w = codes_mat.shape
     df = np.zeros(u, np.int64)
-    lib.doc_freq_i64(_ptr(codes_mat, ctypes.c_int64),
-                     ctypes.c_int64(n_rows), ctypes.c_int64(w),
-                     ctypes.c_int64(u), _ptr(df, ctypes.c_int64))
+    rc = lib.doc_freq_i64(_ptr(codes_mat, ctypes.c_int64),
+                          ctypes.c_int64(n_rows), ctypes.c_int64(w),
+                          ctypes.c_int64(u), _ptr(df, ctypes.c_int64))
+    if rc < 0:  # out-of-domain code: python engines raise IndexError
+        return None
     return df
 
 
-#: cnt-array budget for the native rowwise counter (8 bytes per domain
-#: entry, reset per row via the touched list)
+#: per-domain-entry budget (8 bytes each) shared by the native rowwise
+#: counter's cnt array and doc_freq_i64's last-seen stamp — above it the
+#: callers' chunked python engines bound memory instead
 ROWWISE_DOMAIN_CAP = 1 << 22
 
 

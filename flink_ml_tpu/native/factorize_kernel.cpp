@@ -68,19 +68,27 @@ extern "C" int64_t factorize_i64(const int64_t* keys, int64_t n,
 // (small u) and row-sort (large u) python engines in the CountVectorizer
 // fit (text.py _doc_freq_small_domain / _rowwise_counts), both of which
 // materialize large temporaries this kernel never needs.
-extern "C" void doc_freq_i64(const int64_t* codes, int64_t n_rows,
-                             int64_t w, int64_t u, int64_t* df) {
+//
+// Returns 0, or -1 when any code falls outside [0, u) — this is a
+// module-level API and the python engines it replaces raised IndexError
+// on bad codes, so an unchecked write here would be silent heap
+// corruption in the parent or a forked worker; the wrapper returns None
+// and the caller falls back to the (bounds-checked) python engine.
+extern "C" int64_t doc_freq_i64(const int64_t* codes, int64_t n_rows,
+                                int64_t w, int64_t u, int64_t* df) {
     std::vector<int64_t> last(u, -1);
     for (int64_t r = 0; r < n_rows; ++r) {
         const int64_t* row = codes + r * w;
         for (int64_t j = 0; j < w; ++j) {
             const int64_t c = row[j];
+            if (c < 0 || c >= u) return -1;
             if (last[c] != r) {
                 last[c] = r;
                 ++df[c];
             }
         }
     }
+    return 0;
 }
 
 // Per-row value counts of an (n_rows, w) code matrix with domain [0, u):
@@ -88,9 +96,11 @@ extern "C" void doc_freq_i64(const int64_t* codes, int64_t n_rows,
 // row) in one pass — a per-row count array plus a touched-value list,
 // reset per row. Replaces text.py _rowwise_counts' k-pass / bincount /
 // row-sort python engines on the HashingTF/CountVectorizer transform hot
-// path. Returns nnz, or -1 if more than cap triples would be written
-// (caller falls back). Templated over the narrow code dtypes the callers
-// actually store (relabeled bucket alphabets are uint8/uint16).
+// path. Returns nnz, or -1 if more than cap triples would be written or
+// any code falls outside [0, u) — cnt[c] with an unvalidated c is heap
+// corruption, where the python engines raised IndexError (caller falls
+// back to them either way). Templated over the narrow code dtypes the
+// callers actually store (relabeled bucket alphabets are uint8/uint16).
 #include <algorithm>
 
 template <typename T>
@@ -106,6 +116,7 @@ static int64_t rowwise_counts_impl(const T* codes, int64_t n_rows,
         const T* row = codes + r * w;
         for (int64_t j = 0; j < w; ++j) {
             const int64_t c = (int64_t)row[j];
+            if (c < 0 || c >= u) return -1;
             if (cnt[c] == 0) touched.push_back(c);
             ++cnt[c];
         }

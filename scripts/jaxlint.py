@@ -1,0 +1,16 @@
+"""jaxlint CLI shim — see flink_ml_tpu.analysis.cli (the real entry
+point, also installed as ``flink-ml-tpu-jaxlint``) and docs/jaxlint.md.
+Kept here so CI and developers can run the analyzer from a checkout
+without installing the package."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from flink_ml_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

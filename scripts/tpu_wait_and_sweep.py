@@ -67,6 +67,15 @@ def main() -> int:
              "--chart", os.path.join(REPO, "benchmark_results_r4.png"),
              "--budget-s", "150", "--resume"], env=env)
         print(f"attempt {attempt}: sweep rc={rc}", flush=True)
+        if rc == 3:
+            # validation regression (see run_benchmark_sweep exit codes):
+            # retrying cannot fix it (--resume skips the regressed rows),
+            # and folding into BASELINE.md would hide it — surface and
+            # stop so the regression is the loudest thing in the log
+            print("sweep reported a VALIDATION REGRESSION (exit 3): not "
+                  "retrying, not folding into BASELINE.md — see the "
+                  "results JSON _meta block", flush=True)
+            return 3
         if rc == 0:
             # same tunnel-up window: grab the north-star per-op traces +
             # layout diagnosis before the tunnel can die again (same env

@@ -1,0 +1,19 @@
+"""jaxlint fixture: NEGATIVE for alias-mutation.
+
+Copies break the alias chain, and np.take is a copying gather — both
+safe to mutate.
+"""
+import numpy as np
+
+
+def safe_batch(table):
+    batch = table.take(slice(0, 1024))
+    col = batch.column("x").copy()  # owned buffer
+    col[0] = 0.0
+    return col
+
+
+def numpy_take(arr, idx):
+    picked = np.take(arr, idx)  # numpy take copies
+    picked[0] = 1.0
+    return picked

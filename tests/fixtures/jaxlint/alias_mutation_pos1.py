@@ -1,0 +1,11 @@
+"""jaxlint fixture: POSITIVE for alias-mutation.
+
+Writing through a column of a slice-take batch: the write lands in the
+source table's buffer.
+"""
+
+
+def corrupt_batch(table):
+    batch = table.take(slice(0, 1024))
+    batch["x"][0] = 0.0  # aliases the source table
+    return batch

@@ -1,0 +1,11 @@
+"""jaxlint fixture: POSITIVE for alias-mutation.
+
+Augmented assignment through a column pulled out of a head() view.
+"""
+
+
+def normalize_head(table):
+    view = table.head(32)
+    col = view.column("f")
+    col[:] -= col.mean()  # in-place on a view column
+    return view

@@ -1,0 +1,14 @@
+"""jaxlint fixture: NEGATIVE for host-sync.
+
+Same loop-body np.asarray pattern as the positives, but this module's
+path has no iteration marker — the rule is scoped to the iteration
+runtime's hot loops.
+"""
+import numpy as np
+
+
+def batch_stats(tables):
+    out = []
+    for t in tables:
+        out.append(np.asarray(t).mean())
+    return out

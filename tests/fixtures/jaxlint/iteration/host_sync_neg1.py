@@ -1,0 +1,24 @@
+"""jaxlint fixture: NEGATIVE for host-sync (path contains `iteration`).
+
+Syncs before/after the loop and inside a nested def (not executed
+per-iteration by virtue of its position) are fine.
+"""
+import numpy as np
+
+
+def drive(rounds, state):
+    for _ in range(rounds):
+        state = state + 1
+    final = np.asarray(state)  # one sync after the loop
+    print(final.sum())
+    return final
+
+
+def helper(state):
+    reads = []
+    for _ in range(3):
+        def read():
+            return np.asarray(state)  # def boundary: not a loop-body sync
+
+        reads.append(read)
+    return reads

@@ -1,0 +1,13 @@
+"""jaxlint fixture: POSITIVE for host-sync (path contains `iteration`).
+
+np.asarray and print inside a round loop: a blocking device readback
+per iteration.
+"""
+import numpy as np
+
+
+def drive(rounds, state):
+    for _ in range(rounds):
+        host = np.asarray(state)  # device -> host sync every round
+        print(host.sum())  # and a host materialization to format it
+    return state

@@ -1,0 +1,15 @@
+"""jaxlint fixture: POSITIVE for host-sync (path contains `iteration`).
+
+.item() in a while-loop convergence check: serializes the dispatch
+pipeline once per round.
+"""
+
+
+def converge(losses, tol):
+    i = 0
+    while i < len(losses):
+        loss = losses[i].item()  # blocking scalar readback per round
+        if loss < tol:
+            break
+        i += 1
+    return i

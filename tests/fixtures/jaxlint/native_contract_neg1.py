@@ -1,0 +1,24 @@
+"""jaxlint fixture: NEGATIVE for native-contract.
+
+The sanctioned shapes: None-checked wrapper results, a direct None
+probe, and a clipped gather behind a bounds assert.
+"""
+import numpy as np
+
+from flink_ml_tpu import native
+
+
+def doc_freqs(mat, u, fallback):
+    df = native.doc_freq_i64(mat, u)
+    if df is None:  # fallback contract honored
+        df = fallback(mat, u)
+    return df
+
+
+def probe(mat, u):
+    return native.rowwise_counts(mat, u) is None
+
+
+def gather(tokens, ints):
+    assert ints.size == 0 or ints.max() < len(tokens)
+    return np.take(tokens, ints, mode="clip")

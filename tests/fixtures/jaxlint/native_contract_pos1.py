@@ -1,0 +1,17 @@
+"""jaxlint fixture: POSITIVE for native-contract.
+
+Fallible native wrappers used without the None fallback check: crashes
+exactly where the native tier is unavailable or a cap trips.
+"""
+import numpy as np
+
+from flink_ml_tpu import native
+
+
+def doc_freqs(mat, u):
+    df = native.doc_freq_i64(mat, u)
+    return df + 1  # df may be None: no fallback guard
+
+
+def term_triples(mat, u):
+    return np.sum(native.rowwise_counts(mat, u)[2])  # inline use

@@ -1,0 +1,16 @@
+"""jaxlint fixture: POSITIVE for native-contract.
+
+np.take(mode='clip') with no bounds assert anywhere in scope: bad
+indices are silently clamped to the last element.
+"""
+import numpy as np
+
+
+def gather(tokens, ints):
+    return np.take(tokens, ints, mode="clip")
+
+
+def gather_chunked(tokens, ints, out):
+    for lo in range(0, len(ints), 8):
+        np.take(tokens, ints[lo:lo + 8], mode="clip", out=out[lo:lo + 8])
+    return out

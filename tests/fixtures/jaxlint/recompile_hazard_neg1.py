@@ -1,0 +1,29 @@
+"""jaxlint fixture: NEGATIVE for recompile-hazard.
+
+The repo idiom: jit built once behind functools.lru_cache, reused from
+the loop; statics receive hashable tuples.
+"""
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=None)
+def _program(shape):
+    def gen(key):
+        return key
+
+    return jax.jit(gen, static_argnums=(1,))
+
+
+def run(xs):
+    prog = _program((8,))
+    out = []
+    for x in xs:
+        out.append(prog(x, 8))  # calling a cached jit in a loop is fine
+    return out
+
+
+def apply(f, x):
+    g = jax.jit(f, static_argnums=(1,))
+    return g(x, (32, 64))  # tuple static: hashable

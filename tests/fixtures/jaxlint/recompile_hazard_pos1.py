@@ -1,0 +1,21 @@
+"""jaxlint fixture: POSITIVE for recompile-hazard.
+
+jax.jit wrapped inside a loop body: a fresh PjitFunction (and compile
+cache key) per iteration.
+"""
+import jax
+
+
+def train(f, xs):
+    total = 0.0
+    for x in xs:
+        total = total + jax.jit(f)(x)  # fresh jit wrapper per iteration
+    return total
+
+
+def poll(f, stream):
+    while True:
+        item = next(stream, None)
+        if item is None:
+            return
+        yield jax.jit(f)(item)

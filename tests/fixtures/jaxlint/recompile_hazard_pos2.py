@@ -1,0 +1,15 @@
+"""jaxlint fixture: POSITIVE for recompile-hazard.
+
+Unhashable values passed for declared static arguments — dies at call
+time, after the trace.
+"""
+import jax
+
+
+def apply(f, x):
+    g = jax.jit(f, static_argnums=(1,))
+    return g(x, [32, 64])  # list static: unhashable cache key
+
+
+def apply_named(f, x):
+    return jax.jit(f, static_argnames=("cfg",))(x, cfg={"depth": 2})

@@ -1,0 +1,27 @@
+"""jaxlint fixture: NEGATIVE for rng-reuse.
+
+Keys split before each draw; loop bodies refresh via fold_in per
+iteration. Nothing may be flagged.
+"""
+import jax
+
+
+def sample(shape):
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)
+    b = jax.random.uniform(k2, shape)
+    return a + b
+
+
+def loop(key, xs):
+    out = []
+    for i, x in enumerate(xs):
+        k = jax.random.fold_in(key, i)  # fresh stream per iteration
+        out.append(x + jax.random.normal(k, x.shape))
+    return out
+
+
+def fan_out(seed, shapes):
+    keys = jax.random.split(jax.random.key(seed), len(shapes))
+    return [jax.random.normal(keys[i], s) for i, s in enumerate(shapes)]

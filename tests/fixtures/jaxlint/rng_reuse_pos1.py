@@ -1,0 +1,13 @@
+"""jaxlint fixture: POSITIVE for rng-reuse.
+
+One key, two draws, no split: the uniform is perfectly correlated with
+the normal.
+"""
+import jax
+
+
+def sample(shape):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # same key, second draw
+    return a + b

@@ -1,0 +1,13 @@
+"""jaxlint fixture: POSITIVE for rng-reuse.
+
+A loop-invariant key drawn inside the loop body: every iteration after
+the first reuses it (identical noise each round).
+"""
+import jax
+
+
+def noisy_updates(key, xs):
+    out = []
+    for x in xs:
+        out.append(x + jax.random.normal(key, x.shape))  # same key/round
+    return out

@@ -1,0 +1,10 @@
+"""jaxlint fixture: a suppression WITHOUT a justification — the rng
+finding is silenced, but the bare disable is itself reported."""
+import jax
+
+
+def sample(shape):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # jaxlint: disable=rng-reuse
+    return a + b

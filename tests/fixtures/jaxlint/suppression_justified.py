@@ -1,0 +1,10 @@
+"""jaxlint fixture: a real finding silenced by a JUSTIFIED suppression —
+analyzes clean (exit 0), with the finding marked suppressed."""
+import jax
+
+
+def sample(shape):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # jaxlint: disable=rng-reuse -- fixture: the correlated draw is the point of this test file
+    return a + b

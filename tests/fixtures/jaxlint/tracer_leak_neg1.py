@@ -1,0 +1,18 @@
+"""jaxlint fixture: NEGATIVE for tracer-leak.
+
+Branches on static args and static attributes (.ndim), host casts on
+len() — all concrete under tracing; none may be flagged.
+"""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scale(x, factor):
+    if factor > 2:  # static argument: concrete
+        x = x * factor
+    if x.ndim == 2:  # shape attributes are static under tracing
+        x = x.sum(axis=0)
+    n = float(len(x))  # len() is the (static) leading dim
+    return x / n

@@ -1,0 +1,21 @@
+"""jaxlint fixture: POSITIVE for tracer-leak (never imported, only parsed).
+
+A Python branch on a traced parameter, and a host cast on a value
+derived from one inside a call-site-jitted local function.
+"""
+import jax
+
+
+@jax.jit
+def step(x, lr):
+    if x > 0:  # branch resolved at trace time
+        return x * lr
+    return x
+
+
+def outer(x):
+    def inner(v):
+        s = v + 1.0
+        return float(s)  # host cast on a traced derivation
+
+    return jax.jit(inner)(x)

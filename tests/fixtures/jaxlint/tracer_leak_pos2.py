@@ -1,0 +1,18 @@
+"""jaxlint fixture: POSITIVE for tracer-leak.
+
+np.* applied to a traced parameter under a partial(jax.jit) decorator;
+the static arg is correctly excluded from taint, so the only finding
+must be the np.asarray call.
+"""
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def normalize(v, mode):
+    arr = np.asarray(v)  # forces host concretization under jit
+    if mode == "l2":  # static: fine
+        return arr
+    return v

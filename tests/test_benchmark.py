@@ -300,3 +300,22 @@ def test_double_generator_device_path(monkeypatch):
                            arity=5)
     v2 = np.asarray(gen2.get_data().column("f0"))
     assert set(np.unique(v2)) <= set(range(5))
+
+
+def test_string_gather_asserts_on_out_of_range_codes():
+    """ADVICE r5 #5: mode='clip' would silently clamp a bad code to the
+    last token — the one-time debug assert must fail loudly instead, for
+    both too-large and negative codes; in-range codes still gather."""
+    import pytest
+
+    from flink_ml_tpu.benchmark.datagen import _string_gather
+
+    tokens = np.array(["a", "bb", "ccc"])
+    good = _string_gather(tokens, np.asarray([[0, 2], [1, 1]]))
+    assert np.array_equal(good, [["a", "ccc"], ["bb", "bb"]])
+    with pytest.raises(AssertionError, match="out of range"):
+        _string_gather(tokens, np.asarray([0, 3]))
+    with pytest.raises(AssertionError, match="out of range"):
+        _string_gather(tokens, np.asarray([-1, 0]))
+    # empty input stays fine (no max() on an empty array)
+    assert _string_gather(tokens, np.zeros((0, 2), np.int64)).size == 0

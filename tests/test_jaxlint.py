@@ -1,0 +1,250 @@
+"""jaxlint: the rules are themselves regression-tested.
+
+Every rule class has synthetic positive fixtures (must fire) and a
+negative fixture (must stay silent) under tests/fixtures/jaxlint/; the
+CLI contract (exit codes, suppression-with-justification) is exercised
+end to end, including a full-package run that must stay clean — the lint
+gate CI enforces (.github/workflows/tests.yml job ``jaxlint``).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from flink_ml_tpu.analysis import (
+    Report,
+    all_rules,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "jaxlint")
+CLI = os.path.join(REPO, "scripts", "jaxlint.py")
+
+#: fixture filename prefix -> rule name
+RULE_OF_PREFIX = {
+    "tracer_leak": "tracer-leak",
+    "recompile_hazard": "recompile-hazard",
+    "rng_reuse": "rng-reuse",
+    "host_sync": "host-sync",
+    "native_contract": "native-contract",
+    "alias_mutation": "alias-mutation",
+}
+
+
+def _fixtures(kind: str):
+    out = []
+    for root, _dirs, files in os.walk(FIXTURES):
+        for name in sorted(files):
+            m = re.match(r"(.+)_(pos|neg)\d+\.py$", name)
+            if m and m.group(2) == kind:
+                out.append((os.path.join(root, name),
+                            RULE_OF_PREFIX[m.group(1)]))
+    return out
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, CLI, *args], cwd=REPO,
+        capture_output=True, text=True, timeout=120)
+
+
+def test_fixture_inventory_covers_all_rules():
+    """>= 2 positive + >= 1 negative fixture per rule class (acceptance
+    criterion), and the registry has exactly the six shipped rules."""
+    assert set(all_rules()) == set(RULE_OF_PREFIX.values())
+    pos, neg = _fixtures("pos"), _fixtures("neg")
+    for rule in RULE_OF_PREFIX.values():
+        assert sum(1 for _, r in pos if r == rule) >= 2, rule
+        assert sum(1 for _, r in neg if r == rule) >= 1, rule
+
+
+@pytest.mark.parametrize("path,rule", _fixtures("pos"),
+                         ids=lambda v: os.path.basename(v)
+                         if isinstance(v, str) and v.endswith(".py") else v)
+def test_positive_fixture_fires(path, rule):
+    hits = [f for f in analyze_file(path)
+            if f.rule == rule and not f.suppressed]
+    assert hits, f"{os.path.basename(path)} produced no {rule} finding"
+
+
+@pytest.mark.parametrize("path,rule", _fixtures("neg"),
+                         ids=lambda v: os.path.basename(v)
+                         if isinstance(v, str) and v.endswith(".py") else v)
+def test_negative_fixture_stays_silent(path, rule):
+    hits = [f for f in analyze_file(path)
+            if f.rule == rule and not f.suppressed]
+    assert not hits, [f.render() for f in hits]
+
+
+@pytest.mark.parametrize("path,rule", _fixtures("pos"),
+                         ids=lambda v: os.path.basename(v)
+                         if isinstance(v, str) and v.endswith(".py") else v)
+def test_cli_exits_nonzero_on_positive_fixture(path, rule):
+    proc = _run_cli(path)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert rule in proc.stdout
+
+
+def test_cli_clean_on_package():
+    """The package itself lints clean, with every suppression justified
+    — the acceptance bar CI holds."""
+    proc = _run_cli("flink_ml_tpu/")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_json_format(tmp_path):
+    out = tmp_path / "report.json"
+    proc = _run_cli(os.path.join(FIXTURES, "rng_reuse_pos1.py"),
+                    "--format", "json", "--output", str(out))
+    assert proc.returncode == 1
+    data = json.loads(out.read_text())
+    assert data["counts"]["unsuppressed"] >= 1
+    (finding,) = [f for f in data["findings"] if f["rule"] == "rng-reuse"]
+    assert finding["line"] > 0 and finding["path"].endswith(".py")
+
+
+def test_cli_rule_subset_and_list():
+    proc = _run_cli(os.path.join(FIXTURES, "rng_reuse_pos1.py"),
+                    "--rules", "tracer-leak")
+    assert proc.returncode == 0  # the rng finding is outside the subset
+    listing = _run_cli("--list-rules")
+    assert listing.returncode == 0
+    for rule in all_rules().values():
+        assert rule.code in listing.stdout
+
+
+# -- suppression contract ----------------------------------------------------
+def test_justified_suppression_silences_and_records():
+    path = os.path.join(FIXTURES, "suppression_justified.py")
+    findings = analyze_file(path)
+    assert all(f.suppressed for f in findings), \
+        [f.render() for f in findings if not f.suppressed]
+    (rng,) = [f for f in findings if f.rule == "rng-reuse"]
+    assert "correlated draw is the point" in rng.justification
+    assert Report(findings).exit_code == 0
+
+
+def test_bare_suppression_is_itself_a_finding():
+    path = os.path.join(FIXTURES, "suppression_bare.py")
+    findings = analyze_file(path)
+    assert any(f.rule == "bare-suppression" and not f.suppressed
+               for f in findings)
+    # the rng finding IS silenced — the bare disable is what fails the run
+    assert all(f.suppressed for f in findings if f.rule == "rng-reuse")
+    assert Report(findings).exit_code == 1
+
+
+def test_unknown_rule_in_disable_is_reported():
+    findings = analyze_source(
+        "x = 1  # jaxlint: disable=no-such-rule -- oops\n")
+    assert [f.rule for f in findings] == ["unknown-rule"]
+
+
+def test_suppression_only_matches_its_rule_and_line():
+    src = (
+        "import jax\n"
+        "def f(shape):\n"
+        "    key = jax.random.PRNGKey(0)\n"
+        "    a = jax.random.normal(key, shape)\n"
+        "    b = jax.random.uniform(key, shape)"
+        "  # jaxlint: disable=tracer-leak -- wrong rule on purpose\n"
+        "    c = jax.random.normal(key, shape)\n"
+        "    return a + b + c\n")
+    findings = analyze_source(src)
+    reuse = [f for f in findings if f.rule == "rng-reuse"]
+    assert len(reuse) == 2 and not any(f.suppressed for f in reuse)
+
+
+def test_unused_suppression_is_reported_except_on_subset_runs():
+    src = "x = 1  # jaxlint: disable=rng-reuse -- hazard was removed\n"
+    findings = analyze_source(src)
+    assert [f.rule for f in findings] == ["unused-suppression"]
+    # a subset run must not call suppressions for non-running rules stale
+    assert analyze_source(src, rules=["tracer-leak"]) == []
+
+
+def test_disable_example_in_docstring_is_not_a_suppression():
+    src = ('"""Docs: write `# jaxlint: disable=rng-reuse -- why` '
+           'to suppress."""\nx = 1\n')
+    assert analyze_source(src) == []
+
+
+def test_parse_error_is_a_finding():
+    findings = analyze_source("def broken(:\n", path="bad.py")
+    assert [f.rule for f in findings] == ["parse-error"]
+    assert Report(findings).exit_code == 1
+
+
+# -- analyzer behaviors worth pinning beyond the fixtures --------------------
+def test_taint_flows_through_assignment_and_rebinding_clears():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    y = x * 2\n"
+        "    z = np.asarray(y)\n"       # derived from x: finding
+        "    y = 3.0\n"                 # rebound to a host constant
+        "    w = np.asarray(y)\n"       # no longer traced: clean
+        "    return z, w\n")
+    lines = [f.line for f in analyze_source(src) if f.rule == "tracer-leak"]
+    assert lines == [6]
+
+
+def test_rng_branch_merge_is_conservative():
+    src = (
+        "import jax\n"
+        "def f(key, flag, shape):\n"
+        "    if flag:\n"
+        "        a = jax.random.normal(key, shape)\n"
+        "    else:\n"
+        "        a = jax.random.uniform(key, shape)\n"  # other branch: ok
+        "    b = jax.random.normal(key, shape)\n"       # reuse either way
+        "    return a + b\n")
+    hits = [f.line for f in analyze_source(src) if f.rule == "rng-reuse"]
+    assert hits == [7]
+
+
+def test_alias_rule_is_forward_and_rebind_sensitive():
+    src = (
+        "def f(t):\n"
+        "    c = [0]\n"
+        "    c[0] = 1\n"              # before any view exists: clean
+        "    view = t.head(4)\n"
+        "    c = view.column('x')\n"
+        "    c[0] = 1\n"              # through the view: finding
+        "    c = c * 2\n"             # rebound to an owned array
+        "    c[0] = 1\n"              # clean again
+        "    d = view['y']\n"
+        "    d += 1\n"                # in-place augassign on a column
+        "    return c\n")
+    lines = [f.line for f in analyze_source(src)
+             if f.rule == "alias-mutation"]
+    assert lines == [6, 10]
+
+
+def test_clip_take_needs_an_assert_about_the_indices():
+    body = "    return np.take(tokens, idx, mode='clip')\n"
+    flagged = ("import numpy as np\n"
+               "def f(tokens, idx, n):\n"
+               "    assert n > 0\n" + body)  # unrelated precondition
+    clean = ("import numpy as np\n"
+             "def f(tokens, idx):\n"
+             "    assert idx.max() < len(tokens)\n" + body)
+    assert any(f.rule == "native-contract"
+               for f in analyze_source(flagged))
+    assert not any(f.rule == "native-contract"
+                   for f in analyze_source(clean))
+
+
+def test_analyze_paths_walks_directories():
+    findings = analyze_paths([FIXTURES])
+    rules_seen = {f.rule for f in findings}
+    assert set(RULE_OF_PREFIX.values()) <= rules_seen

@@ -275,3 +275,63 @@ def test_rowwise_counts_matches_python_engines():
     # domain beyond the cap falls back to python (returns None)
     assert native.rowwise_counts(
         np.zeros((2, 2), np.uint8), native.ROWWISE_DOMAIN_CAP + 1) is None
+
+
+def test_doc_freq_i64_out_of_range_falls_back():
+    """ADVICE r5 #1: codes outside [0, u) must NOT be silent heap
+    corruption — the kernel bounds-checks and the wrapper returns None so
+    callers fall back to the (IndexError-raising) python engines."""
+    from flink_ml_tpu import native
+
+    if not native.available():
+        pytest.skip("native tier unavailable")
+    assert native.doc_freq_i64(np.asarray([[0, 5]], np.int64), 3) is None
+    assert native.doc_freq_i64(np.asarray([[-1, 0]], np.int64), 3) is None
+    # in-range still works after the guard
+    np.testing.assert_array_equal(
+        native.doc_freq_i64(np.asarray([[0, 1], [2, 1]], np.int64), 3),
+        [1, 2, 1])
+
+
+def test_doc_freq_i64_domain_cap_falls_back():
+    """ADVICE r5 #2: a mostly-distinct corpus (u ~ rows*w) must not
+    allocate an 8*u-byte stamp per forked worker — above the shared
+    ROWWISE_DOMAIN_CAP the wrapper returns None and the chunked python
+    engines bound memory."""
+    from flink_ml_tpu import native
+
+    if not native.available():
+        pytest.skip("native tier unavailable")
+    mat = np.asarray([[0, 1]], np.int64)
+    assert native.doc_freq_i64(mat, native.ROWWISE_DOMAIN_CAP + 1) is None
+    assert native.doc_freq_i64(mat, 0) is None  # empty domain: fallback
+    assert native.doc_freq_i64(mat, native.ROWWISE_DOMAIN_CAP // 2 + 2) \
+        is not None
+
+
+def test_rowwise_counts_out_of_range_falls_back():
+    """Same guard for the rowwise counter, across the narrow dtypes."""
+    from flink_ml_tpu import native
+
+    if not native.available():
+        pytest.skip("native tier unavailable")
+    assert native.rowwise_counts(np.asarray([[9]], np.uint8), 4) is None
+    assert native.rowwise_counts(np.asarray([[-2]], np.int64), 4) is None
+    got = native.rowwise_counts(np.asarray([[3, 3, 1]], np.uint16), 4)
+    np.testing.assert_array_equal(got[1], [1, 3])
+    np.testing.assert_array_equal(got[2], [1, 2])
+
+
+def test_cv_fit_survives_corrupt_codes_via_fallback(monkeypatch):
+    """End to end: if the native df kernel rejects (simulated by forcing
+    None), the CountVectorizer fit still produces the right vocabulary
+    through the python engines."""
+    from flink_ml_tpu import native
+    from flink_ml_tpu.models.feature.text import CountVectorizer
+
+    docs = np.asarray([["a", "b", "a"], ["b", "b", "c"], ["a", "c", "c"]])
+    t = Table.from_columns(doc=docs)
+    want = CountVectorizer(input_col="doc").fit(t).vocabulary
+    monkeypatch.setattr(native, "doc_freq_i64", lambda *a, **k: None)
+    got = CountVectorizer(input_col="doc").fit(t).vocabulary
+    assert got == want
