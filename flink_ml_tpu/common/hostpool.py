@@ -25,21 +25,45 @@ Why raw ``os.fork`` and not multiprocessing:
 Failure semantics: any worker that dies (non-zero exit, unpicklable
 result, crash) fails the whole map with the worker's traceback; callers
 fall back to their serial path only via ``min_rows`` gating, never on
-silent partial results.
+silent partial results.  A worker that *wedges* (never writes, never
+exits) is SIGKILLed once its per-child deadline expires and the map
+fails with a retryable :class:`~flink_ml_tpu.resilience.policy.
+WorkerTimeout` naming the worker — a hung child must never hang the
+driver (docs/resilience.md).
 """
 
 import io
 import os
 import pickle
+import signal
 import struct
+import time
 import traceback
 
 import numpy as np
 
-__all__ = ["host_parallelism", "map_row_shards", "shard_bounds"]
+from flink_ml_tpu.resilience import faults
+from flink_ml_tpu.resilience.policy import InjectedFault, WorkerTimeout
+
+__all__ = ["host_parallelism", "map_row_shards", "shard_bounds",
+           "child_deadline_s"]
 
 #: result-stream framing: u8 status (0 ok / 1 error), u64 payload length
 _HDR = struct.Struct("<BQ")
+
+
+def child_deadline_s() -> float:
+    """Per-child wall deadline for forked workers. Default 600s — far
+    above any sane shard (shards are ≤ SHARD_CAP_ROWS) yet finite, so a
+    wedged child is killed instead of hanging the driver forever.
+    Override with FLINK_ML_TPU_HOST_TIMEOUT_S (<= 0 disables)."""
+    env = os.environ.get("FLINK_ML_TPU_HOST_TIMEOUT_S")
+    if env is not None:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    return 600.0
 
 
 def host_parallelism() -> int:
@@ -66,9 +90,20 @@ def shard_bounds(n_rows: int, workers: int):
     return bounds
 
 
-def _child_main(fn, lo, hi, wfd):
+def _child_main(fn, lo, hi, wfd, chaos_action=None):
     status, payload = 0, None
     try:
+        if chaos_action is not None:
+            # decided in the PARENT pre-fork so the schedule counter
+            # survives; the child only acts it out, reporting the real
+            # scheduled call number so failures correlate with the plan
+            kind, count = chaos_action
+            if kind == "hang":
+                # injected wedge: exercises the deadline/SIGKILL path
+                while True:
+                    time.sleep(3600)
+            raise InjectedFault("hostpool-child", count,
+                                {"rows": (lo, hi)})
         payload = pickle.dumps(fn(lo, hi), protocol=pickle.HIGHEST_PROTOCOL)
     except BaseException:  # noqa: BLE001 — report the traceback, then _exit
         status = 1
@@ -92,7 +127,8 @@ SHARD_CAP_ROWS = 1 << 20
 
 def map_row_shards(fn, n_rows: int, *, workers: int = None,
                    min_rows: int = 1 << 17,
-                   shard_cap: int = SHARD_CAP_ROWS):
+                   shard_cap: int = SHARD_CAP_ROWS,
+                   timeout_s: float = None):
     """Run ``fn(lo, hi)`` over even row shards of ``[0, n_rows)`` in
     forked workers — a sliding window with at most ``workers`` live
     children, refilled as each finishes (no end-of-wave barrier); return
@@ -105,6 +141,10 @@ def map_row_shards(fn, n_rows: int, *, workers: int = None,
     needs; fork shares them copy-on-write.  Small inputs (below
     ``min_rows``), a single worker, or a platform without fork run the
     shards inline in the parent — so callers need exactly one code path.
+
+    ``timeout_s`` is the per-child deadline (None → ``child_deadline_s``
+    env default; <= 0 disables): a child past it is SIGKILLed and the map
+    raises a retryable :class:`WorkerTimeout` naming the worker.
     """
     workers = host_parallelism() if workers is None else workers
     small = n_rows < max(min_rows, 2)
@@ -114,20 +154,24 @@ def map_row_shards(fn, n_rows: int, *, workers: int = None,
     shards = shard_bounds(n_rows, max(1, n_shards))
     if workers <= 1 or small or not hasattr(os, "fork"):
         return [fn(lo, hi) for lo, hi in shards]
-    return _fork_sliding(fn, shards, workers)
+    if timeout_s is None:
+        timeout_s = child_deadline_s()
+    return _fork_sliding(fn, shards, workers, timeout_s)
 
 
 class _Child:
-    """One forked worker: pid, shard index, reader and an incremental
-    payload buffer (children stream results while others still run)."""
+    """One forked worker: pid, shard index, reader, an incremental
+    payload buffer (children stream results while others still run) and
+    the wall deadline after which the parent gives up on it."""
 
-    __slots__ = ("pid", "idx", "reader", "buf", "header")
+    __slots__ = ("pid", "idx", "reader", "buf", "header", "deadline")
 
-    def __init__(self, pid, idx, rfd):
+    def __init__(self, pid, idx, rfd, deadline):
         self.pid, self.idx = pid, idx
         self.reader = io.FileIO(rfd, "r")
         self.buf = bytearray()
         self.header = None  # (status, length) once parsed
+        self.deadline = deadline  # monotonic seconds, or None
 
 
 def _finalize(child):
@@ -145,11 +189,31 @@ def _finalize(child):
     return pickle.loads(payload)
 
 
-def _fork_sliding(fn, shards, workers):
+def _reap(pid, grace_s: float = 5.0) -> None:
+    """waitpid with a bounded grace period: a child that closed its pipe
+    but never exits gets SIGKILLed instead of blocking the driver."""
+    end = time.monotonic() + grace_s
+    while True:
+        done, _ = os.waitpid(pid, os.WNOHANG)
+        if done:
+            return
+        if time.monotonic() >= end:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            os.waitpid(pid, 0)
+            return
+        time.sleep(0.01)
+
+
+def _fork_sliding(fn, shards, workers, timeout_s=None):
     """Sliding-window scheduler: at most ``workers`` live children; as
     each child's stream closes it is reaped and the next shard forks —
     no end-of-wave barrier idling workers when len(shards) is not a
-    multiple of ``workers``. Results return in shard order."""
+    multiple of ``workers``. Results return in shard order. Each child
+    carries a wall deadline (``timeout_s``); the select loop wakes at the
+    earliest one and a child past it is SIGKILLed → WorkerTimeout."""
     import selectors
 
     sel = selectors.DefaultSelector()
@@ -157,29 +221,67 @@ def _fork_sliding(fn, shards, workers):
     results = [None] * len(shards)
     next_shard = 0
     forked_pids, reaped = [], set()
+    bounded = timeout_s is not None and timeout_s > 0
 
     def fork_next():
         nonlocal next_shard
         lo, hi = shards[next_shard]
+        # chaos decisions happen PRE-fork in the parent: the schedule
+        # counter must advance in the surviving process, and the child
+        # merely performs the chosen action
+        chaos_action = None
+        crash_count = faults.decide("hostpool-child")
+        if crash_count:
+            chaos_action = ("crash", crash_count)
+        else:
+            hang_count = faults.decide("hostpool-hang")
+            if hang_count:
+                chaos_action = ("hang", hang_count)
         rfd, wfd = os.pipe()
         pid = os.fork()
         if pid == 0:  # child: never returns
             os.close(rfd)
             for other_fd in list(live):
                 os.close(other_fd)
-            _child_main(fn, lo, hi, wfd)
+            _child_main(fn, lo, hi, wfd, chaos_action)
         os.close(wfd)
-        child = _Child(pid, next_shard, rfd)
+        deadline = time.monotonic() + timeout_s if bounded else None
+        child = _Child(pid, next_shard, rfd, deadline)
         live[rfd] = child
         sel.register(child.reader, selectors.EVENT_READ, child)
         forked_pids.append(pid)
         next_shard += 1
 
+    def kill_expired():
+        now = time.monotonic()
+        for child in live.values():
+            if child.deadline is not None and now >= child.deadline:
+                try:
+                    os.kill(child.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                os.waitpid(child.pid, 0)
+                reaped.add(child.pid)
+                lo, hi = shards[child.idx]
+                raise WorkerTimeout(child.idx, timeout_s, rows=(lo, hi))
+
     try:
         while next_shard < len(shards) and len(live) < workers:
             fork_next()
         while live:
-            for key, _ in sel.select():
+            wait = None
+            if bounded:
+                wait = max(0.0, min(c.deadline for c in live.values())
+                           - time.monotonic())
+            ready = sel.select(wait)
+            # enforce deadlines EVERY iteration: busy siblings keep
+            # select() returning early, and only checking on an empty
+            # select would let a wedged child outlive its deadline for
+            # as long as the others keep streaming
+            kill_expired()
+            if not ready:
+                continue
+            for key, _ in ready:
                 child = key.data
                 chunk = child.reader.read(1 << 20)
                 if chunk:
@@ -193,7 +295,7 @@ def _fork_sliding(fn, shards, workers):
                 sel.unregister(child.reader)
                 del live[child.reader.fileno()]
                 child.reader.close()
-                os.waitpid(child.pid, 0)
+                _reap(child.pid)
                 reaped.add(child.pid)
                 results[child.idx] = _finalize(child)
                 if next_shard < len(shards):
@@ -201,8 +303,10 @@ def _fork_sliding(fn, shards, workers):
         return results
     finally:
         # close pipes first (a worker blocked on a full pipe gets EPIPE
-        # and exits), then reap every un-waited child so an error path
-        # leaves no zombies behind
+        # and exits), then SIGKILL + reap every un-waited child — on the
+        # WorkerTimeout path some siblings may themselves be wedged, and
+        # a plain waitpid on one of those would hang the very teardown
+        # that exists to prevent hangs
         for child in live.values():
             try:
                 sel.unregister(child.reader)
@@ -215,6 +319,6 @@ def _fork_sliding(fn, shards, workers):
         for pid in forked_pids:
             if pid not in reaped:
                 try:
-                    os.waitpid(pid, 0)
+                    _reap(pid, grace_s=1.0)
                 except ChildProcessError:
                     pass

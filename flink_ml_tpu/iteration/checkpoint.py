@@ -8,21 +8,66 @@ records: a checkpoint is an atomic snapshot of (carry pytree, epoch) taken
 between rounds, so the whole subsystem reduces to serializing a pytree.
 
 Format: one directory per checkpoint, numpy arrays + a treedef manifest.
+The manifest (version 2) records per-leaf sha256 digests, dtypes and
+shapes; files are fsynced before the atomic rename publishes the
+directory, so a torn write cannot masquerade as a valid checkpoint.
 Restore rebuilds arrays onto the template carry's shardings, so resume
 works on the same mesh topology (same-parallelism restore — the reference
 has exactly the same restriction, ReplayOperator.java:163).
+
+Failure behavior (docs/resilience.md): ``restore()`` validates the
+newest checkpoint against its manifest and, on ANY corruption (missing
+or unreadable manifest/leaves, digest mismatch, dtype/shape drift,
+leaf-count mismatch), quarantines the directory as ``ckpt-*.corrupt``
+and falls back to the next-older checkpoint — never raising mid-recovery.
+No surviving checkpoint means a fresh start (returns None).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
 import shutil
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
 import jax
+
+from flink_ml_tpu.resilience import faults
+
+logger = logging.getLogger(__name__)
+
+#: manifest schema: 1 = epoch + num_leaves only (legacy, still
+#: restorable); 2 = adds per-leaf {sha256, dtype, shape} integrity records
+MANIFEST_VERSION = 2
+
+
+class CorruptCheckpoint(Exception):
+    """Internal: a checkpoint directory failed integrity validation.
+    Never escapes ``restore()`` — it routes to quarantine + fallback."""
+
+
+def _leaf_digest(arr: np.ndarray) -> Optional[str]:
+    if arr.dtype == object:  # pointer bytes are not content — no digest
+        return None
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def _fsync_path(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # e.g. a filesystem that won't open directories
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # fsync unsupported here: durability is best-effort, the
+        # digests still catch a torn write on restore
+    finally:
+        os.close(fd)
 
 
 class CheckpointManager:
@@ -32,22 +77,45 @@ class CheckpointManager:
         self.base_dir = base_dir
         self.keep = keep
         os.makedirs(base_dir, exist_ok=True)
+        # a crash between makedirs and the atomic rename strands a
+        # ckpt-*.tmp dir; left alone they accumulate forever
+        self.sweep_orphans()
 
     # -- write ---------------------------------------------------------------
     def save(self, carry: Any, epoch: int) -> str:
+        faults.inject("checkpoint-save", epoch=epoch)
         leaves, treedef = jax.tree_util.tree_flatten(carry)
         ckpt_dir = os.path.join(self.base_dir, f"ckpt-{epoch:08d}")
         tmp_dir = ckpt_dir + ".tmp"
         os.makedirs(tmp_dir, exist_ok=True)
         host_leaves = [np.asarray(x) for x in leaves]
-        np.savez(os.path.join(tmp_dir, "leaves.npz"),
+        leaves_path = os.path.join(tmp_dir, "leaves.npz")
+        np.savez(leaves_path,
                  **{f"leaf_{i}": x for i, x in enumerate(host_leaves)})
-        with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
-            json.dump({"epoch": epoch, "num_leaves": len(leaves)}, f)
+        manifest = {
+            "version": MANIFEST_VERSION,
+            "epoch": epoch,
+            "num_leaves": len(leaves),
+            "leaves": [{"sha256": _leaf_digest(x),
+                        "dtype": str(x.dtype),
+                        "shape": list(x.shape)} for x in host_leaves],
+        }
+        manifest_path = os.path.join(tmp_dir, "manifest.json")
+        with open(manifest_path, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # fsync data before the rename: the atomic publish must never
+        # expose a directory whose contents still live in the page cache
+        # only (a power cut would produce exactly the torn checkpoint the
+        # digests exist to catch — cheaper to not write one)
+        _fsync_path(leaves_path)
+        faults.inject("checkpoint-publish", epoch=epoch)
         # atomic publish: rename makes partially-written checkpoints invisible
         if os.path.exists(ckpt_dir):
             shutil.rmtree(ckpt_dir)
         os.rename(tmp_dir, ckpt_dir)
+        _fsync_path(self.base_dir)  # persist the directory entry itself
         self._gc()
         return ckpt_dir
 
@@ -57,6 +125,18 @@ class CheckpointManager:
             shutil.rmtree(os.path.join(self.base_dir, name),
                           ignore_errors=True)
 
+    def sweep_orphans(self) -> int:
+        """Remove stranded ``ckpt-*.tmp`` dirs (a crash mid-save);
+        returns how many were swept. Quarantined ``*.corrupt`` dirs are
+        kept — they are forensic evidence, not debris."""
+        swept = 0
+        for name in os.listdir(self.base_dir):
+            if name.startswith("ckpt-") and name.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self.base_dir, name),
+                              ignore_errors=True)
+                swept += 1
+        return swept
+
     def _gc(self) -> None:
         ckpts = self.list_checkpoints()
         for stale in ckpts[:-self.keep]:
@@ -65,27 +145,103 @@ class CheckpointManager:
     # -- read ----------------------------------------------------------------
     def list_checkpoints(self):
         return sorted(d for d in os.listdir(self.base_dir)
-                      if d.startswith("ckpt-") and not d.endswith(".tmp"))
+                      if d.startswith("ckpt-") and d[len("ckpt-"):].isdigit())
+
+    def _quarantine(self, ckpt_dir: str, reason: str) -> None:
+        target = ckpt_dir + ".corrupt"
+        n = 0
+        while os.path.exists(target):
+            n += 1
+            target = f"{ckpt_dir}.corrupt{n}"
+        try:
+            os.rename(ckpt_dir, target)
+        except OSError:  # already gone / unrenameable: drop it instead
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+            target = "<removed>"
+        logger.warning(
+            "corrupt checkpoint %s quarantined as %s (%s); falling back "
+            "to the next-older checkpoint", ckpt_dir, target, reason)
+
+    def _load_validated(self, ckpt_dir: str, expected_leaves: int
+                        ) -> Tuple[List[np.ndarray], int]:
+        """(host leaves, epoch) of one checkpoint dir, or raise
+        :class:`CorruptCheckpoint` describing what failed validation.
+        ANY unexpected exception during validation is itself corruption
+        evidence (a manifest mangled into the wrong JSON shape raises
+        AttributeError/KeyError, not json errors) — the recovery path
+        must never crash on a bad checkpoint, only skip it."""
+        try:
+            return self._validate(ckpt_dir, expected_leaves)
+        except CorruptCheckpoint:
+            raise
+        except Exception as e:  # noqa: BLE001 — see docstring
+            raise CorruptCheckpoint(
+                f"validation failed: {type(e).__name__}: {e}") from e
+
+    def _validate(self, ckpt_dir: str, expected_leaves: int
+                  ) -> Tuple[List[np.ndarray], int]:
+        try:
+            with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise CorruptCheckpoint(f"manifest unreadable: {e}") from e
+        num = manifest.get("num_leaves")
+        if not isinstance(num, int):
+            raise CorruptCheckpoint("manifest lacks num_leaves")
+        if num != expected_leaves:
+            # an incompatible snapshot takes the same fallback path as a
+            # failed digest; quarantine renames, never deletes — if EVERY
+            # checkpoint trips this, the template (not the data) changed,
+            # and the dirs can be renamed back by hand
+            raise CorruptCheckpoint(
+                f"checkpoint has {num} leaves, template has "
+                f"{expected_leaves} (a mismatch on every checkpoint "
+                "means the template/config changed, not the data)")
+        records = manifest.get("leaves")
+        try:
+            with np.load(os.path.join(ckpt_dir, "leaves.npz")) as z:
+                host_leaves = [z[f"leaf_{i}"] for i in range(num)]
+        except Exception as e:  # noqa: BLE001 — BadZipFile, KeyError,
+            # OSError, truncated-stream ValueError: all mean "unreadable"
+            raise CorruptCheckpoint(f"leaves unreadable: {e}") from e
+        if records is not None:  # version >= 2: verify integrity records
+            if len(records) != num:
+                raise CorruptCheckpoint("manifest leaf records truncated")
+            for i, (arr, rec) in enumerate(zip(host_leaves, records)):
+                if (rec.get("dtype") is not None
+                        and str(arr.dtype) != rec["dtype"]):
+                    raise CorruptCheckpoint(
+                        f"leaf_{i} dtype {arr.dtype} != manifest "
+                        f"{rec['dtype']}")
+                if (rec.get("shape") is not None
+                        and list(arr.shape) != list(rec["shape"])):
+                    raise CorruptCheckpoint(
+                        f"leaf_{i} shape {list(arr.shape)} != manifest "
+                        f"{rec['shape']}")
+                want = rec.get("sha256")
+                if want is not None and _leaf_digest(arr) != want:
+                    raise CorruptCheckpoint(f"leaf_{i} sha256 mismatch")
+        return host_leaves, manifest["epoch"]
 
     def restore(self, template_carry: Any) -> Optional[Tuple[Any, int]]:
-        """Latest checkpoint restored onto the template's structure and
-        shardings; None if no checkpoint exists."""
-        ckpts = self.list_checkpoints()
-        if not ckpts:
-            return None
-        ckpt_dir = os.path.join(self.base_dir, ckpts[-1])
-        with open(os.path.join(ckpt_dir, "manifest.json")) as f:
-            manifest = json.load(f)
-        with np.load(os.path.join(ckpt_dir, "leaves.npz")) as z:
-            host_leaves = [z[f"leaf_{i}"] for i in range(manifest["num_leaves"])]
+        """Newest checkpoint that passes integrity validation, restored
+        onto the template's structure and shardings; corrupt checkpoints
+        are quarantined (``ckpt-*.corrupt``) and skipped in favor of the
+        next-older one. None if no valid checkpoint exists."""
         t_leaves, treedef = jax.tree_util.tree_flatten(template_carry)
-        if len(t_leaves) != len(host_leaves):
-            raise ValueError(
-                f"checkpoint has {len(host_leaves)} leaves, template has {len(t_leaves)}")
-        restored = []
-        for host, tmpl in zip(host_leaves, t_leaves):
-            if hasattr(tmpl, "sharding"):
-                restored.append(jax.device_put(host, tmpl.sharding))
-            else:
-                restored.append(host)
-        return jax.tree_util.tree_unflatten(treedef, restored), manifest["epoch"]
+        for name in reversed(self.list_checkpoints()):
+            ckpt_dir = os.path.join(self.base_dir, name)
+            try:
+                host_leaves, epoch = self._load_validated(
+                    ckpt_dir, len(t_leaves))
+            except CorruptCheckpoint as e:
+                self._quarantine(ckpt_dir, str(e))
+                continue
+            restored = []
+            for host, tmpl in zip(host_leaves, t_leaves):
+                if hasattr(tmpl, "sharding"):
+                    restored.append(jax.device_put(host, tmpl.sharding))
+                else:
+                    restored.append(host)
+            return jax.tree_util.tree_unflatten(treedef, restored), epoch
+        return None

@@ -23,6 +23,8 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from flink_ml_tpu.resilience import faults
+
 Carry = Any
 Body = Callable[[Carry, jnp.ndarray], Carry]
 Terminate = Callable[[Carry, jnp.ndarray], jnp.ndarray]  # -> bool scalar
@@ -54,13 +56,23 @@ class IterationConfig:
 
 
 class IterationListener:
-    """Ref: iteration/IterationListener.java."""
+    """Ref: iteration/IterationListener.java, extended with the restart/
+    recovery events the reference gets from Flink's restart strategy
+    (emitted by resilience.supervisor.run_supervised, not by the
+    iteration drivers themselves)."""
 
     def on_epoch_watermark_incremented(self, epoch: int, carry: Carry) -> None:
         pass
 
     def on_iteration_terminated(self, carry: Carry) -> None:
         pass
+
+    def on_restart(self, attempt: int, error: BaseException) -> None:
+        """A supervised run failed retryably; restart ``attempt`` (1-based)
+        is about to re-enter from the newest valid checkpoint."""
+
+    def on_recovered(self, attempt: int) -> None:
+        """A supervised run completed after ``attempt`` restart(s)."""
 
 
 def iterate_bounded(initial_carry: Carry,
@@ -163,6 +175,8 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
         carry, e, s = run_segment(carry, epoch, limit)
         rounds = int(e) - epoch
         epoch, stop = int(e), bool(s)
+        # chaos site: the segment boundary is this mode's epoch boundary
+        faults.inject("epoch-boundary", epoch=epoch)
         if epoch % K == 0:
             mgr.save(carry, epoch)
         # per-segment metrics: the host-sync boundary is already here, so
@@ -280,6 +294,7 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
             carry = config.per_round_init(carry, epoch)
         carry, stop = round_fn(
             carry, jnp.int32(epoch) if jit_round else epoch)
+        faults.inject("epoch-boundary", epoch=epoch)
         # listeners/checkpoints run while the async-dispatched device round
         # is still executing — host and device legs overlap
         host_start = _time.perf_counter()

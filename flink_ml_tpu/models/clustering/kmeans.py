@@ -278,6 +278,9 @@ class KMeansModel(Model, KMeansModelParams):
 
 class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
     def fit(self, table: Table) -> KMeansModel:
+        return self._supervised_fit(lambda: self._fit_once(table))
+
+    def _fit_once(self, table: Table) -> KMeansModel:
         x = table.vectors(self.features_col)
         n, dim = x.shape
         k = self.k

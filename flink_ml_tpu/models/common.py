@@ -151,11 +151,33 @@ class IterationRuntimeMixin:
 
     _iteration_config = None
     _iteration_listeners = ()
+    _retry_policy = None
 
     def set_iteration_config(self, config, listeners=()):
         self._iteration_config = config
         self._iteration_listeners = tuple(listeners)
         return self
+
+    def set_retry_policy(self, policy):
+        """Run ``.fit`` under resilience supervision: retryable failures
+        (worker timeouts, injected faults, I/O errors) restart the fit,
+        which resumes from the newest checkpoint that passes integrity
+        validation when a CheckpointManager is configured. Ref: Flink's
+        per-job RestartStrategies — a runtime setting, not a Param."""
+        self._retry_policy = policy
+        return self
+
+    def _supervised_fit(self, fit_once):
+        """Route a zero-arg fit thunk through run_supervised when a
+        retry policy is set; plain call otherwise (zero overhead)."""
+        if self._retry_policy is None:
+            return fit_once()
+        from flink_ml_tpu.resilience.supervisor import run_supervised
+        cfg = self._iteration_config
+        mgr = cfg.checkpoint_manager if cfg is not None else None
+        return run_supervised(fit_once, mgr=mgr,
+                              policy=self._retry_policy,
+                              listeners=self._iteration_listeners)
 
 
 class LinearEstimatorBase(Estimator, LinearTrainParams,
@@ -167,6 +189,9 @@ class LinearEstimatorBase(Estimator, LinearTrainParams,
     model_class = None
 
     def fit(self, table: Table):
+        return self._supervised_fit(lambda: self._fit_once(table))
+
+    def _fit_once(self, table: Table):
         from flink_ml_tpu.linalg import sparse
         x, y, w = extract_labeled_points(self, table)
         params = SGDParams(

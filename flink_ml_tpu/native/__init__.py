@@ -20,6 +20,8 @@ from typing import Optional
 
 import numpy as np
 
+from flink_ml_tpu.resilience import faults
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = sorted(
     os.path.join(_DIR, f) for f in os.listdir(_DIR) if f.endswith(".cpp"))
@@ -130,6 +132,7 @@ def swing_similarity(user_items: np.ndarray, user_offsets: np.ndarray,
     """Native Swing scoring. Returns (out_items (n_items, k),
     out_scores (n_items, k), out_counts (n_items,)); raises RuntimeError
     if the native library is unavailable."""
+    faults.inject("native-kernel", kernel="swing_similarity")
     lib = _get_lib()
     if lib is None:
         raise RuntimeError("native kernels unavailable (g++ build failed)")
@@ -161,6 +164,7 @@ def csv_parse_numeric(data: bytes, n_cols: int, delimiter: str = ","):
     """Native all-numeric CSV parse → (n_rows, n_cols) float64 array, or
     None when the buffer isn't purely numeric (caller falls back) or the
     native library is unavailable."""
+    faults.inject("native-kernel", kernel="csv_parse_numeric")
     lib = _get_lib()
     if lib is None:
         return None
@@ -187,6 +191,7 @@ def factorize_i64(keys: np.ndarray):
     appearance order, or None when the native tier is unavailable or the
     distinct count exceeds FACTORIZE_UNIQ_CAP (callers fall back to
     pandas/np.unique)."""
+    faults.inject("native-kernel", kernel="factorize_i64")
     lib = _get_lib()
     if lib is None:
         return None
@@ -217,6 +222,7 @@ def doc_freq_i64(codes_mat: np.ndarray, u: int):
     rows*w) would otherwise allocate gigabytes across the host pool on
     exactly the degenerate vocabularies the chunked python engines were
     built to survive."""
+    faults.inject("native-kernel", kernel="doc_freq_i64")
     if u <= 0 or u > ROWWISE_DOMAIN_CAP:
         return None
     lib = _get_lib()
@@ -247,6 +253,7 @@ def rowwise_counts(codes_mat: np.ndarray, u: int,
     unavailable, the dtype has no kernel variant, or the domain exceeds
     ROWWISE_DOMAIN_CAP (callers keep their python engines). Values come
     back int64; rows ascend, values ascend within each row."""
+    faults.inject("native-kernel", kernel="rowwise_counts")
     lib = _get_lib()
     if lib is None or u <= 0 or u > ROWWISE_DOMAIN_CAP:
         return None

@@ -1,0 +1,114 @@
+"""Supervised execution: retry-with-backoff around a checkpointed fit.
+
+``run_supervised(fn, mgr, policy)`` wraps any checkpoint-aware unit of
+work — an estimator ``.fit`` configured with a ``CheckpointManager``,
+or a bare ``run_segmented`` driver — and re-enters it after retryable
+failures. Recovery is delegated to the checkpoint layer: on re-entry the
+iteration's own restore path loads the newest checkpoint that passes
+integrity validation (iteration/checkpoint.py quarantines corrupt
+snapshots and falls back to older ones), so the supervisor only needs to
+classify, back off, sweep crash debris and try again.
+
+Ref parity: Flink's fixed-delay restart strategy + JobManager-driven
+restore (SURVEY §5) — the loop the reference gets from its runtime.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional, Sequence
+
+from flink_ml_tpu.resilience.policy import (
+    TERMINAL,
+    RestartsExhausted,
+    RetryPolicy,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _notify(listeners: Sequence, event: str, *args) -> None:
+    # listener failures during recovery notification must not mask the
+    # recovery itself — log and continue (the reference's listener
+    # contract is likewise best-effort on the failure path)
+    for lst in listeners:
+        hook = getattr(lst, event, None)
+        if hook is None:
+            continue
+        try:
+            hook(*args)
+        except Exception:  # noqa: BLE001 — see above
+            logger.warning("resilience listener %r.%s failed",
+                           lst, event, exc_info=True)
+
+
+def run_supervised(fn: Callable[[], object],
+                   mgr=None,
+                   policy: Optional[RetryPolicy] = None,
+                   listeners: Sequence = (),
+                   sleep: Callable[[float], None] = time.sleep):
+    """Run ``fn()`` under ``policy``; return its result.
+
+    On a failure classified RETRYABLE, sleep the policy's backoff, sweep
+    the checkpoint manager's crash debris (orphaned ``ckpt-*.tmp`` dirs)
+    and re-invoke ``fn`` — up to ``policy.max_restarts`` times within
+    ``policy.deadline_s``. TERMINAL failures propagate unchanged;
+    exhausting the budget raises :class:`RestartsExhausted` chaining the
+    last failure. Restart/recovery events flow through the listeners'
+    ``on_restart(attempt, error)`` / ``on_recovered(attempt)`` hooks
+    (IterationListener defines both as no-ops) and the
+    ``ml.resilience`` metric group (restarts/recoveries/failures
+    counters, lastBackoffMs gauge).
+
+    ``fn`` must be re-runnable from its own entry point: each attempt
+    re-restores from the newest *valid* checkpoint (or starts fresh when
+    none survives), which is exactly the contract of the checkpointed
+    iteration drivers.
+    """
+    from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+
+    policy = policy or RetryPolicy()
+    group = metrics.group(ML_GROUP, "resilience")
+    deadline = (time.monotonic() + policy.deadline_s
+                if policy.deadline_s is not None else None)
+    attempt = 0  # completed restarts so far
+    while True:
+        try:
+            result = fn()
+        except Exception as e:  # noqa: BLE001 — classified right below
+            group.counter("failures")
+            if policy.classify(e) == TERMINAL:
+                raise
+            if attempt >= policy.max_restarts:
+                raise RestartsExhausted(
+                    attempt, "restart budget exhausted") from e
+            attempt += 1
+            delay = policy.backoff(attempt)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RestartsExhausted(
+                        attempt - 1,
+                        f"deadline budget ({policy.deadline_s:g}s) "
+                        "exhausted") from e
+                delay = min(delay, remaining)
+            logger.warning(
+                "supervised run failed (%s: %s); restart %d/%d in %.3gs",
+                type(e).__name__, e, attempt, policy.max_restarts, delay)
+            _notify(listeners, "on_restart", attempt, e)
+            group.counter("restarts")
+            group.gauge("lastBackoffMs", delay * 1000.0)
+            if mgr is not None and hasattr(mgr, "sweep_orphans"):
+                # a crash between makedirs and the atomic rename leaves a
+                # ckpt-*.tmp corpse; clear it before the next attempt
+                mgr.sweep_orphans()
+            if delay > 0:
+                sleep(delay)
+            continue
+        if attempt:
+            _notify(listeners, "on_recovered", attempt)
+            group.counter("recoveries")
+            logger.info("supervised run recovered after %d restart(s)",
+                        attempt)
+        return result

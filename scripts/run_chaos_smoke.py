@@ -1,0 +1,124 @@
+"""End-to-end chaos smoke: supervised training under injected faults must
+reproduce the fault-free result exactly.
+
+Arms the fault harness (honoring FLINK_ML_TPU_CHAOS_* when already set —
+how CI's chaos job drives it — else the --seed/--rate flags), then runs
+supervised fits whose recovery paths span the whole resilience stack:
+host-loop epoch faults, checkpoint save/publish faults with restore
+fallback, and a host-pool worker wedge killed by the per-child deadline.
+
+Exit codes mirror the sweep precedent (run_benchmark_sweep.py):
+0 = recovered and results identical; 2 = restart budget exhausted
+(RETRYABLE — the chaos rate may simply be too hot for the budget);
+3 = recovered but results DIFFER from the clean run (a correctness
+regression in the recovery path, NOT retryable).
+
+Usage:
+    python scripts/run_chaos_smoke.py [--seed 1234] [--rate 0.1]
+        [--max-restarts 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="run-chaos-smoke")
+    parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--rate", type=float, default=0.1)
+    parser.add_argument("--max-restarts", type=int, default=20)
+    args = parser.parse_args(argv)
+
+    import tempfile
+
+    import numpy as np
+
+    from flink_ml_tpu.common.hostpool import map_row_shards
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.iteration.iteration import (IterationConfig,
+                                                  iterate_bounded)
+    from flink_ml_tpu.resilience import (RestartsExhausted, RetryPolicy,
+                                         faults, run_supervised)
+
+    if faults.env_armed():  # the harness's own off/on check, not a copy
+        plan_ctx = None  # the environment plan is already active
+        print(f"chaos: env-armed (seed="
+              f"{os.environ.get('FLINK_ML_TPU_CHAOS_SEED', '0')}, rate="
+              f"{os.environ.get('FLINK_ML_TPU_CHAOS_RATE', '0.05')})")
+    else:
+        plan_ctx = faults.chaos(
+            seed=args.seed, rate=args.rate,
+            sites=["epoch-boundary", "checkpoint-save",
+                   "checkpoint-publish", "hostpool-hang"])
+        print(f"chaos: programmatic (seed={args.seed}, rate={args.rate})")
+
+    # a pure-host GD iteration: exercises the host loop, checkpointing
+    # and the supervisor on any jax build (no shard_map dependency)
+    A = np.diag([1.0, 2.0, 3.0, 4.0])
+    b = np.array([1.0, -2.0, 0.5, 3.0])
+
+    def body(carry, epoch):
+        w, _ = carry
+        w = w - 0.1 * (A @ w - b)
+        return w, np.float64(0.5 * w @ A @ w - b @ w)
+
+    init = (np.zeros(4), np.float64(np.inf))
+    with faults.suppressed():
+        expected, _ = iterate_bounded(
+            init, body, max_iter=40, jit_round=False,
+            config=IterationConfig(mode="host"))
+
+    rows = np.arange(200_000, dtype=np.int64)
+    expected_sum = int(rows.sum())
+
+    policy = RetryPolicy(max_restarts=args.max_restarts, backoff_s=0.0)
+    failures = []
+
+    def run_all():
+        with tempfile.TemporaryDirectory() as d:
+            mgr = CheckpointManager(os.path.join(d, "ckpt"))
+            cfg = IterationConfig(mode="host", checkpoint_interval=5,
+                                  checkpoint_manager=mgr)
+            got, _ = run_supervised(
+                lambda: iterate_bounded(init, body, max_iter=40,
+                                        jit_round=False, config=cfg),
+                mgr=mgr, policy=policy)
+            if not np.array_equal(got, expected):
+                failures.append(
+                    f"supervised GD diverged: {got} != {expected}")
+            else:
+                print("supervised host-loop fit: identical")
+        parts = run_supervised(
+            lambda: map_row_shards(lambda lo, hi: int(rows[lo:hi].sum()),
+                                   len(rows), workers=4, min_rows=1024,
+                                   timeout_s=5.0),
+            policy=policy)
+        if sum(parts) != expected_sum:
+            failures.append(f"hostpool sum {sum(parts)} != {expected_sum}")
+        else:
+            print("supervised host-pool map: identical")
+
+    try:
+        if plan_ctx is None:
+            run_all()
+        else:
+            with plan_ctx:
+                run_all()
+    except RestartsExhausted as e:
+        print(f"restart budget exhausted: {e}")
+        return 2
+    if failures:
+        for f in failures:
+            print(f"CHAOS REGRESSION: {f}")
+        return 3
+    print("chaos smoke passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

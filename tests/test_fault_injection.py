@@ -13,6 +13,19 @@ from flink_ml_tpu.models.classification import LogisticRegression
 from flink_ml_tpu.models.clustering import KMeans
 
 
+@pytest.fixture(autouse=True)
+def _no_ambient_chaos(monkeypatch):
+    """This suite injects its own crashes at exact rounds; ambient
+    (env-armed) chaos from CI's chaos job would race them — each test
+    here must see only its scripted failure."""
+    for var in ("FLINK_ML_TPU_CHAOS", "FLINK_ML_TPU_CHAOS_SEED",
+                "FLINK_ML_TPU_CHAOS_RATE", "FLINK_ML_TPU_CHAOS_SITES",
+                "FLINK_ML_TPU_CHAOS_AT"):
+        monkeypatch.delenv(var, raising=False)
+    from flink_ml_tpu.resilience import faults
+    faults.reset_env_plan()
+
+
 class _Crash(Exception):
     pass
 

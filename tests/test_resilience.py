@@ -1,0 +1,694 @@
+"""Resilience layer (flink_ml_tpu/resilience + hardened checkpoints):
+retry policy/classification, supervised recovery, checkpoint integrity
+(digests, quarantine, older-checkpoint fallback), host-pool deadlines and
+the deterministic chaos harness. Ref bar: BoundedAllRoundCheckpointITCase
+— a killed job resumes with exactly-correct results — extended to corrupt
+snapshots and wedged workers, which the reference delegates to Flink's
+runtime."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from flink_ml_tpu.common.hostpool import map_row_shards
+from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+from flink_ml_tpu.iteration.iteration import (
+    IterationConfig,
+    IterationListener,
+    iterate_bounded,
+    run_segmented,
+)
+from flink_ml_tpu.resilience import (
+    RETRYABLE,
+    TERMINAL,
+    InjectedFault,
+    RestartsExhausted,
+    RetryPolicy,
+    TerminalFailure,
+    WorkerTimeout,
+    run_supervised,
+)
+from flink_ml_tpu.resilience import faults
+
+#: the dense model fast paths need jax.shard_map; on builds without it the
+#: model-level chaos tests skip (the same paths' own tests skip/fail
+#: identically at the seed) — the driver-level tests below cover recovery
+#: logic without it
+_HAS_SHARD_MAP = hasattr(jax, "shard_map")
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_chaos(monkeypatch):
+    """Each test opts into chaos explicitly (programmatic plan or its own
+    setenv) so the suite is deterministic whether or not CI's chaos job
+    armed FLINK_ML_TPU_CHAOS for the process — ALL chaos vars are
+    scrubbed (a leaked SITES filter would silently reshape a test's
+    own env plan)."""
+    for var in ("FLINK_ML_TPU_CHAOS", "FLINK_ML_TPU_CHAOS_SEED",
+                "FLINK_ML_TPU_CHAOS_RATE", "FLINK_ML_TPU_CHAOS_SITES",
+                "FLINK_ML_TPU_CHAOS_AT"):
+        monkeypatch.delenv(var, raising=False)
+    # per-test schedules: a test re-arming the same env values must get
+    # fresh per-site counters, not the previous test's consumed ones
+    faults.reset_env_plan()
+
+
+# -- policy ------------------------------------------------------------------
+
+def test_classification_defaults():
+    p = RetryPolicy()
+    assert p.classify(WorkerTimeout(3, 1.0)) == RETRYABLE
+    assert p.classify(InjectedFault("epoch-boundary", 1)) == RETRYABLE
+    assert p.classify(OSError("pipe")) == RETRYABLE
+    assert p.classify(RuntimeError("xla died")) == RETRYABLE
+    assert p.classify(MemoryError()) == RETRYABLE
+    assert p.classify(ValueError("bad shape")) == TERMINAL
+    assert p.classify(TypeError()) == TERMINAL
+    assert p.classify(NotImplementedError()) == TERMINAL  # despite RuntimeError
+    assert p.classify(TerminalFailure()) == TERMINAL
+    # unknown Exception subclasses default retryable (sweep exit-2)
+    class Weird(Exception):
+        pass
+    assert p.classify(Weird()) == RETRYABLE
+
+
+def test_classification_policy_overrides_beat_defaults():
+    p = RetryPolicy(terminal=(OSError,), retryable=(ValueError,))
+    assert p.classify(OSError()) == TERMINAL
+    assert p.classify(ValueError()) == RETRYABLE
+
+
+def test_backoff_schedule_and_cap():
+    p = RetryPolicy(backoff_s=0.5, backoff_multiplier=3.0, max_backoff_s=4.0)
+    assert p.backoff(1) == 0.5
+    assert p.backoff(2) == 1.5
+    assert p.backoff(3) == 4.0  # 4.5 capped
+    assert p.backoff(0) == 0.0
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_restarts=-1)
+    with pytest.raises(ValueError):
+        RetryPolicy(backoff_multiplier=0.5)
+
+
+# -- supervisor --------------------------------------------------------------
+
+def test_supervisor_retries_then_succeeds_with_backoff_sequence():
+    calls, slept = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise OSError("transient")
+        return 42
+
+    policy = RetryPolicy(max_restarts=5, backoff_s=0.25,
+                         backoff_multiplier=2.0)
+    out = run_supervised(flaky, policy=policy, sleep=slept.append)
+    assert out == 42 and len(calls) == 4
+    assert slept == [0.25, 0.5, 1.0]
+
+
+def test_supervisor_terminal_propagates_immediately():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("bug")
+
+    with pytest.raises(ValueError):
+        run_supervised(bad, policy=RetryPolicy(max_restarts=5),
+                       sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_supervisor_exhausts_budget_chains_cause():
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(RestartsExhausted) as ei:
+        run_supervised(always, policy=RetryPolicy(max_restarts=2,
+                                                  backoff_s=0.0),
+                       sleep=lambda s: None)
+    assert isinstance(ei.value.__cause__, OSError)
+    assert ei.value.attempts == 2
+
+
+def test_supervisor_deadline_budget():
+    def always():
+        raise OSError("down")
+
+    # a deadline already in the past after the first failure: gives up
+    # without consuming the restart budget
+    with pytest.raises(RestartsExhausted, match="deadline"):
+        run_supervised(always,
+                       policy=RetryPolicy(max_restarts=100, backoff_s=0.0,
+                                          deadline_s=0.0),
+                       sleep=lambda s: None)
+
+
+def test_supervisor_emits_restart_and_recovery_events():
+    events = []
+
+    class Recorder(IterationListener):
+        def on_restart(self, attempt, error):
+            events.append(("restart", attempt, type(error).__name__))
+
+        def on_recovered(self, attempt):
+            events.append(("recovered", attempt))
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("x")
+        return "ok"
+
+    out = run_supervised(flaky, policy=RetryPolicy(backoff_s=0.0),
+                         listeners=[Recorder()], sleep=lambda s: None)
+    assert out == "ok"
+    assert events == [("restart", 1, "OSError"), ("restart", 2, "OSError"),
+                      ("recovered", 2)]
+
+
+def test_supervisor_sweeps_tmp_orphans_between_attempts(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            os.makedirs(os.path.join(mgr.base_dir, "ckpt-00000001.tmp"))
+            raise OSError("crashed mid-save")
+        # the orphan from attempt 1 must be gone by the time we re-enter
+        assert not any(n.endswith(".tmp") for n in os.listdir(mgr.base_dir))
+        return "ok"
+
+    assert run_supervised(flaky, mgr=mgr,
+                          policy=RetryPolicy(backoff_s=0.0),
+                          sleep=lambda s: None) == "ok"
+
+
+# -- checkpoint integrity ----------------------------------------------------
+
+def _carry():
+    return (np.arange(8, dtype=np.float32), np.float64(1.25))
+
+
+def _two_checkpoints(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(_carry(), 2)
+    c2 = (np.arange(8, dtype=np.float32) * 2, np.float64(2.5))
+    mgr.save(c2, 4)
+    return mgr
+
+
+def _assert_fell_back(mgr, quarantined_name="ckpt-00000004"):
+    got = mgr.restore(_carry())
+    assert got is not None
+    carry, epoch = got
+    assert epoch == 2
+    np.testing.assert_array_equal(carry[0], np.arange(8, dtype=np.float32))
+    names = os.listdir(mgr.base_dir)
+    assert any(n.startswith(quarantined_name + ".corrupt") for n in names), \
+        names
+    assert mgr.list_checkpoints() == ["ckpt-00000002"]
+
+
+def test_manifest_records_digests_dtype_shape(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    path = mgr.save(_carry(), 3)
+    with open(os.path.join(path, "manifest.json")) as f:
+        m = json.load(f)
+    assert m["version"] == 2 and m["num_leaves"] == 2
+    assert m["leaves"][0]["dtype"] == "float32"
+    assert m["leaves"][0]["shape"] == [8]
+    assert len(m["leaves"][0]["sha256"]) == 64
+
+
+def test_restore_truncated_npz_falls_back(tmp_path):
+    mgr = _two_checkpoints(tmp_path)
+    npz = os.path.join(mgr.base_dir, "ckpt-00000004", "leaves.npz")
+    with open(npz, "r+b") as f:
+        f.truncate(os.path.getsize(npz) // 2)
+    _assert_fell_back(mgr)
+
+
+def test_restore_missing_manifest_falls_back(tmp_path):
+    mgr = _two_checkpoints(tmp_path)
+    os.remove(os.path.join(mgr.base_dir, "ckpt-00000004", "manifest.json"))
+    _assert_fell_back(mgr)
+
+
+def test_restore_bitflipped_leaf_digest_mismatch_falls_back(tmp_path):
+    mgr = _two_checkpoints(tmp_path)
+    # rewrite the npz as a VALID archive with altered content: only the
+    # manifest's sha256 can catch this (zip CRC is consistent again)
+    npz = os.path.join(mgr.base_dir, "ckpt-00000004", "leaves.npz")
+    with np.load(npz) as z:
+        leaves = {k: z[k].copy() for k in z.files}
+    leaves["leaf_0"][3] += 1.0
+    np.savez(npz, **leaves)
+    _assert_fell_back(mgr)
+
+
+def test_restore_leaf_count_mismatch_falls_back(tmp_path):
+    """A template/checkpoint leaf-count mismatch is classified as a
+    corrupt checkpoint (older-fallback + quarantine), not a bare
+    ValueError mid-recovery."""
+    mgr = _two_checkpoints(tmp_path)
+    # make the NEWEST checkpoint structurally wrong for the template
+    manifest = os.path.join(mgr.base_dir, "ckpt-00000004", "manifest.json")
+    with open(manifest) as f:
+        m = json.load(f)
+    m["num_leaves"] = 3
+    with open(manifest, "w") as f:
+        json.dump(m, f)
+    _assert_fell_back(mgr)
+
+
+def test_restore_malformed_manifest_shape_falls_back(tmp_path):
+    """A manifest that parses as JSON but has the wrong SHAPE (null,
+    missing epoch, non-dict leaf records) must still route to quarantine
+    + fallback — the recovery path never raises mid-recovery."""
+    for i, bad in enumerate(["null", '{"num_leaves": 2, "leaves": [1, 2]}',
+                             '{"num_leaves": 2, "version": 2, '
+                             '"leaves": null}']):
+        mgr = _two_checkpoints(tmp_path / f"case{i}")
+        with open(os.path.join(mgr.base_dir, "ckpt-00000004",
+                               "manifest.json"), "w") as f:
+            f.write(bad)
+        _assert_fell_back(mgr)
+
+
+def test_restore_all_corrupt_returns_none(tmp_path):
+    mgr = _two_checkpoints(tmp_path)
+    for name in list(mgr.list_checkpoints()):
+        os.remove(os.path.join(mgr.base_dir, name, "manifest.json"))
+    assert mgr.restore(_carry()) is None
+    assert mgr.list_checkpoints() == []
+    assert len([n for n in os.listdir(mgr.base_dir)
+                if ".corrupt" in n]) == 2
+
+
+def test_restore_legacy_v1_manifest(tmp_path):
+    """Pre-hardening checkpoints (no per-leaf records) must still
+    restore — digest checks are skipped, structure is still validated."""
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    path = mgr.save(_carry(), 5)
+    manifest = os.path.join(path, "manifest.json")
+    with open(manifest, "w") as f:
+        json.dump({"epoch": 5, "num_leaves": 2}, f)
+    got = mgr.restore(_carry())
+    assert got is not None and got[1] == 5
+
+
+def test_init_sweeps_orphaned_tmp_dirs(tmp_path):
+    base = str(tmp_path / "ckpt")
+    os.makedirs(os.path.join(base, "ckpt-00000003.tmp"))
+    os.makedirs(os.path.join(base, "ckpt-00000007.tmp"))
+    os.makedirs(os.path.join(base, "ckpt-00000004"))
+    mgr = CheckpointManager(base)
+    names = os.listdir(base)
+    assert not any(n.endswith(".tmp") for n in names)
+    assert "ckpt-00000004" in names
+    assert mgr.sweep_orphans() == 0  # idempotent
+
+
+def test_quarantined_dirs_not_listed_or_gced(tmp_path):
+    mgr = _two_checkpoints(tmp_path)
+    os.remove(os.path.join(mgr.base_dir, "ckpt-00000004", "manifest.json"))
+    mgr.restore(_carry())
+    assert mgr.list_checkpoints() == ["ckpt-00000002"]
+    # later saves GC real checkpoints but keep the forensic .corrupt dir
+    mgr.save(_carry(), 6)
+    mgr.save(_carry(), 8)
+    assert mgr.list_checkpoints() == ["ckpt-00000006", "ckpt-00000008"]
+    assert any(".corrupt" in n for n in os.listdir(mgr.base_dir))
+
+
+def test_publish_fault_leaves_no_visible_checkpoint(tmp_path):
+    """A crash between the tmp write and the atomic rename must leave the
+    previous checkpoint intact and only a sweepable orphan behind."""
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(_carry(), 2)
+    with faults.chaos(at={"checkpoint-publish": [1]}):
+        with pytest.raises(InjectedFault):
+            mgr.save(_carry(), 4)
+    assert mgr.list_checkpoints() == ["ckpt-00000002"]
+    assert any(n.endswith(".tmp") for n in os.listdir(mgr.base_dir))
+    mgr.sweep_orphans()
+    assert not any(n.endswith(".tmp") for n in os.listdir(mgr.base_dir))
+
+
+# -- chaos harness -----------------------------------------------------------
+
+def test_fault_plan_seeded_schedule_is_deterministic():
+    with faults.chaos(seed=7, rate=0.5) as plan:
+        a = [plan.decide("epoch-boundary") for _ in range(12)]
+    with faults.chaos(seed=7, rate=0.5) as plan:
+        b = [plan.decide("epoch-boundary") for _ in range(12)]
+    assert a == b and any(a)
+    with faults.chaos(seed=8, rate=0.5) as plan:
+        c = [plan.decide("epoch-boundary") for _ in range(12)]
+    assert a != c  # a different seed is a different schedule
+
+
+def test_fault_plan_explicit_schedule_and_site_filter():
+    with faults.chaos(at={"checkpoint-save": [2]}):
+        faults.inject("checkpoint-save")  # call 1: no fault
+        with pytest.raises(InjectedFault) as ei:
+            faults.inject("checkpoint-save")
+        assert ei.value.count == 2
+        faults.inject("epoch-boundary")  # unlisted site never faults
+    with faults.chaos(rate=1.0, sites=["epoch-boundary"]):
+        faults.inject("checkpoint-save")  # filtered out
+        with pytest.raises(InjectedFault):
+            faults.inject("epoch-boundary")
+
+
+def test_suppressed_disables_injection():
+    with faults.chaos(rate=1.0):
+        with faults.suppressed():
+            faults.inject("epoch-boundary")
+        with pytest.raises(InjectedFault):
+            faults.inject("epoch-boundary")
+
+
+def test_env_activation(monkeypatch):
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS", "1")
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS_AT", "checkpoint-save:1")
+    with pytest.raises(InjectedFault):
+        faults.inject("checkpoint-save")
+    faults.inject("checkpoint-save")  # only call 1 is scheduled
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS", "0")
+    faults.inject("checkpoint-save")  # off
+
+
+def test_env_malformed_at_entry_ignored(monkeypatch):
+    """A typo'd FLINK_ML_TPU_CHAOS_AT entry must not detonate as a
+    ValueError inside the first instrumented production call."""
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS", "1")
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS_AT",
+                       "checkpoint-save,epoch-boundary:notanint,"
+                       "native-kernel:1")
+    faults.inject("checkpoint-save")  # malformed entries skipped
+    with pytest.raises(InjectedFault):
+        faults.inject("native-kernel")  # well-formed entry still applies
+
+
+def test_env_armed_matches_off_set(monkeypatch):
+    for off in ("0", "false", "False", "off", "no", ""):
+        monkeypatch.setenv("FLINK_ML_TPU_CHAOS", off)
+        assert not faults.env_armed()
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS", "1")
+    assert faults.env_armed()
+
+
+def test_env_rearm_resets_schedule_counters(monkeypatch):
+    """Disarm→re-arm with identical env values must start a fresh
+    schedule once the disarmed state was observed (or reset_env_plan
+    was called) — not resume the consumed counters."""
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS", "1")
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS_AT", "native-kernel:1")
+    with pytest.raises(InjectedFault):
+        faults.inject("native-kernel")  # consumes call #1
+    faults.inject("native-kernel")      # call #2: nothing scheduled
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS", "0")
+    faults.inject("native-kernel")      # disarmed call observes the off
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS", "1")
+    with pytest.raises(InjectedFault):
+        faults.inject("native-kernel")  # fresh plan: call #1 again
+
+
+def test_env_rate_plan_uses_seed(monkeypatch):
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS", "1")
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS_SEED", "1234")
+    monkeypatch.setenv("FLINK_ML_TPU_CHAOS_RATE", "1.0")
+    with pytest.raises(InjectedFault):
+        faults.inject("native-kernel")
+
+
+# -- host pool deadlines -----------------------------------------------------
+
+def test_wedged_child_killed_and_named(rng):
+    with faults.chaos(at={"hostpool-hang": [1]}):
+        with pytest.raises(WorkerTimeout) as ei:
+            map_row_shards(lambda lo, hi: hi - lo, 2000, workers=2,
+                           min_rows=4, timeout_s=1.0)
+    assert ei.value.worker_index == 0
+    assert RetryPolicy().classify(ei.value) == RETRYABLE
+
+
+def test_injected_child_crash_propagates_as_worker_failure():
+    with faults.chaos(at={"hostpool-child": [2]}):
+        with pytest.raises(RuntimeError, match="InjectedFault") as ei:
+            map_row_shards(lambda lo, hi: hi - lo, 2000, workers=2,
+                           min_rows=4)
+    # the traceback names the real scheduled call, so failures correlate
+    # with the deterministic plan
+    assert "call #2" in str(ei.value)
+
+
+def test_wedged_child_killed_on_deadline_despite_busy_siblings():
+    """Deadline enforcement must not wait for the selector to go idle:
+    a sibling streaming a large payload keeps select() busy, and the
+    wedged child must still die at ~timeout_s, not at drain time."""
+    import time as _time
+    big = np.zeros(1 << 22, dtype=np.uint8)  # 4 MiB result per shard
+
+    def fn(lo, hi):
+        return big
+
+    start = _time.monotonic()
+    with faults.chaos(at={"hostpool-hang": [1]}):
+        with pytest.raises(WorkerTimeout):
+            map_row_shards(fn, 40_000, workers=4, min_rows=4,
+                           shard_cap=2_000, timeout_s=1.5)
+    assert _time.monotonic() - start < 10.0
+
+
+def test_hostpool_survives_sibling_teardown_after_timeout():
+    """The WorkerTimeout teardown must SIGKILL wedged siblings too — the
+    driver returns promptly instead of blocking in waitpid."""
+    with faults.chaos(at={"hostpool-hang": [1, 2]}):
+        with pytest.raises(WorkerTimeout):
+            map_row_shards(lambda lo, hi: hi - lo, 2000, workers=2,
+                           min_rows=4, timeout_s=1.0)
+
+
+def test_supervised_hostpool_map_recovers():
+    """A map whose first attempt hits a wedged child succeeds on retry —
+    the WorkerTimeout → restart → clean re-fork loop end to end."""
+    with faults.chaos(at={"hostpool-hang": [1]}):
+        parts = run_supervised(
+            lambda: map_row_shards(lambda lo, hi: hi - lo, 2000,
+                                   workers=2, min_rows=4, timeout_s=1.0),
+            policy=RetryPolicy(max_restarts=2, backoff_s=0.0),
+            sleep=lambda s: None)
+    assert sum(parts) == 2000
+
+
+def test_hostpool_timeout_disabled_runs_normally():
+    parts = map_row_shards(lambda lo, hi: hi - lo, 2000, workers=2,
+                           min_rows=4, timeout_s=0)
+    assert sum(parts) == 2000
+
+
+# -- end-to-end recovery (driver level, no shard_map needed) -----------------
+
+_A = np.diag([1.0, 2.0, 3.0])
+_B = np.array([1.0, -2.0, 0.5])
+
+
+def _gd_body(carry, epoch):
+    w, _ = carry
+    w = w - 0.1 * (_A @ w - _B)
+    return w, np.float64(0.5 * w @ _A @ w - _B @ w)
+
+
+def _gd_init():
+    return np.zeros(3), np.float64(np.inf)
+
+
+def _gd_expected():
+    with faults.suppressed():
+        return iterate_bounded(_gd_init(), _gd_body, max_iter=30,
+                               jit_round=False,
+                               config=IterationConfig(mode="host"))[0]
+
+
+def test_host_loop_supervised_chaos_identical(tmp_path):
+    expected = _gd_expected()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=5,
+                          checkpoint_manager=mgr)
+
+    def fit_once():
+        return iterate_bounded(_gd_init(), _gd_body, max_iter=30,
+                               jit_round=False, config=cfg)
+
+    with faults.chaos(at={"epoch-boundary": [12, 23],
+                          "checkpoint-save": [4]}):
+        got, _ = run_supervised(fit_once, mgr=mgr,
+                                policy=RetryPolicy(max_restarts=5,
+                                                   backoff_s=0.0),
+                                sleep=lambda s: None)
+    np.testing.assert_array_equal(got, expected)  # bit-identical
+    assert not mgr.list_checkpoints()  # completed run cleared
+
+
+def test_host_loop_supervised_corrupt_newest_checkpoint(tmp_path):
+    """Crash at an epoch boundary AND corrupt the newest snapshot: the
+    retry must restore from the older checkpoint, quarantine the corrupt
+    one and still converge to the uninterrupted result."""
+    expected = _gd_expected()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=5,
+                          checkpoint_manager=mgr)
+    state = {"corrupted": False}
+
+    class CorruptAfterCrash(IterationListener):
+        def on_restart(self, attempt, error):
+            newest = mgr.list_checkpoints()[-1]
+            os.remove(os.path.join(mgr.base_dir, newest, "manifest.json"))
+            state["corrupted"] = True
+
+    def fit_once():
+        return iterate_bounded(_gd_init(), _gd_body, max_iter=30,
+                               jit_round=False, config=cfg)
+
+    with faults.chaos(at={"epoch-boundary": [14]}):
+        got, _ = run_supervised(fit_once, mgr=mgr,
+                                policy=RetryPolicy(max_restarts=3,
+                                                   backoff_s=0.0),
+                                listeners=[CorruptAfterCrash()],
+                                sleep=lambda s: None)
+    assert state["corrupted"]
+    np.testing.assert_array_equal(got, expected)
+    assert any(".corrupt" in n for n in os.listdir(mgr.base_dir))
+
+
+def test_run_segmented_supervised_chaos_identical(tmp_path):
+    """The segmented driver (device fast path's host shell) under chaos:
+    faults at segment boundaries and checkpoint saves recover to the
+    exact uninterrupted trajectory."""
+    def run_segment(carry, epoch0, limit):
+        w, loss = carry
+        for e in range(epoch0, limit):
+            w, loss = _gd_body((w, loss), e)
+        return (w, loss), limit, False
+
+    with faults.suppressed():
+        mgr0 = CheckpointManager(str(tmp_path / "clean"))
+        expected, _ = run_segmented(run_segment, _gd_init(), 30, 5, mgr0)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+
+    def fit_once():
+        return run_segmented(run_segment, _gd_init(), 30, 5, mgr)
+
+    with faults.chaos(at={"epoch-boundary": [3], "checkpoint-save": [5],
+                          "checkpoint-publish": [2]}):
+        got, _ = run_supervised(fit_once, mgr=mgr,
+                                policy=RetryPolicy(max_restarts=6,
+                                                   backoff_s=0.0),
+                                sleep=lambda s: None)
+    np.testing.assert_array_equal(got, expected)
+    assert not any(n.endswith(".tmp") for n in os.listdir(mgr.base_dir))
+
+
+# -- end-to-end recovery (model level, needs shard_map) ----------------------
+
+needs_shard_map = pytest.mark.skipif(
+    not _HAS_SHARD_MAP, reason="jax.shard_map unavailable (seed-known)")
+
+
+@pytest.fixture
+def lr_data(rng):
+    from flink_ml_tpu.common.table import Table
+    x = np.concatenate([rng.normal(size=(300, 5)),
+                        rng.normal(size=(300, 5)) + 2]).astype(np.float32)
+    y = np.concatenate([np.zeros(300), np.ones(300)]).astype(np.float32)
+    return Table.from_columns(features=x, label=y)
+
+
+def _lr():
+    from flink_ml_tpu.models.classification import LogisticRegression
+    return LogisticRegression(max_iter=12, global_batch_size=200,
+                              learning_rate=0.1)
+
+
+@needs_shard_map
+def test_lr_supervised_host_mode_chaos_identical(lr_data, tmp_path):
+    with faults.suppressed():
+        expected = _lr().fit(lr_data).coefficients
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="host", checkpoint_interval=2,
+                          checkpoint_manager=mgr)
+    with faults.chaos(at={"epoch-boundary": [7], "checkpoint-save": [2]}):
+        got = (_lr().set_iteration_config(cfg)
+               .set_retry_policy(RetryPolicy(max_restarts=6, backoff_s=0.0))
+               .fit(lr_data).coefficients)
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+@needs_shard_map
+def test_lr_supervised_device_mode_chaos_identical(lr_data, tmp_path):
+    with faults.suppressed():
+        expected = _lr().fit(lr_data).coefficients
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    cfg = IterationConfig(mode="device", checkpoint_interval=2,
+                          checkpoint_manager=mgr)
+    with faults.chaos(at={"checkpoint-publish": [3], "epoch-boundary": [5]}):
+        got = (_lr().set_iteration_config(cfg)
+               .set_retry_policy(RetryPolicy(max_restarts=6, backoff_s=0.0))
+               .fit(lr_data).coefficients)
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+@needs_shard_map
+def test_kmeans_supervised_segmented_chaos_identical(rng, tmp_path):
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.clustering import KMeans
+    x = np.concatenate([rng.normal(size=(100, 3)),
+                        rng.normal(size=(100, 3)) + 6]).astype(np.float32)
+    t = Table.from_columns(features=x)
+    with faults.suppressed():
+        expected = KMeans(k=2, seed=7, max_iter=8).fit(t).centroids
+    cfg = IterationConfig(mode="device", checkpoint_interval=3,
+                          checkpoint_manager=CheckpointManager(
+                              str(tmp_path / "ckpt")))
+    with faults.chaos(at={"epoch-boundary": [2], "checkpoint-save": [2]}):
+        got = (KMeans(k=2, seed=7, max_iter=8).set_iteration_config(cfg)
+               .set_retry_policy(RetryPolicy(max_restarts=6, backoff_s=0.0))
+               .fit(t).centroids)
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+@needs_shard_map
+def test_lr_seeded_rate_chaos_deterministic_recovery(lr_data, tmp_path):
+    """The CI chaos configuration in miniature: a seeded rate plan over
+    the recovery sites; a fixed seed must recover to the exact clean
+    result on every run."""
+    with faults.suppressed():
+        expected = _lr().fit(lr_data).coefficients
+    for trial in range(2):
+        cfg = IterationConfig(
+            mode="host", checkpoint_interval=2,
+            checkpoint_manager=CheckpointManager(
+                str(tmp_path / f"ckpt{trial}")))
+        with faults.chaos(seed=1234, rate=0.15,
+                          sites=["epoch-boundary", "checkpoint-save"]):
+            got = (_lr().set_iteration_config(cfg)
+                   .set_retry_policy(RetryPolicy(max_restarts=20,
+                                                 backoff_s=0.0))
+                   .fit(lr_data).coefficients)
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
