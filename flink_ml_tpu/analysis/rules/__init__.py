@@ -4,6 +4,7 @@
 from flink_ml_tpu.analysis.rules import (  # noqa: F401
     aliasing,
     hostsync,
+    metrics_in_jit,
     native_contract,
     recompile,
     rng,

@@ -1,0 +1,84 @@
+"""Rule JL107 ``metric-in-jit``: metric/tracer recording inside traced code.
+
+``metrics.group(...).counter(...)`` or ``tracer.span(...)`` inside a
+``jit``/``shard_map``-traced body executes exactly once — at trace time —
+and never again: the compiled program contains no Python, so the counter
+silently records one increment for a million steps and the span measures
+tracing, not execution. The observability layer (docs/observability.md)
+is host-side by design; recording belongs at the host boundaries the
+iteration runtime already exposes (epoch/segment edges, stage wrappers).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from flink_ml_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+from flink_ml_tpu.analysis.rules._shared import jitted_functions
+
+#: receiver roots that mark the observability layer (the module-level
+#: registry/tracer singletons and their conventional local names)
+_ROOTS = {"metrics", "tracer", "tracing"}
+
+#: recording methods on registry groups / histograms / tracers — calling
+#: any of these in traced code is the hazard regardless of receiver name
+_RECORD_ATTRS = {"gauge", "counter", "histogram", "observe", "span",
+                 "event", "add_event", "set_attribute"}
+
+#: numeric namespaces whose same-named members are jit-legal math, not
+#: metric recording (``jnp.histogram`` computes one)
+_NUMERIC_ROOTS = {"jnp", "np", "numpy", "jax", "lax", "jsp", "scipy"}
+
+
+def _chain_root(node: ast.AST):
+    """The root Name of an attribute/call chain: ``metrics`` for
+    ``metrics.group("ml").counter(...)`` (descends through both
+    Attribute.value and Call.func)."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            return node.id
+        else:
+            return None
+
+
+@register
+class MetricInJitRule(Rule):
+    name = "metric-in-jit"
+    code = "JL107"
+    rationale = (
+        "metrics.*/tracer span calls inside a jit/shard_map-traced body "
+        "run once at trace time and silently record nothing per step — "
+        "record at host boundaries (epoch/segment edges) instead")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        seen = set()
+        for fn, _argnums, _argnames in jitted_functions(ctx):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute):
+                    continue
+                attr = node.func.attr
+                root = _chain_root(node.func)
+                if root in _ROOTS or (
+                        attr in _RECORD_ATTRS and root is not None
+                        and root not in _NUMERIC_ROOTS):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{root}.…{attr}(...)` inside jit/shard_map-"
+                        f"traced `{fn.name}` executes once at trace time "
+                        "and records nothing per compiled step (move the "
+                        "recording to the host boundary around the "
+                        "traced call)")

@@ -14,6 +14,7 @@ compute inside concrete stages is jitted XLA.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 from typing import Tuple
@@ -24,22 +25,38 @@ from flink_ml_tpu.utils import io as rw
 
 
 def _profiled(method, kind: str):
-    """Wrap a fit/transform implementation with the profiler hook (SURVEY.md
-    §5: profiling is the reference's gap we close). Active only when
-    ``FLINK_ML_TPU_PROFILE_DIR`` is set — one env check of overhead
-    otherwise. Traces nest safely: a Pipeline's stages inside the pipeline
-    trace record wall-time gauges only."""
+    """Wrap a fit/transform implementation with the observability hooks
+    (SURVEY.md §5: run visibility is the reference's gap we close).
+    Two independent, composing arms — ``FLINK_ML_TPU_PROFILE_DIR``
+    records a jax.profiler trace (device/XLA internals),
+    ``FLINK_ML_TPU_TRACE_DIR`` opens a tracer span (host-side structure:
+    fit→epoch→checkpoint nesting, docs/observability.md). Two env checks
+    of overhead when both are off. Traces nest safely: a Pipeline's
+    stages inside the pipeline trace record wall-time gauges only."""
 
     @functools.wraps(method)
     def wrapper(self, *args, **kwargs):
         from flink_ml_tpu.common.metrics import PROFILE_DIR_ENV, profile
+        from flink_ml_tpu.observability import tracing
 
         trace_dir = os.environ.get(PROFILE_DIR_ENV)
-        if not trace_dir:
+        tracer = tracing.tracer
+        if not trace_dir and not tracer.enabled:
             return method(self, *args, **kwargs)
         region = f"{type(self).__name__}.{kind}"
-        with profile(os.path.join(trace_dir, region), name=region):
-            return method(self, *args, **kwargs)
+        try:
+            with contextlib.ExitStack() as stack:
+                if tracer.enabled:
+                    stack.enter_context(tracer.span(
+                        region, kind=kind, stage=type(self).__name__))
+                if trace_dir:
+                    stack.enter_context(profile(
+                        os.path.join(trace_dir, region), name=region))
+                return method(self, *args, **kwargs)
+        finally:
+            # an outermost stage (not one nested in a Pipeline) closing
+            # its root span snapshots the registry beside the spans
+            tracing.maybe_dump_root_metrics()
 
     wrapper._profiled = True
     return wrapper

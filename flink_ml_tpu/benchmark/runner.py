@@ -68,6 +68,22 @@ def load_config(path: str) -> dict:
 
 
 def run_benchmark(name: str, spec: dict) -> dict:
+    """One named benchmark; with FLINK_ML_TPU_TRACE_DIR armed the whole
+    run is a span (datagen + fit/transform + materialization nested
+    inside), so a BENCH sweep leaves an inspectable trace per row."""
+    from flink_ml_tpu.observability import tracing
+
+    with tracing.tracer.span("benchmark.run", benchmark=name,
+                             stage=spec["stage"]["className"]) as sp:
+        result = _run_benchmark(name, spec)
+        sp.set_attribute("totalTimeMs", round(result["totalTimeMs"], 3))
+        sp.set_attribute("inputThroughput",
+                         round(result["inputThroughput"], 1))
+    tracing.maybe_dump_root_metrics()
+    return result
+
+
+def _run_benchmark(name: str, spec: dict) -> dict:
     stage = resolve_stage(spec["stage"]["className"])()
     stage.params_from_json(spec["stage"].get("paramMap", {}), strict=True)
 

@@ -92,6 +92,17 @@ def shard_bounds(n_rows: int, workers: int):
 
 def _child_main(fn, lo, hi, wfd, chaos_action=None):
     status, payload = 0, None
+    # fork re-seed (docs/observability.md): the child's spans go to its
+    # own spans-<pid>.jsonl parented to the inherited dispatch span, and
+    # its registry restarts empty so the end-of-shard snapshot shipped
+    # back holds only child-produced metrics. reseed_child (NOT clear):
+    # inherited locks may be held by a driver thread that doesn't exist
+    # in the child, so they must be replaced, never acquired
+    from flink_ml_tpu.common.metrics import metrics
+    from flink_ml_tpu.observability import tracing
+
+    tracing.tracer.reseed_child()
+    metrics.reseed_child()
     try:
         if chaos_action is not None:
             # decided in the PARENT pre-fork so the schedule counter
@@ -104,7 +115,12 @@ def _child_main(fn, lo, hi, wfd, chaos_action=None):
                     time.sleep(3600)
             raise InjectedFault("hostpool-child", count,
                                 {"rows": (lo, hi)})
-        payload = pickle.dumps(fn(lo, hi), protocol=pickle.HIGHEST_PROTOCOL)
+        with tracing.tracer.span("hostpool.child", rows_lo=lo,
+                                 rows_hi=hi):
+            result = fn(lo, hi)
+        payload = pickle.dumps(
+            {"result": result, "metrics": metrics.snapshot()},
+            protocol=pickle.HIGHEST_PROTOCOL)
     except BaseException:  # noqa: BLE001 — report the traceback, then _exit
         status = 1
         payload = traceback.format_exc().encode("utf-8", "replace")
@@ -146,6 +162,8 @@ def map_row_shards(fn, n_rows: int, *, workers: int = None,
     env default; <= 0 disables): a child past it is SIGKILLed and the map
     raises a retryable :class:`WorkerTimeout` naming the worker.
     """
+    from flink_ml_tpu.observability import tracing
+
     workers = host_parallelism() if workers is None else workers
     small = n_rows < max(min_rows, 2)
     n_shards = 1 if small else max(
@@ -153,10 +171,15 @@ def map_row_shards(fn, n_rows: int, *, workers: int = None,
         -(-n_rows // max(1, shard_cap)))
     shards = shard_bounds(n_rows, max(1, n_shards))
     if workers <= 1 or small or not hasattr(os, "fork"):
-        return [fn(lo, hi) for lo, hi in shards]
+        with tracing.tracer.span("hostpool.map", n_rows=n_rows,
+                                 shards=len(shards), mode="inline"):
+            return [fn(lo, hi) for lo, hi in shards]
     if timeout_s is None:
         timeout_s = child_deadline_s()
-    return _fork_sliding(fn, shards, workers, timeout_s)
+    with tracing.tracer.span("hostpool.map", n_rows=n_rows,
+                             shards=len(shards), workers=workers,
+                             mode="fork"):
+        return _fork_sliding(fn, shards, workers, timeout_s)
 
 
 class _Child:
@@ -175,7 +198,10 @@ class _Child:
 
 
 def _finalize(child):
-    """Parse a finished child's stream → its unpickled result."""
+    """Parse a finished child's stream → its unpickled result, folding
+    the child's metric-registry snapshot into the driver registry on the
+    way (the collect-time merge of docs/observability.md — before this,
+    everything a worker counted was silently dropped)."""
     if child.header is None:
         raise RuntimeError(
             "host-pool worker died before reporting a result")
@@ -186,7 +212,16 @@ def _finalize(child):
                            + payload.decode("utf-8", "replace"))
     if len(payload) < length:
         raise RuntimeError("host-pool worker result truncated")
-    return pickle.loads(payload)
+    envelope = pickle.loads(payload)
+    snap = envelope.get("metrics")
+    if snap:
+        from flink_ml_tpu.common.metrics import metrics
+
+        try:
+            metrics.merge(snap)
+        except ValueError:  # a bucket-drift snapshot must not fail the map
+            pass
+    return envelope["result"]
 
 
 def _reap(pid, grace_s: float = 5.0) -> None:
@@ -263,6 +298,12 @@ def _fork_sliding(fn, shards, workers, timeout_s=None):
                 os.waitpid(child.pid, 0)
                 reaped.add(child.pid)
                 lo, hi = shards[child.idx]
+                from flink_ml_tpu.observability import tracing
+
+                tracing.tracer.event("hostpool.timeout",
+                                     worker=child.idx,
+                                     timeout_s=timeout_s,
+                                     rows_lo=lo, rows_hi=hi)
                 raise WorkerTimeout(child.idx, timeout_s, rows=(lo, hi))
 
     try:

@@ -7,18 +7,95 @@ metric group names (``ml`` / ``model``) and the model ``timestamp`` /
 Flink's web UI; we expose a process-local registry plus a first-class
 profiler hook (jax.profiler) — SURVEY.md §5 flags profiling as a reference
 gap worth closing.
+
+Beyond the reference (docs/observability.md): metrics carry optional
+**labels** and **histograms** so per-epoch / per-site history survives a
+fit instead of collapsing into a last-value gauge, the registry is
+thread-safe under concurrent stages, and :meth:`MetricsRegistry.merge`
+folds host-pool child snapshots into the driver registry (the reference's
+per-subtask metric aggregation, done by Flink's JobManager there).
+
+Labeled metrics render their key in Prometheus label syntax
+(``name{site="epoch"}``) so a snapshot is one string-split away from text
+exposition (observability/exporters.py).
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 ML_GROUP = "ml"
 MODEL_GROUP = "model"
 TIMESTAMP_GAUGE = "timestamp"
 VERSION_GAUGE = "version"
+
+#: default histogram bucket upper bounds — latency-shaped (ms); callers
+#: with a different unit (bytes, counts) pass their own ``buckets``
+DEFAULT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                   1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0)
+
+
+def _escape_label(value) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def metric_key(name: str, labels: Optional[Dict[str, str]] = None) -> str:
+    """``name`` or ``name{k="v",...}`` (sorted keys, Prometheus syntax,
+    values escaped) — THE rendering of a labeled metric identity;
+    exporters and merge rely on every writer agreeing on it."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics): ``counts[i]``
+    tallies observations <= ``buckets[i]``; an implicit +Inf bucket is
+    ``count``. Thread-safe."""
+
+    __slots__ = ("buckets", "counts", "sum", "count", "_lock")
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.counts = [0] * len(self.buckets)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.sum += value
+            self.count += 1
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    self.counts[i] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"buckets": list(self.buckets),
+                    "counts": list(self.counts),
+                    "sum": self.sum, "count": self.count}
+
+    def merge_snapshot(self, snap: dict) -> None:
+        """Fold a child histogram snapshot in (bucket bounds must match —
+        both sides derive them from the same instrumentation site)."""
+        with self._lock:
+            if tuple(snap.get("buckets", ())) != self.buckets:
+                raise ValueError(
+                    f"histogram bucket mismatch: {snap.get('buckets')} "
+                    f"vs {list(self.buckets)}")
+            for i, c in enumerate(snap["counts"]):
+                self.counts[i] += int(c)
+            self.sum += float(snap["sum"])
+            self.count += int(snap["count"])
 
 
 class MetricGroup:
@@ -26,32 +103,96 @@ class MetricGroup:
         self.name = name
         self._gauges: Dict[str, float] = {}
         self._counters: Dict[str, int] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
 
-    def gauge(self, name: str, value) -> None:
-        self._gauges[name] = value
+    def gauge(self, name: str, value,
+              labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._gauges[metric_key(name, labels)] = value
 
-    def counter(self, name: str, increment: int = 1) -> int:
-        self._counters[name] = self._counters.get(name, 0) + increment
-        return self._counters[name]
+    def counter(self, name: str, increment: int = 1,
+                labels: Optional[Dict[str, str]] = None) -> int:
+        key = metric_key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + increment
+            return self._counters[key]
 
-    def get_gauge(self, name: str):
-        return self._gauges.get(name)
+    def histogram(self, name: str, buckets=DEFAULT_BUCKETS,
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        """The histogram registered under ``name`` (+labels), created on
+        first use. ``buckets`` only applies at creation."""
+        key = metric_key(name, labels)
+        with self._lock:
+            hist = self._histograms.get(key)
+            if hist is None:
+                hist = self._histograms[key] = Histogram(buckets)
+            return hist
 
-    def get_counter(self, name: str) -> int:
-        return self._counters.get(name, 0)
+    def get_gauge(self, name: str,
+                  labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            return self._gauges.get(metric_key(name, labels))
+
+    def get_counter(self, name: str,
+                    labels: Optional[Dict[str, str]] = None) -> int:
+        with self._lock:
+            return self._counters.get(metric_key(name, labels), 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"gauges": dict(self._gauges),
+                    "counters": dict(self._counters),
+                    "histograms": {k: h.snapshot()
+                                   for k, h in self._histograms.items()}}
+
+    def merge_snapshot(self, snap: dict) -> None:
+        """Fold a child group snapshot in: counters and histograms add,
+        gauges last-write-wins (the child wrote later than the parent's
+        pre-fork value by construction). All-or-nothing: histogram
+        bucket mismatches are detected by :meth:`check_snapshot` BEFORE
+        any key is folded, so a drifted snapshot never leaves the group
+        half-merged (counters updated, histograms not)."""
+        self.check_snapshot(snap)
+        for key, value in snap.get("gauges", {}).items():
+            with self._lock:
+                self._gauges[key] = value
+        for key, inc in snap.get("counters", {}).items():
+            with self._lock:
+                self._counters[key] = self._counters.get(key, 0) + int(inc)
+        for key, hsnap in snap.get("histograms", {}).items():
+            self.histogram(key, buckets=hsnap["buckets"]
+                           ).merge_snapshot(hsnap)
+
+    def check_snapshot(self, snap: dict) -> None:
+        """Raise ValueError if merging ``snap`` would fail (histogram
+        bucket drift against an existing series) — called before any
+        mutation so merges are all-or-nothing."""
+        for key, hsnap in snap.get("histograms", {}).items():
+            with self._lock:
+                existing = self._histograms.get(key)
+            if existing is not None and \
+                    tuple(hsnap.get("buckets", ())) != existing.buckets:
+                raise ValueError(
+                    f"histogram {key!r} bucket mismatch: "
+                    f"{hsnap.get('buckets')} vs {list(existing.buckets)}")
 
 
 class MetricsRegistry:
-    """Process-local metric registry; groups address as 'ml.model'."""
+    """Process-local metric registry; groups address as 'ml.model'.
+    Thread-safe: concurrent stages may create/write groups freely."""
 
     def __init__(self):
         self._groups: Dict[str, MetricGroup] = {}
+        self._lock = threading.Lock()
 
     def group(self, *path: str) -> MetricGroup:
         key = ".".join(path)
-        if key not in self._groups:
-            self._groups[key] = MetricGroup(key)
-        return self._groups[key]
+        with self._lock:
+            grp = self._groups.get(key)
+            if grp is None:
+                grp = self._groups[key] = MetricGroup(key)
+            return grp
 
     def model_group(self) -> MetricGroup:
         return self.group(ML_GROUP, MODEL_GROUP)
@@ -64,10 +205,40 @@ class MetricsRegistry:
                     timestamp_ms if timestamp_ms is not None
                     else int(time.time() * 1000))
 
-    def snapshot(self) -> Dict[str, Dict[str, Dict[str, float]]]:
-        return {name: {"gauges": dict(g._gauges),
-                       "counters": dict(g._counters)}
-                for name, g in self._groups.items()}
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            groups = list(self._groups.items())
+        return {name: g.snapshot() for name, g in groups}
+
+    def merge(self, snapshot: Dict[str, dict]) -> None:
+        """Fold another registry's :meth:`snapshot` into this one — how
+        host-pool child registries reach the driver (common/hostpool.py
+        ships each child's snapshot back beside its shard result).
+        All-or-nothing: every group is validated before any is folded,
+        so a drifted snapshot is rejected whole, never half-merged."""
+        groups = [(self.group(*name.split(".")), gsnap)
+                  for name, gsnap in snapshot.items()]
+        for grp, gsnap in groups:
+            grp.check_snapshot(gsnap)
+        for grp, gsnap in groups:
+            grp.merge_snapshot(gsnap)
+
+    def clear(self) -> None:
+        """Drop every group (thread-safe; for same-process use — a
+        forked child must use :meth:`reseed_child` instead)."""
+        with self._lock:
+            self._groups.clear()
+
+    def reseed_child(self) -> None:
+        """Reset this registry in a freshly forked child WITHOUT touching
+        the inherited locks: a driver thread may have held
+        ``_lock`` (or any group's lock) at fork time, and that mutex now
+        has no owner thread in the child — acquiring it (as ``clear``
+        would) deadlocks until the host-pool deadline SIGKILLs the
+        worker. Post-fork the child is single-threaded, so plain
+        reassignment is safe."""
+        self._lock = threading.Lock()
+        self._groups = {}
 
 
 #: default process-wide registry
@@ -79,6 +250,7 @@ metrics = MetricsRegistry()
 PROFILE_DIR_ENV = "FLINK_ML_TPU_PROFILE_DIR"
 
 _trace_active = False  # jax.profiler allows one trace at a time
+_trace_lock = threading.Lock()  # guards the start/stop decision
 
 
 @contextlib.contextmanager
@@ -92,16 +264,34 @@ def profile(trace_dir: str = None, name: str = None):
     import jax
 
     start = time.perf_counter()
-    tracing = bool(trace_dir) and not _trace_active
+    tracing = False
+    if trace_dir:
+        # the check and the claim must be one atomic step: two concurrent
+        # stages racing here would otherwise both call start_trace
+        with _trace_lock:
+            if not _trace_active:
+                _trace_active = tracing = True
     if tracing:
-        jax.profiler.start_trace(trace_dir)
-        _trace_active = True
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except BaseException:
+            # roll the claim back: a failed start must not disable
+            # profiling for the rest of the process
+            with _trace_lock:
+                _trace_active = False
+            raise
     try:
         yield
     finally:
         if tracing:
-            jax.profiler.stop_trace()
-            _trace_active = False
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                # release the claim even when stop_trace raises (e.g. a
+                # full disk writing the trace) — symmetric with the
+                # start-path rollback above
+                with _trace_lock:
+                    _trace_active = False
         elapsed_ms = (time.perf_counter() - start) * 1000.0
         metrics.group(ML_GROUP).gauge("lastProfiledRegionMs", elapsed_ms)
         if name:

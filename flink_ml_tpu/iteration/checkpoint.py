@@ -32,6 +32,9 @@ import os
 import shutil
 from typing import Any, List, Optional, Tuple
 
+import time
+from typing import Dict
+
 import numpy as np
 
 import jax
@@ -39,6 +42,26 @@ import jax
 from flink_ml_tpu.resilience import faults
 
 logger = logging.getLogger(__name__)
+
+#: bucket bounds for checkpoint payload-size histograms (bytes)
+_BYTE_BUCKETS = tuple(4.0 ** i for i in range(4, 19))  # 256 B .. 64 GB
+
+
+def _ckpt_group():
+    from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+
+    return metrics.group(ML_GROUP, "checkpoint")
+
+
+def _observe(op: str, ms: float, nbytes: int) -> None:
+    """Record one save/restore into the ml.checkpoint histograms,
+    labeled by operation so both directions share one metric name."""
+    labels: Dict[str, str] = {"op": op}
+    group = _ckpt_group()
+    group.histogram("opMs", labels=labels).observe(ms)
+    group.histogram("opBytes", buckets=_BYTE_BUCKETS,
+                    labels=labels).observe(nbytes)
+    group.counter("ops", labels=labels)
 
 #: manifest schema: 1 = epoch + num_leaves only (legacy, still
 #: restorable); 2 = adds per-leaf {sha256, dtype, shape} integrity records
@@ -83,12 +106,28 @@ class CheckpointManager:
 
     # -- write ---------------------------------------------------------------
     def save(self, carry: Any, epoch: int) -> str:
+        from flink_ml_tpu.observability import tracing
+
+        start = time.perf_counter()
+        self._last_save_bytes = 0
+        with tracing.tracer.span("checkpoint.save", epoch=epoch) as sp:
+            ckpt_dir = self._save(carry, epoch, sp)
+        _observe("save", (time.perf_counter() - start) * 1000.0,
+                 self._last_save_bytes)
+        return ckpt_dir
+
+    def _save(self, carry: Any, epoch: int, sp) -> str:
         faults.inject("checkpoint-save", epoch=epoch)
         leaves, treedef = jax.tree_util.tree_flatten(carry)
         ckpt_dir = os.path.join(self.base_dir, f"ckpt-{epoch:08d}")
         tmp_dir = ckpt_dir + ".tmp"
         os.makedirs(tmp_dir, exist_ok=True)
         host_leaves = [np.asarray(x) for x in leaves]
+        # stashed on self (not read off the span): the histogram must see
+        # real bytes with the tracer disarmed too
+        self._last_save_bytes = int(sum(x.nbytes for x in host_leaves))
+        sp.set_attribute("bytes", self._last_save_bytes)
+        sp.set_attribute("leaves", len(host_leaves))
         leaves_path = os.path.join(tmp_dir, "leaves.npz")
         np.savez(leaves_path,
                  **{f"leaf_{i}": x for i, x in enumerate(host_leaves)})
@@ -161,6 +200,12 @@ class CheckpointManager:
         logger.warning(
             "corrupt checkpoint %s quarantined as %s (%s); falling back "
             "to the next-older checkpoint", ckpt_dir, target, reason)
+        from flink_ml_tpu.observability import tracing
+
+        _ckpt_group().counter("quarantined")
+        tracing.tracer.event("checkpoint.quarantine",
+                             checkpoint=os.path.basename(ckpt_dir),
+                             reason=reason)
 
     def _load_validated(self, ckpt_dir: str, expected_leaves: int
                         ) -> Tuple[List[np.ndarray], int]:
@@ -228,20 +273,33 @@ class CheckpointManager:
         onto the template's structure and shardings; corrupt checkpoints
         are quarantined (``ckpt-*.corrupt``) and skipped in favor of the
         next-older one. None if no valid checkpoint exists."""
+        from flink_ml_tpu.observability import tracing
+
+        start = time.perf_counter()
         t_leaves, treedef = jax.tree_util.tree_flatten(template_carry)
-        for name in reversed(self.list_checkpoints()):
-            ckpt_dir = os.path.join(self.base_dir, name)
-            try:
-                host_leaves, epoch = self._load_validated(
-                    ckpt_dir, len(t_leaves))
-            except CorruptCheckpoint as e:
-                self._quarantine(ckpt_dir, str(e))
-                continue
-            restored = []
-            for host, tmpl in zip(host_leaves, t_leaves):
-                if hasattr(tmpl, "sharding"):
-                    restored.append(jax.device_put(host, tmpl.sharding))
-                else:
-                    restored.append(host)
-            return jax.tree_util.tree_unflatten(treedef, restored), epoch
+        with tracing.tracer.span("checkpoint.restore") as sp:
+            for name in reversed(self.list_checkpoints()):
+                ckpt_dir = os.path.join(self.base_dir, name)
+                try:
+                    host_leaves, epoch = self._load_validated(
+                        ckpt_dir, len(t_leaves))
+                except CorruptCheckpoint as e:
+                    self._quarantine(ckpt_dir, str(e))
+                    continue
+                restored = []
+                for host, tmpl in zip(host_leaves, t_leaves):
+                    if hasattr(tmpl, "sharding"):
+                        restored.append(jax.device_put(host,
+                                                       tmpl.sharding))
+                    else:
+                        restored.append(host)
+                nbytes = int(sum(x.nbytes for x in host_leaves))
+                sp.set_attribute("epoch", epoch)
+                sp.set_attribute("checkpoint", name)
+                sp.set_attribute("bytes", nbytes)
+                _observe("restore",
+                         (time.perf_counter() - start) * 1000.0, nbytes)
+                return (jax.tree_util.tree_unflatten(treedef, restored),
+                        epoch)
+            sp.set_attribute("result", "fresh-start")
         return None

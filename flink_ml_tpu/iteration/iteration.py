@@ -158,6 +158,7 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
     K-grid (a snapshot from a different interval or mode) realigns at the
     first segment so later boundaries checkpoint on-grid again."""
     from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+    from flink_ml_tpu.observability import tracing
     iter_group = metrics.group(ML_GROUP, "iteration")
 
     import time as _time
@@ -172,19 +173,30 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
         # off-phase restore
         limit = min(epoch + K - epoch % K, max_iter)
         seg_start = _time.perf_counter()
-        carry, e, s = run_segment(carry, epoch, limit)
-        rounds = int(e) - epoch
-        epoch, stop = int(e), bool(s)
-        # chaos site: the segment boundary is this mode's epoch boundary
-        faults.inject("epoch-boundary", epoch=epoch)
-        if epoch % K == 0:
-            mgr.save(carry, epoch)
+        with tracing.tracer.span("segment", epoch_from=epoch,
+                                 epoch_to=limit) as sp:
+            carry, e, s = run_segment(carry, epoch, limit)
+            rounds = int(e) - epoch
+            epoch, stop = int(e), bool(s)
+            sp.set_attribute("rounds", rounds)
+            # chaos site: the segment boundary is this mode's epoch
+            # boundary
+            faults.inject("epoch-boundary", epoch=epoch)
+            if epoch % K == 0:
+                mgr.save(carry, epoch)
         # per-segment metrics: the host-sync boundary is already here, so
         # the counters cost no extra device round-trip
         seg_ms = (_time.perf_counter() - seg_start) * 1000.0
         iter_group.counter("rounds", rounds)
         iter_group.gauge("lastSegmentMs", seg_ms)
         iter_group.gauge("lastRoundMs", seg_ms / max(rounds, 1))
+        # histories survive the fit (last-value gauges don't): per-epoch
+        # duration distribution, labeled by execution mode
+        iter_group.histogram(
+            "epochMs", labels={"mode": "device-segment"}).observe(
+            seg_ms / max(rounds, 1))
+        iter_group.histogram(
+            "segmentMs", labels={"mode": "device-segment"}).observe(seg_ms)
     mgr.clear()
     return carry
 
@@ -277,7 +289,9 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
             return new_carry, stop
 
     from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+    from flink_ml_tpu.observability import tracing
     iter_group = metrics.group(ML_GROUP, "iteration")
+    mode_label = {"mode": "host"}
 
     carry = initial_carry
     start_epoch = 0
@@ -290,29 +304,40 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
     import time as _time
     for epoch in range(start_epoch, max_iter):
         round_start = _time.perf_counter()
-        if config.per_round_init is not None:
-            carry = config.per_round_init(carry, epoch)
-        carry, stop = round_fn(
-            carry, jnp.int32(epoch) if jit_round else epoch)
-        faults.inject("epoch-boundary", epoch=epoch)
-        # listeners/checkpoints run while the async-dispatched device round
-        # is still executing — host and device legs overlap
-        host_start = _time.perf_counter()
-        for lst in listeners:
-            lst.on_epoch_watermark_incremented(epoch, carry)
-        if mgr is not None and config.checkpoint_interval and \
-                (epoch + 1) % config.checkpoint_interval == 0:
-            mgr.save(carry, epoch + 1)
-        host_ms = (_time.perf_counter() - host_start) * 1000.0
-        stop = bool(stop)  # host sync point: device round now complete
-        # per-round wall time split: hostMs = listener/checkpoint work,
-        # deviceMs = dispatch + residual device wait after the overlap —
-        # the profiling surface the reference lacks (its per-round wrapper
-        # only feeds Flink's LatencyStats)
-        total_ms = (_time.perf_counter() - round_start) * 1000.0
+        with tracing.tracer.span("epoch", epoch=epoch) as sp:
+            if config.per_round_init is not None:
+                carry = config.per_round_init(carry, epoch)
+            carry, stop = round_fn(
+                carry, jnp.int32(epoch) if jit_round else epoch)
+            faults.inject("epoch-boundary", epoch=epoch)
+            # listeners/checkpoints run while the async-dispatched device
+            # round is still executing — host and device legs overlap
+            host_start = _time.perf_counter()
+            for lst in listeners:
+                lst.on_epoch_watermark_incremented(epoch, carry)
+            if mgr is not None and config.checkpoint_interval and \
+                    (epoch + 1) % config.checkpoint_interval == 0:
+                mgr.save(carry, epoch + 1)
+            host_ms = (_time.perf_counter() - host_start) * 1000.0
+            stop = bool(stop)  # host sync point: device round complete
+            # per-round wall time split: hostMs = listener/checkpoint
+            # work, deviceMs = dispatch + residual device wait after the
+            # overlap — the profiling surface the reference lacks (its
+            # per-round wrapper only feeds Flink's LatencyStats)
+            total_ms = (_time.perf_counter() - round_start) * 1000.0
+            sp.set_attribute("host_ms", round(host_ms, 3))
+            sp.set_attribute("device_ms", round(total_ms - host_ms, 3))
         iter_group.gauge("lastRoundMs", total_ms)
         iter_group.gauge("lastRoundHostMs", host_ms)
         iter_group.gauge("lastRoundDeviceMs", total_ms - host_ms)
+        # last-value gauges keep only the final epoch; the labeled
+        # histograms keep the whole fit's distribution
+        iter_group.histogram("epochMs", labels=mode_label).observe(
+            total_ms)
+        iter_group.histogram("epochHostMs", labels=mode_label).observe(
+            host_ms)
+        iter_group.histogram("epochDeviceMs", labels=mode_label).observe(
+            total_ms - host_ms)
         iter_group.counter("rounds")
         if stop:
             break

@@ -1,0 +1,40 @@
+"""Unified observability: span tracing, metric export, run inspection.
+
+See docs/observability.md. Arm with ``FLINK_ML_TPU_TRACE_DIR=<dir>``
+(spans + metric snapshots stream there as JSON artifacts) and inspect
+with ``flink-ml-tpu-trace <dir>``; composes with the
+``FLINK_ML_TPU_PROFILE_DIR`` jax.profiler hook (common/metrics.py)
+rather than replacing it.
+"""
+
+from flink_ml_tpu.observability.exporters import (
+    chrome_trace,
+    dump_metrics,
+    prometheus_text,
+    read_metrics,
+    read_spans,
+    write_chrome_trace,
+)
+from flink_ml_tpu.observability.tracing import (
+    TRACE_DIR_ENV,
+    Span,
+    Tracer,
+    event,
+    span,
+    tracer,
+)
+
+__all__ = [
+    "TRACE_DIR_ENV",
+    "Span",
+    "Tracer",
+    "chrome_trace",
+    "dump_metrics",
+    "event",
+    "prometheus_text",
+    "read_metrics",
+    "read_spans",
+    "span",
+    "tracer",
+    "write_chrome_trace",
+]

@@ -1,0 +1,214 @@
+"""``flink-ml-tpu-trace``: inspect a trace directory from artifacts alone.
+
+A failed or slow run leaves ``spans-*.jsonl`` + ``metrics-*.json`` under
+its ``FLINK_ML_TPU_TRACE_DIR``; this CLI answers "where did the time go,
+and did it recompile/retry/checkpoint more than it should?" without
+rerunning anything:
+
+    flink-ml-tpu-trace TRACE_DIR                 # summary (text)
+    flink-ml-tpu-trace TRACE_DIR --format json   # summary (machine)
+    flink-ml-tpu-trace TRACE_DIR --chrome t.json # Perfetto-loadable trace
+    flink-ml-tpu-trace TRACE_DIR --prometheus    # metrics text exposition
+    flink-ml-tpu-trace TRACE_DIR --check         # exit 2 on empty/invalid
+
+Sections: top spans by self-time (time in a span minus its children —
+where work actually happened), per-epoch breakdown (host/device split,
+checkpoints per epoch), and the checkpoint/retry timeline (saves,
+restores, quarantines, supervisor restarts, host-pool timeouts) in
+chronological order.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+from flink_ml_tpu.observability.exporters import (
+    prometheus_text,
+    read_metrics,
+    read_spans,
+    write_chrome_trace,
+)
+
+#: events that belong on the failure/recovery timeline
+TIMELINE_EVENTS = ("supervisor.restart", "supervisor.recovered",
+                   "checkpoint.quarantine", "hostpool.timeout")
+
+
+def _ms(us) -> float:
+    return round((us or 0) / 1000.0, 3)
+
+
+def summarize(spans: List[dict]) -> dict:
+    """Structured summary of a span list (the CLI's JSON output)."""
+    by_id = {sp["id"]: sp for sp in spans if sp.get("id")}
+    child_dur: Dict[str, int] = {}
+    children: Dict[str, List[dict]] = {}
+    for sp in spans:
+        parent = sp.get("parent")
+        if parent in by_id:
+            child_dur[parent] = (child_dur.get(parent, 0)
+                                 + (sp.get("dur_us") or 0))
+            children.setdefault(parent, []).append(sp)
+
+    # -- top spans by aggregate self-time, grouped by name -------------------
+    agg: Dict[str, dict] = {}
+    for sp in spans:
+        dur = sp.get("dur_us") or 0
+        self_us = max(0, dur - child_dur.get(sp.get("id"), 0))
+        row = agg.setdefault(sp.get("name", "?"),
+                             {"count": 0, "total_us": 0, "self_us": 0})
+        row["count"] += 1
+        row["total_us"] += dur
+        row["self_us"] += self_us
+    top = [{"name": name, "count": row["count"],
+            "total_ms": _ms(row["total_us"]),
+            "self_ms": _ms(row["self_us"])}
+           for name, row in agg.items()]
+    top.sort(key=lambda r: -r["self_ms"])
+
+    # -- per-epoch breakdown -------------------------------------------------
+    epochs = []
+    for sp in spans:
+        if sp.get("name") not in ("epoch", "segment"):
+            continue
+        attrs = sp.get("attrs", {})
+        ckpts = sum(1 for c in children.get(sp.get("id"), ())
+                    if str(c.get("name", "")).startswith("checkpoint."))
+        row = {"kind": sp["name"],
+               "epoch": attrs.get("epoch", attrs.get("epoch_to")),
+               "ms": _ms(sp.get("dur_us")),
+               "checkpoints": ckpts}
+        for key in ("host_ms", "device_ms", "rounds", "epoch_from",
+                    "epoch_to"):
+            if key in attrs:
+                row[key] = attrs[key]
+        epochs.append(row)
+    epochs.sort(key=lambda r: (r["epoch"] is None, r["epoch"]))
+
+    # -- checkpoint / retry timeline -----------------------------------------
+    timeline = []
+    for sp in spans:
+        if str(sp.get("name", "")).startswith("checkpoint."):
+            timeline.append({"ts_us": sp.get("ts_us", 0),
+                             "what": sp["name"],
+                             "ms": _ms(sp.get("dur_us")),
+                             "attrs": sp.get("attrs", {})})
+        for ev in sp.get("events", ()):
+            if ev.get("name") in TIMELINE_EVENTS:
+                timeline.append({"ts_us": ev.get("ts_us", 0),
+                                 "what": ev["name"],
+                                 "attrs": ev.get("attrs", {})})
+    timeline.sort(key=lambda r: r["ts_us"])
+
+    roots = [sp for sp in spans if sp.get("parent") not in by_id]
+    return {"spans": len(spans),
+            "traces": len({sp.get("trace") for sp in spans}),
+            "roots": [{"name": sp.get("name"),
+                       "ms": _ms(sp.get("dur_us"))} for sp in roots],
+            "top_self_time": top,
+            "epochs": epochs,
+            "timeline": timeline}
+
+
+def render_summary(summary: dict, top_n: int = 15) -> str:
+    out = [f"{summary['spans']} span(s) across "
+           f"{summary['traces']} trace(s)"]
+    for root in summary["roots"]:
+        out.append(f"  root: {root['name']}  {root['ms']} ms")
+
+    out.append("")
+    out.append("top spans by self-time:")
+    out.append(f"  {'name':<32} {'count':>6} {'total ms':>12} "
+               f"{'self ms':>12}")
+    for row in summary["top_self_time"][:top_n]:
+        out.append(f"  {row['name']:<32} {row['count']:>6} "
+                   f"{row['total_ms']:>12.3f} {row['self_ms']:>12.3f}")
+
+    if summary["epochs"]:
+        out.append("")
+        out.append("per-epoch breakdown:")
+        for row in summary["epochs"]:
+            extra = "".join(
+                f"  {k}={row[k]}" for k in
+                ("host_ms", "device_ms", "rounds") if k in row)
+            out.append(f"  {row['kind']} {row['epoch']}: "
+                       f"{row['ms']} ms  checkpoints={row['checkpoints']}"
+                       f"{extra}")
+
+    if summary["timeline"]:
+        out.append("")
+        out.append("checkpoint/retry timeline:")
+        t0 = summary["timeline"][0]["ts_us"]
+        for row in summary["timeline"]:
+            attrs = " ".join(f"{k}={v}"
+                             for k, v in row.get("attrs", {}).items())
+            ms = f" {row['ms']} ms" if "ms" in row else ""
+            out.append(f"  +{_ms(row['ts_us'] - t0):>10.3f} ms  "
+                       f"{row['what']}{ms}  {attrs}".rstrip())
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="flink-ml-tpu-trace",
+        description="Summarize a FLINK_ML_TPU_TRACE_DIR trace directory.")
+    parser.add_argument("trace_dir")
+    parser.add_argument("--chrome", metavar="OUT_JSON",
+                        help="also export a Chrome/Perfetto trace")
+    parser.add_argument("--prometheus", action="store_true",
+                        help="print the merged metrics snapshot in "
+                             "Prometheus text exposition format")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--top", type=int, default=15,
+                        help="rows in the self-time table")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 2 when the trace has no spans (CI "
+                             "smoke gate)")
+    args = parser.parse_args(argv)
+
+    try:
+        spans = read_spans(args.trace_dir)
+    except OSError as e:
+        print(f"flink-ml-tpu-trace: cannot read {args.trace_dir}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.check and not spans:
+        print(f"flink-ml-tpu-trace: no spans in {args.trace_dir}",
+              file=sys.stderr)
+        return 2
+
+    if args.chrome:
+        n = write_chrome_trace(args.trace_dir, args.chrome)
+        print(f"wrote {n} span(s) to {args.chrome}", file=sys.stderr)
+
+    if args.prometheus:
+        snap = read_metrics(args.trace_dir)
+        if not snap:
+            print("flink-ml-tpu-trace: no metric samples in "
+                  f"{args.trace_dir} — either no metrics-*.json snapshot "
+                  "was written (one lands when an outermost stage span "
+                  "closes) or the traced run recorded no metrics",
+                  file=sys.stderr)
+        print(prometheus_text(snap), end="")
+        return 0
+
+    summary = summarize(spans)
+    try:
+        if args.format == "json":
+            print(json.dumps(summary, indent=2, default=str))
+        else:
+            print(render_summary(summary, top_n=args.top))
+    except BrokenPipeError:  # `... | head` closed the pipe: not an error
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

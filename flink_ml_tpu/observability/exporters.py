@@ -1,0 +1,221 @@
+"""Trace/metrics exporters: JSONL span merge, Chrome trace-event JSON,
+Prometheus text exposition.
+
+Writers (observability/tracing.py) stream one ``spans-<pid>.jsonl`` per
+process into the trace dir; host-pool children add their own pid files.
+The readers here merge the whole directory — that merge IS the
+"collect" step of the fork-boundary design, so a trace survives any mix
+of parent/child crashes that left files behind.
+
+Chrome trace-event output loads in Perfetto / chrome://tracing: spans
+become complete (``ph: "X"``) events, span events become instants
+(``ph: "i"``). Prometheus output is the text exposition format
+(name{labels} value), rendered from a registry snapshot — the labeled
+key syntax in common/metrics.py is chosen so this is a string split,
+not a parser.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+from typing import Dict, List, Optional
+
+from flink_ml_tpu.common.metrics import MetricsRegistry, metrics
+
+#: metrics snapshot files in a trace dir (one per traced process)
+METRICS_GLOB = "metrics-*.json"
+SPANS_GLOB = "spans-*.jsonl"
+
+PROM_PREFIX = "flink_ml_tpu"
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+# -- span collection ---------------------------------------------------------
+def read_spans(trace_dir: str) -> List[dict]:
+    """All span records from every ``spans-*.jsonl`` in ``trace_dir``
+    (parent + forked children), in start-time order. Truncated trailing
+    lines (a process killed mid-write) are skipped, not fatal — a trace
+    from a crashed run is exactly when this reader matters most."""
+    records: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(trace_dir, SPANS_GLOB))):
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("type") == "span":
+                    records.append(rec)
+    records.sort(key=lambda r: (r.get("ts_us", 0), r.get("id", "")))
+    return records
+
+
+# -- Chrome trace-event format ----------------------------------------------
+def chrome_trace_events(spans: List[dict]) -> List[dict]:
+    events: List[dict] = []
+    for sp in spans:
+        args = dict(sp.get("attrs", {}))
+        args["span_id"] = sp.get("id")
+        if sp.get("parent"):
+            args["parent_id"] = sp["parent"]
+        events.append({
+            "name": sp.get("name", "?"),
+            "cat": "span",
+            "ph": "X",
+            "ts": sp.get("ts_us", 0),
+            "dur": sp.get("dur_us") or 0,
+            "pid": sp.get("pid", 0),
+            "tid": sp.get("tid", 0),
+            "args": args,
+        })
+        for ev in sp.get("events", ()):
+            events.append({
+                "name": ev.get("name", "?"),
+                "cat": "event",
+                "ph": "i",
+                "s": "t",  # thread-scoped instant
+                "ts": ev.get("ts_us", sp.get("ts_us", 0)),
+                "pid": sp.get("pid", 0),
+                "tid": sp.get("tid", 0),
+                "args": dict(ev.get("attrs", {})),
+            })
+    return events
+
+
+def chrome_trace(trace_dir: str) -> dict:
+    """Perfetto-loadable JSON object for a whole trace directory."""
+    return {"traceEvents": chrome_trace_events(read_spans(trace_dir)),
+            "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(trace_dir: str, out_path: str) -> int:
+    """Write the merged Chrome trace; returns the number of span records
+    exported."""
+    doc = chrome_trace(trace_dir)
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    return sum(1 for e in doc["traceEvents"] if e["ph"] == "X")
+
+
+# -- metrics snapshots in the trace dir --------------------------------------
+def dump_metrics(trace_dir: str,
+                 registry: MetricsRegistry = metrics) -> str:
+    """Write the registry snapshot as ``metrics-<pid>.json`` (overwrite:
+    the newest snapshot per process supersedes earlier ones)."""
+    os.makedirs(trace_dir, exist_ok=True)
+    path = os.path.join(trace_dir, f"metrics-{os.getpid()}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(registry.snapshot(), f, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def read_metrics(trace_dir: str) -> Dict[str, dict]:
+    """Merge every ``metrics-*.json`` in the dir into one snapshot."""
+    merged = MetricsRegistry()
+    for path in sorted(glob.glob(os.path.join(trace_dir, METRICS_GLOB))):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                merged.merge(json.load(f))
+        except (OSError, json.JSONDecodeError, ValueError):
+            continue  # a torn snapshot must not sink the readable ones
+    return merged.snapshot()
+
+
+# -- Prometheus text exposition ----------------------------------------------
+def _prom_name(group: str, metric: str, suffix: str = "") -> str:
+    name = f"{PROM_PREFIX}_{group}_{metric}{suffix}".replace(".", "_")
+    return _NAME_OK.sub("_", name)
+
+
+def _split_labels(key: str):
+    """``name{k="v"}`` → (name, 'k="v"'); plain names → (key, '')."""
+    if "{" in key and key.endswith("}"):
+        name, _, rest = key.partition("{")
+        return name, rest[:-1]
+    return key, ""
+
+
+def _with_labels(name: str, labels: str, extra: str = "") -> str:
+    inner = ",".join(x for x in (labels, extra) if x)
+    return f"{name}{{{inner}}}" if inner else name
+
+
+def _fmt(value) -> str:
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _series_by_name(entries: Dict[str, object]):
+    """Group ``key -> value`` (key possibly labeled) by bare metric name:
+    name → [(labels, value), ...] — one exposition family per name (the
+    text format allows exactly one ``# TYPE`` line per metric name, so
+    labeled series of one metric must render under a single header)."""
+    by_name: Dict[str, List] = {}
+    for key in sorted(entries):
+        name, labels = _split_labels(key)
+        by_name.setdefault(name, []).append((labels, entries[key]))
+    return by_name
+
+
+def prometheus_text(snapshot: Optional[Dict[str, dict]] = None) -> str:
+    """Render a registry snapshot (default: the live process registry) in
+    the Prometheus text exposition format, histograms as cumulative
+    ``_bucket{le=...}`` series plus ``_sum``/``_count``."""
+    if snapshot is None:
+        snapshot = metrics.snapshot()
+    lines: List[str] = []
+    for group in sorted(snapshot):
+        gsnap = snapshot[group]
+        for name, series in _series_by_name(
+                gsnap.get("gauges", {})).items():
+            prom = _prom_name(group, name)
+            lines.append(f"# TYPE {prom} gauge")
+            for labels, value in series:
+                lines.append(f"{_with_labels(prom, labels)} "
+                             f"{_fmt(value)}")
+        for name, series in _series_by_name(
+                gsnap.get("counters", {})).items():
+            prom = _prom_name(group, name, "_total")
+            lines.append(f"# TYPE {prom} counter")
+            for labels, value in series:
+                lines.append(f"{_with_labels(prom, labels)} "
+                             f"{_fmt(value)}")
+        for name, series in _series_by_name(
+                gsnap.get("histograms", {})).items():
+            prom = _prom_name(group, name)
+            lines.append(f"# TYPE {prom} histogram")
+            for labels, hist in series:
+                # counts are already cumulative (metrics.Histogram)
+                for bound, cnt in zip(hist["buckets"], hist["counts"]):
+                    lines.append(
+                        f"{_with_labels(prom + '_bucket', labels, _le(bound))}"
+                        f" {_fmt(cnt)}")
+                lines.append(
+                    f"{_with_labels(prom + '_bucket', labels, _le(math.inf))}"
+                    f" {_fmt(hist['count'])}")
+                lines.append(f"{_with_labels(prom + '_sum', labels)} "
+                             f"{_fmt(hist['sum'])}")
+                lines.append(f"{_with_labels(prom + '_count', labels)} "
+                             f"{_fmt(hist['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+def _le(bound: float) -> str:
+    return f'le="{_fmt(bound)}"'

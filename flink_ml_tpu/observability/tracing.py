@@ -1,0 +1,303 @@
+"""Span-based tracing: trace/span ids, parent links, attributes, events.
+
+The reference delegates run visibility to Flink's web UI (SURVEY.md §5);
+here the runtime is this process, so the trace is a first-class artifact:
+every instrumented seam (api/stage.py fit/transform, the iteration epoch
+loop, checkpoint save/restore, the host pool, the resilience supervisor,
+the benchmark runner) opens a :class:`Span` through the process-wide
+:data:`tracer`, and finished spans stream to JSON-lines files under
+``FLINK_ML_TPU_TRACE_DIR`` — one file per process, merged by the readers
+(observability/exporters.py, the ``flink-ml-tpu-trace`` CLI).
+
+Context propagation is thread-local (a span opened on one thread never
+parents a span on another), and survives the host-pool ``os.fork``
+boundary: the parent's current span rides into the child through the
+fork, :func:`Tracer.reseed_child` freezes it as a remote parent link and
+points the child's sink at its own ``spans-<pid>.jsonl``, so child spans
+nest under the dispatching parent span when the files are merged at
+collect time.
+
+When no trace dir is armed (env or :meth:`Tracer.configure`), ``span``
+returns a shared no-op context manager — one dict lookup of overhead —
+so the instrumentation stays compiled into production paths, same policy
+as resilience.faults.
+
+This composes with (does not replace) the ``FLINK_ML_TPU_PROFILE_DIR``
+jax.profiler hook: the profiler captures device/XLA internals, the
+tracer captures the host-side structure around them.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: env var holding a directory; when set, instrumented seams emit spans
+#: as ``spans-<pid>.jsonl`` files there (docs/observability.md)
+TRACE_DIR_ENV = "FLINK_ML_TPU_TRACE_DIR"
+
+_id_counter = itertools.count(1)
+_id_lock = threading.Lock()
+
+
+def _new_id() -> str:
+    """Process-unique span/trace id: pid + monotonic counter. ids only
+    need to be unique within one trace dir; embedding the pid keeps
+    forked children (which inherit the counter) from colliding."""
+    with _id_lock:
+        n = next(_id_counter)
+    return f"{os.getpid():x}-{n:x}"
+
+
+class Span:
+    """One timed region. ``ts_us`` is wall-clock epoch microseconds (what
+    Chrome trace-event ``ts`` wants); duration is measured on the
+    monotonic clock."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "ts_us",
+                 "dur_us", "attrs", "events", "_t0")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: Optional[str], attrs: Dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.ts_us = time.time_ns() // 1000
+        self.dur_us = None
+        self.attrs = dict(attrs)
+        self.events: List[dict] = []
+        self._t0 = time.perf_counter_ns()
+
+    def set_attribute(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def add_event(self, name: str, **attrs) -> None:
+        self.events.append({"name": name,
+                            "ts_us": time.time_ns() // 1000,
+                            "attrs": attrs})
+
+    def finish(self) -> None:
+        self.dur_us = (time.perf_counter_ns() - self._t0) // 1000
+
+    def to_record(self, pid: int, tid: int) -> dict:
+        return {"type": "span", "name": self.name,
+                "trace": self.trace_id, "id": self.span_id,
+                "parent": self.parent_id, "ts_us": self.ts_us,
+                "dur_us": self.dur_us, "pid": pid, "tid": tid,
+                "attrs": self.attrs, "events": self.events}
+
+
+class _NoopSpan:
+    """Shared do-nothing span/context-manager for the disarmed tracer."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attribute(self, key, value):
+        pass
+
+    def add_event(self, name, **attrs):
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _ActiveSpan:
+    """Context manager pairing a real Span with its tracer."""
+
+    __slots__ = ("_tracer", "span")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self.span = span
+
+    def __enter__(self):
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.span.set_attribute("error", exc_type.__name__)
+        self._tracer._end(self.span)
+        return False
+
+
+class Tracer:
+    """Process-wide tracer with thread-local context propagation."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._configured_dir: Optional[str] = None
+        self._sink = None           # open file handle, lazily created
+        self._sink_pid = None       # pid the sink belongs to (fork guard)
+        self._sink_path = None      # path it writes (re-arm guard)
+        self._sink_lock = threading.Lock()
+        # a frozen (trace_id, span_id) parent inherited across fork
+        self._remote_parent = None
+
+    # -- arming --------------------------------------------------------------
+    @property
+    def trace_dir(self) -> Optional[str]:
+        return self._configured_dir or os.environ.get(TRACE_DIR_ENV)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.trace_dir)
+
+    def configure(self, trace_dir: Optional[str]) -> None:
+        """Programmatic arming (tests, embedding); ``None`` reverts to
+        the environment."""
+        self.shutdown()
+        self._configured_dir = trace_dir
+
+    def shutdown(self) -> None:
+        """Close the sink (spans already written stay on disk)."""
+        with self._sink_lock:
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                except OSError:
+                    pass
+                self._sink = None
+                self._sink_pid = None
+        self._configured_dir = None
+
+    # -- context -------------------------------------------------------------
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def current(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def span(self, name: str, **attrs):
+        """Open a span under the current one (or as a new trace root).
+        Use as a context manager; yields the :class:`Span`."""
+        if not self.enabled:
+            return _NOOP
+        stack = self._stack()
+        if stack:
+            parent = stack[-1]
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif self._remote_parent is not None:
+            trace_id, parent_id = self._remote_parent
+        else:
+            trace_id, parent_id = _new_id(), None
+        sp = Span(name, trace_id, _new_id(), parent_id, attrs)
+        stack.append(sp)
+        return _ActiveSpan(self, sp)
+
+    def event(self, name: str, **attrs) -> None:
+        """Record an instant event on the current span; with no span
+        open, emit a standalone zero-duration span carrying it — the
+        event must reach the trace either way (a supervisor restart
+        outside any fit still matters)."""
+        if not self.enabled:
+            return
+        cur = self.current()
+        if cur is not None:
+            cur.add_event(name, **attrs)
+            return
+        with self.span(f"event:{name}") as sp:
+            sp.add_event(name, **attrs)
+
+    def _end(self, sp: Span) -> None:
+        sp.finish()
+        stack = self._stack()
+        if stack and stack[-1] is sp:
+            stack.pop()
+        else:  # out-of-order exit: drop it from wherever it sits
+            try:
+                stack.remove(sp)
+            except ValueError:
+                pass
+        self._write(sp)
+
+    # -- sink ----------------------------------------------------------------
+    def span_file(self) -> Optional[str]:
+        d = self.trace_dir
+        if not d:
+            return None
+        return os.path.join(d, f"spans-{os.getpid()}.jsonl")
+
+    def _write(self, sp: Span) -> None:
+        path = self.span_file()
+        if path is None:
+            return
+        record = sp.to_record(os.getpid(), threading.get_ident())
+        line = json.dumps(record, default=str) + "\n"
+        with self._sink_lock:
+            if self._sink is not None and self._sink_pid != os.getpid():
+                # forked child inherited the parent's handle: abandon it
+                # (closing could flush into the parent's file)
+                self._sink = None
+            elif self._sink is not None and self._sink_path != path:
+                # the trace dir was re-armed mid-process: follow it
+                try:
+                    self._sink.close()
+                except OSError:
+                    pass
+                self._sink = None
+            if self._sink is None:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                self._sink = open(path, "a", encoding="utf-8")
+                self._sink_pid = os.getpid()
+                self._sink_path = path
+            self._sink.write(line)
+            self._sink.flush()  # line-per-span: nothing buffered at fork
+                                # or os._exit time
+
+    # -- fork boundary -------------------------------------------------------
+    def reseed_child(self) -> None:
+        """Called in a freshly forked host-pool child: freeze the
+        inherited current span as a remote parent link, drop the
+        inherited context/sink, and point writes at this pid's own span
+        file. The child's spans then merge under the dispatching parent
+        span at collect time."""
+        cur = self.current()
+        self._remote_parent = ((cur.trace_id, cur.span_id)
+                               if cur is not None else None)
+        self._tls = threading.local()
+        self._sink = None
+        self._sink_pid = None
+        self._sink_path = None
+        self._sink_lock = threading.Lock()
+
+
+#: default process-wide tracer
+tracer = Tracer()
+
+
+def span(name: str, **attrs):
+    """Module-level convenience: ``tracer.span`` on the default tracer."""
+    return tracer.span(name, **attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Module-level convenience: ``tracer.event`` on the default tracer."""
+    tracer.event(name, **attrs)
+
+
+def maybe_dump_root_metrics() -> None:
+    """Snapshot the process registry into the trace dir when the tracer
+    is armed and no span remains open (an outermost span just closed) —
+    the shared tail of every instrumented entry point (stage wrappers,
+    the benchmark runner), so the trace dir is inspectable without the
+    process."""
+    if tracer.enabled and tracer.current() is None:
+        from flink_ml_tpu.observability.exporters import dump_metrics
+
+        dump_metrics(tracer.trace_dir)

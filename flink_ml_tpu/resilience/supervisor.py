@@ -97,8 +97,13 @@ def run_supervised(fn: Callable[[], object],
                 "supervised run failed (%s: %s); restart %d/%d in %.3gs",
                 type(e).__name__, e, attempt, policy.max_restarts, delay)
             _notify(listeners, "on_restart", attempt, e)
+            from flink_ml_tpu.observability import tracing
+
+            tracing.tracer.event("supervisor.restart", attempt=attempt,
+                                 error=type(e).__name__, detail=str(e))
             group.counter("restarts")
             group.gauge("lastBackoffMs", delay * 1000.0)
+            group.histogram("backoffMs").observe(delay * 1000.0)
             if mgr is not None and hasattr(mgr, "sweep_orphans"):
                 # a crash between makedirs and the atomic rename leaves a
                 # ckpt-*.tmp corpse; clear it before the next attempt
@@ -108,6 +113,9 @@ def run_supervised(fn: Callable[[], object],
             continue
         if attempt:
             _notify(listeners, "on_recovered", attempt)
+            from flink_ml_tpu.observability import tracing
+
+            tracing.tracer.event("supervisor.recovered", attempt=attempt)
             group.counter("recoveries")
             logger.info("supervised run recovered after %d restart(s)",
                         attempt)

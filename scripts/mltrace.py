@@ -1,0 +1,16 @@
+"""Trace-inspection CLI shim — see flink_ml_tpu.observability.cli (the
+real entry point, also installed as ``flink-ml-tpu-trace``) and
+docs/observability.md. Kept here so CI and developers can inspect a
+FLINK_ML_TPU_TRACE_DIR from a checkout without installing the package."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from flink_ml_tpu.observability.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""NEGATIVE: recording at the host boundary around the traced call (the
+iteration-runtime pattern), and jit-legal numeric lookalikes inside."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from flink_ml_tpu.common.metrics import metrics
+
+
+@jax.jit
+def train_step(w, g):
+    # jnp.histogram is math, not metric recording — must stay silent
+    counts, _edges = jnp.histogram(g, bins=4)
+    return w - 0.1 * g, counts
+
+
+def fit(w, g, rounds):
+    group = metrics.group("ml", "iteration")
+    for epoch in range(rounds):
+        start = time.perf_counter()
+        w, _ = train_step(w, g)
+        # host boundary: records every epoch — must stay silent
+        group.histogram("epochMs").observe(
+            (time.perf_counter() - start) * 1000.0)
+    group.counter("fits")
+    return w

@@ -1,0 +1,19 @@
+"""POSITIVE: metric recording inside a jit-decorated body — the counter
+increments once at trace time, never per compiled step."""
+
+import jax
+import jax.numpy as jnp
+
+from flink_ml_tpu.common.metrics import metrics
+
+
+@jax.jit
+def train_step(w, g):
+    metrics.group("ml").counter("steps")  # must fire: traced once
+    return w - 0.1 * g
+
+
+def loop(w, g):
+    for _ in range(100):
+        w = train_step(w, g)
+    return jnp.sum(w)

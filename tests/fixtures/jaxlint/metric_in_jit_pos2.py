@@ -1,0 +1,29 @@
+"""POSITIVE: tracer span + histogram observe inside a call-site-jitted /
+shard_mapped body — spans measure tracing, not execution."""
+
+import jax
+from jax.experimental.shard_map import shard_map
+
+from flink_ml_tpu.common.metrics import metrics
+from flink_ml_tpu.observability import tracing
+
+tracer = tracing.tracer
+epoch_hist = metrics.group("ml", "iteration").histogram("epochMs")
+
+
+def round_body(carry, epoch):
+    with tracer.span("round", epoch=epoch):  # must fire
+        new_carry = carry * 2
+    epoch_hist.observe(1.0)  # must fire
+    return new_carry
+
+
+round_fn = jax.jit(round_body)
+
+
+def per_shard(xl):
+    tracing.event("shard")  # must fire
+    return xl.sum()
+
+
+sharded = shard_map(per_shard, mesh=None, in_specs=None, out_specs=None)

@@ -35,6 +35,7 @@ RULE_OF_PREFIX = {
     "host_sync": "host-sync",
     "native_contract": "native-contract",
     "alias_mutation": "alias-mutation",
+    "metric_in_jit": "metric-in-jit",
 }
 
 
@@ -57,7 +58,7 @@ def _run_cli(*args):
 
 def test_fixture_inventory_covers_all_rules():
     """>= 2 positive + >= 1 negative fixture per rule class (acceptance
-    criterion), and the registry has exactly the six shipped rules."""
+    criterion), and the registry has exactly the shipped rules."""
     assert set(all_rules()) == set(RULE_OF_PREFIX.values())
     pos, neg = _fixtures("pos"), _fixtures("neg")
     for rule in RULE_OF_PREFIX.values():
