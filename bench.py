@@ -196,6 +196,14 @@ def _worker(role: str) -> int:
                         "totalTimeMs": round(best["totalTimeMs"], 1),
                         "inputThroughput": round(best["inputThroughput"],
                                                  1),
+                        # compile/steady split (docs/observability.md):
+                        # what the excluded warmup paid, and whether the
+                        # measured run recompiled anything (should be 0)
+                        "warmupCompileMs": round(
+                            best.get("warmupCompileTimeMs", 0.0), 1),
+                        "warmupCompileCount": best.get(
+                            "warmupCompileCount", 0),
+                        "steadyCompileCount": best.get("compileCount", 0),
                     }
                     if "executionPath" in best:
                         out[name]["executionPath"] = best["executionPath"]
@@ -219,6 +227,14 @@ def _worker(role: str) -> int:
         "vs_baseline": ratio,
         "platform": ("cpu-fallback" if role == "cpu"
                      else jax.default_backend()),
+        # compile/steady split: the warmup's compile bill (excluded from
+        # the measured number, as the JVM baseline excludes JIT warmup)
+        # and the measured run's own compile count, which should be 0 —
+        # captured here so an unattended TPU window records compile
+        # behavior without anyone watching (docs/observability.md)
+        "warmup_compile_ms": round(best.get("warmupCompileTimeMs", 0.0), 1),
+        "warmup_compile_count": best.get("warmupCompileCount", 0),
+        "steady_compile_count": best.get("compileCount", 0),
     }
     if role == "cpu":
         # a host-CPU demo beating the README sample says nothing about

@@ -32,12 +32,18 @@ def _profiled(method, kind: str):
     ``FLINK_ML_TPU_TRACE_DIR`` opens a tracer span (host-side structure:
     fit→epoch→checkpoint nesting, docs/observability.md). Two env checks
     of overhead when both are off. Traces nest safely: a Pipeline's
-    stages inside the pipeline trace record wall-time gauges only."""
+    stages inside the pipeline trace record wall-time gauges only.
+
+    A traced fit also arms compile telemetry: the jax.monitoring
+    subscription (compile counts/durations land in ``ml.compile``), a
+    recompile-storm window scoped to the outermost stage call, and a
+    device-memory watermark sampled as the ROOT span closes (no-op on
+    CPU) — so peak HBM per fit is on the root span itself."""
 
     @functools.wraps(method)
     def wrapper(self, *args, **kwargs):
         from flink_ml_tpu.common.metrics import PROFILE_DIR_ENV, profile
-        from flink_ml_tpu.observability import tracing
+        from flink_ml_tpu.observability import compilestats, tracing
 
         trace_dir = os.environ.get(PROFILE_DIR_ENV)
         tracer = tracing.tracer
@@ -46,13 +52,19 @@ def _profiled(method, kind: str):
         region = f"{type(self).__name__}.{kind}"
         try:
             with contextlib.ExitStack() as stack:
+                sp = None
                 if tracer.enabled:
-                    stack.enter_context(tracer.span(
+                    compilestats.install()
+                    sp = stack.enter_context(tracer.span(
                         region, kind=kind, stage=type(self).__name__))
+                    stack.enter_context(compilestats.fit_window())
                 if trace_dir:
                     stack.enter_context(profile(
                         os.path.join(trace_dir, region), name=region))
-                return method(self, *args, **kwargs)
+                result = method(self, *args, **kwargs)
+                if sp is not None and sp.parent_id is None:
+                    compilestats.sample_memory(f"root:{kind}", span=sp)
+                return result
         finally:
             # an outermost stage (not one nested in a Pipeline) closing
             # its root span snapshots the registry beside the spans

@@ -70,15 +70,31 @@ def load_config(path: str) -> dict:
 def run_benchmark(name: str, spec: dict) -> dict:
     """One named benchmark; with FLINK_ML_TPU_TRACE_DIR armed the whole
     run is a span (datagen + fit/transform + materialization nested
-    inside), so a BENCH sweep leaves an inspectable trace per row."""
-    from flink_ml_tpu.observability import tracing
+    inside), so a BENCH sweep leaves an inspectable trace per row.
 
+    Every run also carries its compile accounting: ``compileCount`` /
+    ``compileTimeMs`` are the XLA compiles this run triggered (the
+    jax.monitoring delta across the run — 0 on a warm cache), so sweep
+    rows separate compile from steady-state without anyone watching."""
+    from flink_ml_tpu.observability import compilestats, tracing
+
+    # with monitoring available the phase channel sees every compile in
+    # the run; without it, only instrumented functions are visible. The
+    # delta must subtract within ONE source — mixing them can go negative
+    source = "phase" if compilestats.install() else "perfn"
     with tracing.tracer.span("benchmark.run", benchmark=name,
                              stage=spec["stage"]["className"]) as sp:
+        before = compilestats.compile_totals_split()[source]
         result = _run_benchmark(name, spec)
+        after = compilestats.compile_totals_split()[source]
+        result["compileCount"] = after["count"] - before["count"]
+        result["compileTimeMs"] = round(
+            after["timeMs"] - before["timeMs"], 3)
         sp.set_attribute("totalTimeMs", round(result["totalTimeMs"], 3))
         sp.set_attribute("inputThroughput",
                          round(result["inputThroughput"], 1))
+        sp.set_attribute("compileCount", result["compileCount"])
+        sp.set_attribute("compileTimeMs", result["compileTimeMs"])
     tracing.maybe_dump_root_metrics()
     return result
 
@@ -179,13 +195,23 @@ def _table_bytes(table) -> int:
 def best_of(name: str, spec: dict, runs: int = 3) -> dict:
     """The measurement protocol every published number uses: one identical
     warmup run (XLA compile excluded — the JVM baseline's steady state
-    excludes JIT warmup too), then best inputThroughput of ``runs``."""
-    run_benchmark(name, spec)
+    excludes JIT warmup too), then best inputThroughput of ``runs``.
+
+    The warmup's compile accounting rides on the returned best row as
+    the compile/steady split: ``warmupTimeMs`` / ``warmupCompileTimeMs``
+    / ``warmupCompileCount`` say what the excluded warmup actually paid,
+    and the best run's own ``compileCount`` should be ~0 — a nonzero
+    steady-state compile count is itself a recompile signal worth a look
+    with the storm detector (docs/observability.md)."""
+    warmup = run_benchmark(name, spec)
     best = None
     for _ in range(runs):
         r = run_benchmark(name, spec)
         if best is None or r["inputThroughput"] > best["inputThroughput"]:
             best = r
+    best["warmupTimeMs"] = round(warmup["totalTimeMs"], 3)
+    best["warmupCompileTimeMs"] = warmup.get("compileTimeMs", 0.0)
+    best["warmupCompileCount"] = warmup.get("compileCount", 0)
     return best
 
 

@@ -84,6 +84,10 @@ class Histogram:
                     "counts": list(self.counts),
                     "sum": self.sum, "count": self.count}
 
+    def quantile(self, q: float) -> float:
+        """Estimated ``q`` quantile (see :func:`histogram_quantile`)."""
+        return histogram_quantile(self.snapshot(), q)
+
     def merge_snapshot(self, snap: dict) -> None:
         """Fold a child histogram snapshot in (bucket bounds must match —
         both sides derive them from the same instrumentation site)."""
@@ -96,6 +100,30 @@ class Histogram:
                 self.counts[i] += int(c)
             self.sum += float(snap["sum"])
             self.count += int(snap["count"])
+
+
+def histogram_quantile(snapshot: dict, q: float) -> float:
+    """Estimate the ``q`` (0..1) quantile from a cumulative-bucket
+    histogram snapshot — Prometheus ``histogram_quantile`` semantics:
+    linear interpolation within the winning bucket (from 0 below the
+    first bound), observations past the last finite bound clamp to it.
+    NaN for an empty histogram — the artifact-diff tooling
+    (observability/diff.py) must distinguish 'no samples' from 0."""
+    total = int(snapshot.get("count", 0))
+    if total <= 0:
+        return float("nan")
+    target = q * total
+    buckets = snapshot.get("buckets", ())
+    counts = snapshot.get("counts", ())
+    prev_count, prev_bound = 0, 0.0
+    for bound, count in zip(buckets, counts):
+        if count >= target:
+            if count == prev_count:
+                return float(bound)
+            frac = (target - prev_count) / (count - prev_count)
+            return prev_bound + (float(bound) - prev_bound) * frac
+        prev_count, prev_bound = count, float(bound)
+    return float(buckets[-1]) if buckets else float("nan")
 
 
 class MetricGroup:

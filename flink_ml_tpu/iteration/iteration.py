@@ -158,7 +158,7 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
     K-grid (a snapshot from a different interval or mode) realigns at the
     first segment so later boundaries checkpoint on-grid again."""
     from flink_ml_tpu.common.metrics import ML_GROUP, metrics
-    from flink_ml_tpu.observability import tracing
+    from flink_ml_tpu.observability import compilestats, tracing
     iter_group = metrics.group(ML_GROUP, "iteration")
 
     import time as _time
@@ -184,6 +184,11 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
             faults.inject("epoch-boundary", epoch=epoch)
             if epoch % K == 0:
                 mgr.save(carry, epoch)
+            if tracing.tracer.enabled:
+                # HBM watermark at the segment boundary (the host-sync
+                # point, so the sample costs no extra device round-trip;
+                # silent no-op on CPU)
+                compilestats.sample_memory("segment", span=sp)
         # per-segment metrics: the host-sync boundary is already here, so
         # the counters cost no extra device round-trip
         seg_ms = (_time.perf_counter() - seg_start) * 1000.0
@@ -289,7 +294,7 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
             return new_carry, stop
 
     from flink_ml_tpu.common.metrics import ML_GROUP, metrics
-    from flink_ml_tpu.observability import tracing
+    from flink_ml_tpu.observability import compilestats, tracing
     iter_group = metrics.group(ML_GROUP, "iteration")
     mode_label = {"mode": "host"}
 
@@ -327,6 +332,10 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
             total_ms = (_time.perf_counter() - round_start) * 1000.0
             sp.set_attribute("host_ms", round(host_ms, 3))
             sp.set_attribute("device_ms", round(total_ms - host_ms, 3))
+            if tracing.tracer.enabled:
+                # per-epoch HBM watermark, taken after the stop-bit sync
+                # so the round's allocations are visible (no-op on CPU)
+                compilestats.sample_memory("epoch", span=sp)
         iter_group.gauge("lastRoundMs", total_ms)
         iter_group.gauge("lastRoundHostMs", host_ms)
         iter_group.gauge("lastRoundDeviceMs", total_ms - host_ms)

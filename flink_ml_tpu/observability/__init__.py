@@ -1,12 +1,24 @@
-"""Unified observability: span tracing, metric export, run inspection.
+"""Unified observability: span tracing, metric export, run inspection,
+compile & device telemetry.
 
 See docs/observability.md. Arm with ``FLINK_ML_TPU_TRACE_DIR=<dir>``
 (spans + metric snapshots stream there as JSON artifacts) and inspect
-with ``flink-ml-tpu-trace <dir>``; composes with the
+with ``flink-ml-tpu-trace <dir>``; compare/gate two runs with
+``flink-ml-tpu-trace diff A B --budget <pct>``. Composes with the
 ``FLINK_ML_TPU_PROFILE_DIR`` jax.profiler hook (common/metrics.py)
-rather than replacing it.
+rather than replacing it. Compile telemetry (``compilestats``) records
+XLA compile counts/durations, recompile storms, per-program FLOP/byte
+cost and HBM watermarks into the same artifact set.
 """
 
+from flink_ml_tpu.observability.compilestats import (
+    aot_compile,
+    capture_cost,
+    compile_stats,
+    compile_totals,
+    instrumented_jit,
+    sample_memory,
+)
 from flink_ml_tpu.observability.exporters import (
     chrome_trace,
     dump_metrics,
@@ -28,12 +40,18 @@ __all__ = [
     "TRACE_DIR_ENV",
     "Span",
     "Tracer",
+    "aot_compile",
+    "capture_cost",
     "chrome_trace",
+    "compile_stats",
+    "compile_totals",
     "dump_metrics",
     "event",
+    "instrumented_jit",
     "prometheus_text",
     "read_metrics",
     "read_spans",
+    "sample_memory",
     "span",
     "tracer",
     "write_chrome_trace",

@@ -10,12 +10,16 @@ rerunning anything:
     flink-ml-tpu-trace TRACE_DIR --chrome t.json # Perfetto-loadable trace
     flink-ml-tpu-trace TRACE_DIR --prometheus    # metrics text exposition
     flink-ml-tpu-trace TRACE_DIR --check         # exit 2 on empty/invalid
+    flink-ml-tpu-trace diff A B --budget 20      # regression gate (exit 4)
 
 Sections: top spans by self-time (time in a span minus its children —
 where work actually happened), per-epoch breakdown (host/device split,
 checkpoints per epoch), and the checkpoint/retry timeline (saves,
 restores, quarantines, supervisor restarts, host-pool timeouts) in
-chronological order.
+chronological order. The ``diff`` subcommand (observability/diff.py)
+compares two trace dirs or metrics snapshots — span self-time deltas,
+histogram-quantile deltas, compile-count deltas — and with ``--budget``
+exits 4 on a regression: CI's and the unattended TPU sweep's perf gate.
 """
 
 from __future__ import annotations
@@ -25,6 +29,7 @@ import json
 import sys
 from typing import Dict, List
 
+from flink_ml_tpu.observability.diff import aggregate_self_time
 from flink_ml_tpu.observability.exporters import (
     prometheus_text,
     read_metrics,
@@ -44,25 +49,14 @@ def _ms(us) -> float:
 def summarize(spans: List[dict]) -> dict:
     """Structured summary of a span list (the CLI's JSON output)."""
     by_id = {sp["id"]: sp for sp in spans if sp.get("id")}
-    child_dur: Dict[str, int] = {}
     children: Dict[str, List[dict]] = {}
     for sp in spans:
         parent = sp.get("parent")
         if parent in by_id:
-            child_dur[parent] = (child_dur.get(parent, 0)
-                                 + (sp.get("dur_us") or 0))
             children.setdefault(parent, []).append(sp)
 
     # -- top spans by aggregate self-time, grouped by name -------------------
-    agg: Dict[str, dict] = {}
-    for sp in spans:
-        dur = sp.get("dur_us") or 0
-        self_us = max(0, dur - child_dur.get(sp.get("id"), 0))
-        row = agg.setdefault(sp.get("name", "?"),
-                             {"count": 0, "total_us": 0, "self_us": 0})
-        row["count"] += 1
-        row["total_us"] += dur
-        row["self_us"] += self_us
+    agg = aggregate_self_time(spans)
     top = [{"name": name, "count": row["count"],
             "total_ms": _ms(row["total_us"]),
             "self_ms": _ms(row["self_us"])}
@@ -152,9 +146,19 @@ def render_summary(summary: dict, top_n: int = 15) -> str:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "diff":
+        # the regression gate lives in its own module; dispatch before
+        # argparse so `diff` never collides with a dir named "diff"
+        # (use ./diff to summarize such a directory)
+        from flink_ml_tpu.observability.diff import main as diff_main
+
+        return diff_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="flink-ml-tpu-trace",
-        description="Summarize a FLINK_ML_TPU_TRACE_DIR trace directory.")
+        description="Summarize a FLINK_ML_TPU_TRACE_DIR trace directory "
+                    "(or `diff A B [--budget PCT]` two of them).")
     parser.add_argument("trace_dir")
     parser.add_argument("--chrome", metavar="OUT_JSON",
                         help="also export a Chrome/Perfetto trace")

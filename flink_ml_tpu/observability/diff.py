@@ -1,0 +1,288 @@
+"""``mltrace diff``: compare two trace dirs (or metrics snapshots) and
+gate perf regressions from artifacts alone.
+
+A trace dir is the ``FLINK_ML_TPU_TRACE_DIR`` artifact set
+(``spans-*.jsonl`` + ``metrics-*.json``); a side may also be a single
+registry-snapshot JSON file (``observability.dump_metrics`` output, or a
+benchmark results file reduced to a snapshot). The diff reports:
+
+- **per-span-name self-time deltas** (span duration minus direct
+  children, aggregated by name — where work actually happened),
+- **histogram-quantile deltas** (q50/q90/q99 of every registry
+  histogram, labeled series kept apart),
+- **compile-count deltas** (the ``ml.compile`` counters, plus the
+  backend_compile total `compilestats` aggregates).
+
+``--budget <pct>`` turns the report into a regression gate: exit
+:data:`EXIT_BUDGET` (4) when side B regresses side A beyond the budget.
+Gated: per-span-name self-time (with a ``--min-ms`` absolute noise
+floor, default 5 ms — wall clocks jitter, sub-floor deltas never gate)
+and the total compile count (floor: +2 compiles). Histogram quantiles
+are reported but not gated — two honest runs jitter there by design.
+Exit codes: 0 within budget / no budget given, 2 unreadable or empty
+side, 4 budget exceeded — distinct so CI and the unattended TPU sweep
+can tell "regressed" from "broken artifacts".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional
+
+from flink_ml_tpu.common.metrics import histogram_quantile
+from flink_ml_tpu.observability.compilestats import (
+    compile_totals_from_snapshot,
+)
+from flink_ml_tpu.observability.exporters import read_metrics, read_spans
+
+EXIT_OK = 0
+EXIT_INVALID = 2
+#: the documented budget exit code (docs/observability.md)
+EXIT_BUDGET = 4
+
+QUANTILES = (0.5, 0.9, 0.99)
+
+#: default absolute self-time noise floor (ms) under which no span-level
+#: delta can gate, whatever its percentage
+DEFAULT_MIN_MS = 5.0
+
+#: compile-count gate floor: B must add at least this many compiles over
+#: A before the percentage budget can fire (one stray compile is noise)
+COMPILE_COUNT_FLOOR = 2
+
+
+# -- span aggregation (shared with cli.summarize) -----------------------------
+def aggregate_self_time(spans: List[dict]) -> Dict[str, dict]:
+    """``name → {count, total_us, self_us}`` where self-time is a span's
+    duration minus its direct children's — the quantity worth diffing
+    (total time double-counts every level of nesting)."""
+    by_id = {sp["id"]: sp for sp in spans if sp.get("id")}
+    child_dur: Dict[str, int] = {}
+    for sp in spans:
+        parent = sp.get("parent")
+        if parent in by_id:
+            child_dur[parent] = (child_dur.get(parent, 0)
+                                 + (sp.get("dur_us") or 0))
+    agg: Dict[str, dict] = {}
+    for sp in spans:
+        dur = sp.get("dur_us") or 0
+        row = agg.setdefault(sp.get("name", "?"),
+                             {"count": 0, "total_us": 0, "self_us": 0})
+        row["count"] += 1
+        row["total_us"] += dur
+        row["self_us"] += max(0, dur - child_dur.get(sp.get("id"), 0))
+    return agg
+
+
+# -- side loading -------------------------------------------------------------
+def load_side(path: str) -> dict:
+    """One diff side: a trace directory, or a metrics-snapshot JSON
+    file. Raises ValueError when the side holds no readable artifact —
+    an empty side must be EXIT_INVALID, never a vacuous 'no regression'."""
+    if os.path.isdir(path):
+        spans = read_spans(path)
+        snap = read_metrics(path)
+        if not spans and not snap:
+            raise ValueError(
+                f"{path}: no spans-*.jsonl or metrics-*.json artifacts")
+        return {"spans": aggregate_self_time(spans), "metrics": snap}
+    with open(path, "r", encoding="utf-8") as f:
+        snap = json.load(f)
+    if not isinstance(snap, dict) or not snap:
+        raise ValueError(f"{path}: not a metrics snapshot")
+    return {"spans": {}, "metrics": snap}
+
+
+# -- delta computation --------------------------------------------------------
+def _pct(a: float, b: float) -> Optional[float]:
+    if a <= 0:
+        return None if b <= 0 else math.inf
+    return (b - a) / a * 100.0
+
+
+def diff_profiles(a: dict, b: dict) -> dict:
+    """Structured deltas between two loaded sides (B relative to A)."""
+    span_rows = []
+    for name in sorted(set(a["spans"]) | set(b["spans"])):
+        empty = {"count": 0, "total_us": 0, "self_us": 0}
+        ra = a["spans"].get(name, empty)
+        rb = b["spans"].get(name, empty)
+        a_ms = ra["self_us"] / 1000.0
+        b_ms = rb["self_us"] / 1000.0
+        span_rows.append({"name": name,
+                          "a_count": ra["count"], "b_count": rb["count"],
+                          "a_self_ms": round(a_ms, 3),
+                          "b_self_ms": round(b_ms, 3),
+                          "delta_ms": round(b_ms - a_ms, 3),
+                          "delta_pct": _pct(a_ms, b_ms)})
+    span_rows.sort(key=lambda r: -abs(r["delta_ms"]))
+
+    hist_rows = []
+    ma, mb = a["metrics"] or {}, b["metrics"] or {}
+    for group in sorted(set(ma) | set(mb)):
+        ha = (ma.get(group) or {}).get("histograms", {})
+        hb = (mb.get(group) or {}).get("histograms", {})
+        for key in sorted(set(ha) | set(hb)):
+            sa, sb = ha.get(key), hb.get(key)
+            row = {"group": group, "key": key,
+                   "a_count": int((sa or {}).get("count", 0)),
+                   "b_count": int((sb or {}).get("count", 0)),
+                   "quantiles": {}}
+            for q in QUANTILES:
+                qa = histogram_quantile(sa, q) if sa else float("nan")
+                qb = histogram_quantile(sb, q) if sb else float("nan")
+                row["quantiles"][f"q{int(q * 100)}"] = {
+                    "a": None if math.isnan(qa) else round(qa, 3),
+                    "b": None if math.isnan(qb) else round(qb, 3),
+                    "delta_pct": (None if math.isnan(qa) or math.isnan(qb)
+                                  else _pct(qa, qb))}
+            hist_rows.append(row)
+
+    compile_rows = []
+    ca = (ma.get("ml.compile") or {}).get("counters", {})
+    cb = (mb.get("ml.compile") or {}).get("counters", {})
+    for key in sorted(set(ca) | set(cb)):
+        va, vb = int(ca.get(key, 0)), int(cb.get(key, 0))
+        compile_rows.append({"key": key, "a": va, "b": vb,
+                             "delta": vb - va})
+    totals_a = compile_totals_from_snapshot(ma)
+    totals_b = compile_totals_from_snapshot(mb)
+
+    return {"spans": span_rows, "histograms": hist_rows,
+            "compile": compile_rows,
+            "compile_totals": {"a": totals_a, "b": totals_b},
+            # span gating needs span data on BOTH sides: against a
+            # metrics-only side (a snapshot file, or a dir that captured
+            # no spans) every B span would read as an infinite-percent
+            # regression and the budget would always fire
+            "spans_comparable": bool(a["spans"]) and bool(b["spans"])}
+
+
+def violations(diff: dict, budget_pct: float,
+               min_ms: float = DEFAULT_MIN_MS) -> List[dict]:
+    """The gated regressions in ``diff`` exceeding ``budget_pct``."""
+    out = []
+    for row in diff["spans"] if diff.get("spans_comparable") else ():
+        regress_ms = row["b_self_ms"] - row["a_self_ms"]
+        if regress_ms < min_ms:
+            continue
+        pct = row["delta_pct"]
+        if pct is not None and pct > budget_pct:
+            out.append({"kind": "span-self-time", "name": row["name"],
+                        "a_ms": row["a_self_ms"], "b_ms": row["b_self_ms"],
+                        "delta_pct": (None if math.isinf(pct)
+                                      else round(pct, 1))})
+    ta = diff["compile_totals"]["a"]["count"]
+    tb = diff["compile_totals"]["b"]["count"]
+    if tb - ta >= COMPILE_COUNT_FLOOR:
+        pct = _pct(float(ta), float(tb))
+        if pct is not None and pct > budget_pct:
+            out.append({"kind": "compile-count", "name": "backend compiles",
+                        "a": ta, "b": tb,
+                        "delta_pct": (None if math.isinf(pct)
+                                      else round(pct, 1))})
+    return out
+
+
+# -- rendering ----------------------------------------------------------------
+def _fmt_pct(pct: Optional[float]) -> str:
+    if pct is None:
+        return "  —   "
+    if math.isinf(pct):
+        return "  new "
+    return f"{pct:+7.1f}%"
+
+
+def render_diff(diff: dict, viol: List[dict], top_n: int = 15) -> str:
+    out = ["span self-time deltas (B vs A):",
+           f"  {'name':<32} {'A ms':>10} {'B ms':>10} {'delta':>10} "
+           f"{'pct':>8}"]
+    for row in diff["spans"][:top_n]:
+        out.append(f"  {row['name']:<32} {row['a_self_ms']:>10.3f} "
+                   f"{row['b_self_ms']:>10.3f} {row['delta_ms']:>+10.3f} "
+                   f"{_fmt_pct(row['delta_pct'])}")
+    if not diff["spans"]:
+        out.append("  (no spans on either side)")
+    elif not diff.get("spans_comparable"):
+        out.append("  (one side has no span data — self-time deltas "
+                   "reported but not gated)")
+
+    hists = [r for r in diff["histograms"]
+             if r["a_count"] or r["b_count"]]
+    if hists:
+        out.append("")
+        out.append("histogram quantile deltas (reported, not gated):")
+        for row in hists[:top_n]:
+            qs = "  ".join(
+                f"{q}: {v['a']}→{v['b']}"
+                for q, v in row["quantiles"].items()
+                if v["a"] is not None or v["b"] is not None)
+            out.append(f"  {row['group']}:{row['key']}  "
+                       f"count {row['a_count']}→{row['b_count']}  {qs}")
+
+    ct = diff["compile_totals"]
+    out.append("")
+    out.append(f"compile totals: count {ct['a']['count']}→"
+               f"{ct['b']['count']}, time "
+               f"{ct['a']['timeMs']:.1f}→{ct['b']['timeMs']:.1f} ms")
+    for row in diff["compile"][:top_n]:
+        if row["delta"]:
+            out.append(f"  {row['key']}: {row['a']}→{row['b']} "
+                       f"({row['delta']:+d})")
+
+    if viol:
+        out.append("")
+        out.append("BUDGET EXCEEDED:")
+        for v in viol:
+            out.append(f"  {v['kind']}: {v['name']}  "
+                       + " ".join(f"{k}={val}" for k, val in v.items()
+                                  if k not in ("kind", "name")))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="flink-ml-tpu-trace diff",
+        description="Diff two trace dirs / metrics snapshots; with "
+                    "--budget, gate regressions (exit 4).")
+    parser.add_argument("a", help="baseline: trace dir or metrics JSON")
+    parser.add_argument("b", help="candidate: trace dir or metrics JSON")
+    parser.add_argument("--budget", type=float, default=None, metavar="PCT",
+                        help="fail (exit 4) when B regresses A beyond "
+                             "PCT%% on a gated quantity")
+    parser.add_argument("--min-ms", type=float, default=DEFAULT_MIN_MS,
+                        help="absolute span self-time delta (ms) below "
+                             "which the budget never fires "
+                             f"(default {DEFAULT_MIN_MS})")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--top", type=int, default=15,
+                        help="rows per section in text output")
+    args = parser.parse_args(argv)
+
+    try:
+        side_a = load_side(args.a)
+        side_b = load_side(args.b)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"mltrace diff: {e}", file=sys.stderr)
+        return EXIT_INVALID
+
+    diff = diff_profiles(side_a, side_b)
+    viol = (violations(diff, args.budget, args.min_ms)
+            if args.budget is not None else [])
+
+    if args.format == "json":
+        print(json.dumps({"diff": diff, "violations": viol,
+                          "budget_pct": args.budget}, indent=2,
+                         default=str))
+    else:
+        print(render_diff(diff, viol, top_n=args.top))
+    return EXIT_BUDGET if viol else EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,12 @@ def chrome_trace_events(spans: List[dict]) -> List[dict]:
             "args": args,
         })
         for ev in sp.get("events", ()):
+            ev_args = dict(ev.get("attrs", {}))
+            # the owning span's ids must ride along, or Perfetto shows a
+            # floating instant nobody can correlate with its span
+            ev_args["span_id"] = sp.get("id")
+            if sp.get("parent"):
+                ev_args["parent_id"] = sp["parent"]
             events.append({
                 "name": ev.get("name", "?"),
                 "cat": "event",
@@ -85,7 +91,7 @@ def chrome_trace_events(spans: List[dict]) -> List[dict]:
                 "ts": ev.get("ts_us", sp.get("ts_us", 0)),
                 "pid": sp.get("pid", 0),
                 "tid": sp.get("tid", 0),
-                "args": dict(ev.get("attrs", {})),
+                "args": ev_args,
             })
     return events
 

@@ -4,15 +4,29 @@ Run on the real chip to localize where the KMeans-demo milliseconds go:
 dispatch latency, H2D/D2H transfer, the compiled Lloyd program at 1 vs 20
 rounds, and the end-to-end benchmark. Prints a timing table, then the
 bench.py JSON line.
+
+Compiles go through ``observability.compilestats.aot_compile`` (exact
+compile timing, cost_analysis FLOP/byte capture) and every section is a
+span under ``FLINK_ML_TPU_TRACE_DIR`` (default
+``profiles/trace_profile_bench/``), so the TPU window leaves
+``flink-ml-tpu-trace``-readable artifacts beside the stdout table.
 """
 
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from flink_ml_tpu.observability import compilestats, tracing
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 
 
 def t(label, fn, repeat=5):
@@ -30,32 +44,51 @@ def _timed(fn):
 
 def main():
     print("devices:", jax.devices())
-    x_small = jnp.zeros(8)
-    f_triv = jax.jit(lambda v: v + 1)
-    t("trivial jit dispatch", lambda: f_triv(x_small))
+    os.environ.setdefault(
+        tracing.TRACE_DIR_ENV,
+        os.path.join(ROOT, "profiles", "trace_profile_bench"))
+    compilestats.install()
+    print("trace dir:", os.environ[tracing.TRACE_DIR_ENV])
+    with tracing.tracer.span("tpu_profile_bench"):
+        _profile()
+    tracing.maybe_dump_root_metrics()
+    print(f"\ninspect: python scripts/mltrace.py "
+          f"{os.environ[tracing.TRACE_DIR_ENV]}")
 
-    host = np.random.default_rng(0).random((10000, 10)).astype(np.float32)
-    t("H2D 10k x 10 f32", lambda: jax.device_put(host))
-    dev = jax.device_put(host)
-    t("D2H 10k x 10 f32", lambda: np.asarray(dev))
 
-    # transfer at benchmark scale: 500k x 100 f32 = 200 MB. Round 2's 4 MB
-    # probe hid a 60x variance on identical 200 MB puts through the tunnel;
-    # print each sample, not just the best.
-    big = np.random.default_rng(1).random((500_000, 100)).astype(np.float32)
-    for i in range(5):
-        dt = _timed(lambda: jax.device_put(big))
-        print(f"H2D 500k x 100 f32 (200 MB) sample {i}     {dt * 1e3:8.2f} ms"
+def _profile():
+    with tracing.tracer.span("dispatch-and-transfer") as sp:
+        x_small = jnp.zeros(8)
+        f_triv = compilestats.instrumented_jit(lambda v: v + 1,
+                                               name="trivial_add")
+        t("trivial jit dispatch", lambda: f_triv(x_small))
+
+        host = np.random.default_rng(0).random((10000, 10)).astype(
+            np.float32)
+        t("H2D 10k x 10 f32", lambda: jax.device_put(host))
+        dev = jax.device_put(host)
+        t("D2H 10k x 10 f32", lambda: np.asarray(dev))
+
+        # transfer at benchmark scale: 500k x 100 f32 = 200 MB. Round 2's
+        # 4 MB probe hid a 60x variance on identical 200 MB puts through
+        # the tunnel; print each sample, not just the best.
+        big = np.random.default_rng(1).random((500_000, 100)).astype(
+            np.float32)
+        for i in range(5):
+            dt = _timed(lambda: jax.device_put(big))
+            print(f"H2D 500k x 100 f32 (200 MB) sample {i}     "
+                  f"{dt * 1e3:8.2f} ms  ({big.nbytes / dt / 1e9:6.2f} GB/s)")
+        big_dev = jax.device_put(big)
+        dt = _timed(lambda: np.asarray(big_dev))
+        print(f"D2H 500k x 100 f32 (200 MB)               {dt * 1e3:8.2f} ms"
               f"  ({big.nbytes / dt / 1e9:6.2f} GB/s)")
-    big_dev = jax.device_put(big)
-    dt = _timed(lambda: np.asarray(big_dev))
-    print(f"D2H 500k x 100 f32 (200 MB)               {dt * 1e3:8.2f} ms"
-          f"  ({big.nbytes / dt / 1e9:6.2f} GB/s)")
 
-    # device datagen at the same scale: the transfer-free on-ramp
-    from flink_ml_tpu.benchmark.datagen import _device_random
-    t("device datagen 500k x 100 f32", lambda: _device_random(0, (500_000, 100)))
-    del big, big_dev
+        # device datagen at the same scale: the transfer-free on-ramp
+        from flink_ml_tpu.benchmark.datagen import _device_random
+        t("device datagen 500k x 100 f32",
+          lambda: _device_random(0, (500_000, 100)))
+        del big, big_dev
+        compilestats.sample_memory("transfer", span=sp)
 
     from flink_ml_tpu.models.clustering.kmeans import _build_lloyd_program
     from flink_ml_tpu.parallel.collective import shard_batch
@@ -66,23 +99,31 @@ def main():
     init = jnp.asarray(host[:2])
     for iters in (1, 2, 5, 20):
         fit = _build_lloyd_program(mesh, "euclidean", iters)
-        t(f"lloyd program, {iters:2d} round(s)",
-          lambda fit=fit: fit(xs, jnp.int32(n), init))
+        with tracing.tracer.span(f"program:lloyd-{iters}") as sp:
+            fit_c = compilestats.aot_compile(fit, xs, jnp.int32(n), init,
+                                             name=f"lloyd_{iters}")
+            best = t(f"lloyd program, {iters:2d} round(s)",
+                     lambda fit_c=fit_c: fit_c(xs, jnp.int32(n), init))
+            sp.set_attribute("best_wall_ms", round(best * 1e3, 3))
+            compilestats.sample_memory("program", span=sp)
 
     from flink_ml_tpu.ops.losses import BinaryLogisticLoss
     from flink_ml_tpu.ops.optimizer import SGD, SGDParams
 
     y = (host @ np.arange(10) > 4.5).astype(np.float32)
     sgd = SGD(SGDParams(max_iter=20, global_batch_size=1000))
-    t("sgd optimize 10k x 10, 20 rounds",
-      lambda: sgd.optimize(BinaryLogisticLoss(), np.zeros(10, np.float32),
-                           host, y)[0], repeat=3)
+    with tracing.tracer.span("program:sgd-10kx10"):
+        t("sgd optimize 10k x 10, 20 rounds",
+          lambda: sgd.optimize(BinaryLogisticLoss(),
+                               np.zeros(10, np.float32), host, y)[0],
+          repeat=3)
 
     import bench
 
     print("\nbench.py:")
     t0 = time.perf_counter()
-    bench.main()
+    with tracing.tracer.span("bench.py"):
+        bench.main()
     print(f"bench total wall: {time.perf_counter() - t0:.1f}s")
 
 

@@ -13,6 +13,14 @@ static-schedule SGD, dynamic-slice while fallback, Lloyd's while program):
    named difference rather than an anonymous ``copy.N`` op.
 
 Run on the real chip: ``python scripts/tpu_profile_r4.py``.
+
+Program compiles go through ``observability.compilestats.aot_compile``
+(compile-time histograms, cost_analysis FLOP/byte capture, HBM
+watermarks) and the whole run is spanned under
+``FLINK_ML_TPU_TRACE_DIR`` (default ``profiles/trace_profile_r4/``), so
+a TPU window's artifacts are ``flink-ml-tpu-trace``-readable — and
+``mltrace diff``-able against the next window — instead of bespoke
+stdout.
 """
 
 import collections
@@ -26,6 +34,8 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np  # noqa: E402
+
+from flink_ml_tpu.observability import compilestats, tracing  # noqa: E402
 
 ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 
@@ -73,6 +83,21 @@ def main() -> int:
     assert jax.default_backend() != "cpu", "needs the TPU backend"
     print("devices:", jax.devices())
 
+    # TPU-window artifacts must be mltrace-readable, not bespoke stdout:
+    # arm the tracer (respecting an operator-set dir) + compile telemetry
+    os.environ.setdefault(tracing.TRACE_DIR_ENV,
+                          os.path.join(ROOT, "profiles", "trace_profile_r4"))
+    compilestats.install()
+    print("trace dir:", os.environ[tracing.TRACE_DIR_ENV])
+    with tracing.tracer.span("tpu_profile_r4"):
+        rc = _profile_programs()
+    tracing.maybe_dump_root_metrics()
+    print(f"\ninspect: python scripts/mltrace.py "
+          f"{os.environ[tracing.TRACE_DIR_ENV]}")
+    return rc
+
+
+def _profile_programs() -> int:
     from flink_ml_tpu.benchmark.datagen import _device_random
     from flink_ml_tpu.models.clustering.kmeans import _build_lloyd_program
     from flink_ml_tpu.ops.losses import BinaryLogisticLoss
@@ -103,23 +128,28 @@ def main() -> int:
         args = (xs, ys, ws, c0, offs)
         if label == "while-segment":
             args = args + (jnp.int32(0), jnp.int32(prm.max_iter))
-        lowered = prog.lower(*args).compile()
-        try:
-            fmts = lowered.input_formats
-        except Exception:
-            fmts = None
-        print(f"\nSGD {label}: compiled input formats vs actual:")
-        if fmts is not None:
-            for i, (f, a) in enumerate(zip(jax.tree_util.tree_leaves(fmts),
-                                           args)):
-                have = getattr(a, "format", None)
-                mark = " <-- MISMATCH (layout copy!)" if (
-                    have is not None and str(f) != str(have)) else ""
-                print(f"  arg{i}: want {f}  have {have}{mark}")
-        prof_dir = os.path.join(ROOT, "profiles", f"northstar_lr_r4_{label}")
-        best = timed(lambda: prog(*args))
-        with jax.profiler.trace(prof_dir):
-            jax.block_until_ready(prog(*args))
+        with tracing.tracer.span(f"program:sgd-{label}") as sp:
+            compiled = compilestats.aot_compile(prog, *args,
+                                                name=f"sgd_{label}")
+            try:
+                fmts = compiled.input_formats
+            except Exception:
+                fmts = None
+            print(f"\nSGD {label}: compiled input formats vs actual:")
+            if fmts is not None:
+                for i, (f, a) in enumerate(zip(
+                        jax.tree_util.tree_leaves(fmts), args)):
+                    have = getattr(a, "format", None)
+                    mark = " <-- MISMATCH (layout copy!)" if (
+                        have is not None and str(f) != str(have)) else ""
+                    print(f"  arg{i}: want {f}  have {have}{mark}")
+            prof_dir = os.path.join(ROOT, "profiles",
+                                    f"northstar_lr_r4_{label}")
+            best = timed(lambda: compiled(*args))
+            sp.set_attribute("best_wall_ms", round(best * 1e3, 3))
+            compilestats.sample_memory("program", span=sp)
+            with jax.profiler.trace(prof_dir):
+                jax.block_until_ready(compiled(*args))
         print(f"SGD {label}: best wall {best * 1e3:.1f} ms; device ops:")
         device_op_table(prof_dir)
 
@@ -131,10 +161,15 @@ def main() -> int:
     xs, nn = ensure_on_mesh(mesh, x, axes, jnp.float32)
     init = jnp.asarray(np.random.default_rng(2).random((k, d)), jnp.float32)
     fit = _build_lloyd_program(mesh, "euclidean", 10)
-    best = timed(lambda: fit(xs, jnp.int32(n), init))
-    prof_dir = os.path.join(ROOT, "profiles", "northstar_kmeans_r4")
-    with jax.profiler.trace(prof_dir):
-        jax.block_until_ready(fit(xs, jnp.int32(n), init))
+    with tracing.tracer.span("program:kmeans-lloyd10") as sp:
+        fit_c = compilestats.aot_compile(fit, xs, jnp.int32(n), init,
+                                         name="kmeans_lloyd10")
+        best = timed(lambda: fit_c(xs, jnp.int32(n), init))
+        sp.set_attribute("best_wall_ms", round(best * 1e3, 3))
+        compilestats.sample_memory("program", span=sp)
+        prof_dir = os.path.join(ROOT, "profiles", "northstar_kmeans_r4")
+        with jax.profiler.trace(prof_dir):
+            jax.block_until_ready(fit_c(xs, jnp.int32(n), init))
     print(f"\nKMeans lloyd 10 rounds: best wall {best * 1e3:.1f} ms; "
           "device ops:")
     device_op_table(prof_dir)

@@ -1,0 +1,435 @@
+"""Compile & device telemetry (observability/compilestats.py) and the
+``mltrace diff`` regression gate (observability/diff.py).
+
+Acceptance bar (ISSUE 4): ``mltrace diff`` on two runs of the same
+traced fit exits 0; with an injected slowdown it exits the documented
+budget code (4); and jitting one function over >N distinct shapes under
+``JAX_PLATFORMS=cpu`` records the recompile-storm counter and event —
+all without TPU hardware.
+"""
+
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flink_ml_tpu.common.metrics import (
+    MetricsRegistry,
+    histogram_quantile,
+    metrics,
+)
+from flink_ml_tpu.iteration.iteration import IterationConfig, iterate_bounded
+from flink_ml_tpu.observability import compilestats as cs
+from flink_ml_tpu.observability import diff as trace_diff
+from flink_ml_tpu.observability import (
+    TRACE_DIR_ENV,
+    dump_metrics,
+    read_spans,
+    tracer,
+)
+from flink_ml_tpu.observability.cli import main as trace_cli
+
+_HAS_MONITORING = cs.install()
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(TRACE_DIR_ENV, raising=False)
+    monkeypatch.delenv(cs.STORM_ENV, raising=False)
+    yield
+    tracer.shutdown()
+    cs.compile_stats.reset()
+
+
+# -- histogram quantiles ------------------------------------------------------
+
+def test_histogram_quantile_interpolates():
+    snap = {"buckets": [1.0, 10.0, 100.0], "counts": [1, 2, 4],
+            "sum": 100.0, "count": 4}
+    # target 2 lands mid-bucket (1, 10]: 1 + (2-1)/(2-1) * 9 = 10
+    assert histogram_quantile(snap, 0.5) == pytest.approx(10.0)
+    # past the last finite bound clamps to it
+    assert histogram_quantile(snap, 1.0) == pytest.approx(100.0)
+    assert math.isnan(histogram_quantile({"count": 0}, 0.5))
+
+
+def test_histogram_quantile_on_live_histogram():
+    from flink_ml_tpu.common.metrics import Histogram
+
+    h = Histogram(buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    assert 0.0 < h.quantile(0.5) <= 10.0
+
+
+# -- jax.monitoring subscription ----------------------------------------------
+
+@pytest.mark.skipif(not _HAS_MONITORING,
+                    reason="this jax build has no monitoring channels")
+def test_monitoring_channels_record_compile_phases():
+    grp = metrics.group("ml", "compile")
+    hist = grp.histogram("phaseMs", buckets=cs.COMPILE_BUCKETS,
+                         labels={"phase": "backend_compile"})
+    before = hist.snapshot()["count"]
+    f = jax.jit(lambda x: x * 1.2345 + 6.789)  # fresh identity → compiles
+    f(jnp.ones((5,)))
+    assert hist.snapshot()["count"] > before
+    assert grp.get_counter("phases",
+                           labels={"phase": "backend_compile"}) > 0
+
+
+# -- instrumented jit + recompile storm ---------------------------------------
+
+def test_instrumented_jit_counts_compiles_and_caches(tmp_path):
+    tracer.configure(str(tmp_path))
+
+    @cs.instrumented_jit(name="cfn_counts")
+    def f(x):
+        return x * 2.0
+
+    for _ in range(3):  # repeat shape: one compile, cached executable
+        np.testing.assert_allclose(f(jnp.ones((4,))), np.full(4, 2.0))
+    np.testing.assert_allclose(f(jnp.ones((8,))), np.full(8, 2.0))
+    tracer.configure(None)
+
+    grp = metrics.group("ml", "compile")
+    assert grp.get_counter("compiles", labels={"fn": "cfn_counts"}) == 2
+    hist = grp.histogram("compileMs", buckets=cs.COMPILE_BUCKETS,
+                         labels={"fn": "cfn_counts"}).snapshot()
+    assert hist["count"] == 2 and hist["sum"] > 0
+    events = [ev for s in read_spans(str(tmp_path)) for ev in s["events"]]
+    assert sum(1 for ev in events if ev["name"] == "compile"
+               and ev["attrs"].get("fn") == "cfn_counts") == 2
+
+
+def test_recompile_storm_counter_and_event(tmp_path, monkeypatch):
+    """The ISSUE acceptance run: one function jitted over >N distinct
+    shapes on CPU fires the storm counter + warning event."""
+    monkeypatch.setenv(cs.STORM_ENV, "3")
+    tracer.configure(str(tmp_path))
+
+    @cs.instrumented_jit(name="storm_fn")
+    def f(x):
+        return x + 1.0
+
+    with tracer.span("fit"):
+        for n in range(1, 6):  # 5 distinct shapes > N=3
+            f(jnp.ones((n,)))
+    tracer.configure(None)
+
+    grp = metrics.group("ml", "compile")
+    assert grp.get_counter("storms", labels={"fn": "storm_fn"}) == 1
+    storms = [ev for s in read_spans(str(tmp_path)) for ev in s["events"]
+              if ev["name"] == "compile.storm"]
+    assert len(storms) == 1
+    assert storms[0]["attrs"]["fn"] == "storm_fn"
+    assert storms[0]["attrs"]["signatures"] > 3
+
+
+def test_fit_window_rebases_storm_counts(monkeypatch):
+    """Shapes compiled before a fit window must not count against it —
+    a long-lived process accumulating shapes is not a storm."""
+    monkeypatch.setenv(cs.STORM_ENV, "3")
+
+    @cs.instrumented_jit(name="window_fn")
+    def f(x):
+        return x - 1.0
+
+    for n in range(1, 4):  # 3 signatures before any window
+        f(jnp.ones((n,)))
+    with cs.fit_window():
+        for n in range(4, 7):  # only 3 NEW signatures in-window: no storm
+            f(jnp.ones((n,)))
+        assert metrics.group("ml", "compile").get_counter(
+            "storms", labels={"fn": "window_fn"}) == 0
+        f(jnp.ones((7,)))  # 4th in-window signature: storm
+    assert metrics.group("ml", "compile").get_counter(
+        "storms", labels={"fn": "window_fn"}) == 1
+
+
+def test_instrumented_jit_static_args_stay_correct():
+    """A Compiled from static_argnums rejects the static operand, so the
+    wrapper must dispatch such signatures through the jitted callable —
+    and both static values must compute correctly (bools share one
+    abstract signature, so correctness rides on the jitted fallback,
+    which re-specializes per static value internally)."""
+
+    @cs.instrumented_jit(name="static_fn", static_argnums=(1,))
+    def f(x, flag):
+        return x * 2.0 if flag else x
+
+    np.testing.assert_allclose(f(jnp.ones((3,)), True), np.full(3, 2.0))
+    np.testing.assert_allclose(f(jnp.ones((3,)), False), np.ones(3))
+    np.testing.assert_allclose(f(jnp.ones((3,)), True), np.full(3, 2.0))
+    assert metrics.group("ml", "compile").get_counter(
+        "compiles", labels={"fn": "static_fn"}) == 1
+
+
+def test_instrumented_jit_dynamic_bools_share_one_compile():
+    """Python bools are weak-typed dynamic scalars under jit — True and
+    False must hit ONE compiled executable, not record phantom
+    recompiles (a value-sensitive signature would double the compile
+    bill and skew the storm/compile-count telemetry)."""
+
+    @cs.instrumented_jit(name="bool_fn")
+    def f(x, flag):
+        return x * jnp.where(flag, 2.0, 1.0)
+
+    np.testing.assert_allclose(f(jnp.ones((3,)), True), np.full(3, 2.0))
+    np.testing.assert_allclose(f(jnp.ones((3,)), False), np.ones(3))
+    assert metrics.group("ml", "compile").get_counter(
+        "compiles", labels={"fn": "bool_fn"}) == 1
+
+
+# -- aot_compile + cost capture -----------------------------------------------
+
+def test_aot_compile_records_time_and_cost(tmp_path):
+    tracer.configure(str(tmp_path))
+    with tracer.span("root"):
+        compiled = cs.aot_compile(lambda x: (x * 3.0).sum(),
+                                  jnp.ones((16,)), name="aot_fn")
+    tracer.configure(None)
+    assert float(compiled(jnp.ones((16,)))) == pytest.approx(48.0)
+
+    grp = metrics.group("ml", "compile")
+    assert grp.get_counter("compiles", labels={"fn": "aot_fn"}) == 1
+    flops = metrics.group("ml", "device").get_gauge(
+        "programFlops", labels={"fn": "aot_fn"})
+    assert flops is not None and flops > 0
+    events = [ev for s in read_spans(str(tmp_path)) for ev in s["events"]]
+    assert any(ev["name"] == "compile.cost"
+               and ev["attrs"]["fn"] == "aot_fn" for ev in events)
+
+
+# -- device memory sampling ---------------------------------------------------
+
+class _FakeDevice:
+    id = 0
+
+    def memory_stats(self):
+        return {"bytes_in_use": 1000, "peak_bytes_in_use": 2000}
+
+
+def test_sample_memory_cpu_is_silent_noop():
+    jnp.zeros(1).block_until_ready()  # backend live: the guard must pass
+    cs.compile_stats._memory_unavailable = False
+    assert cs.sample_memory("probe") == {}
+    # the verdict latched: later samples return without touching devices
+    assert cs.compile_stats._memory_unavailable
+    assert cs.sample_memory("probe") == {}
+
+
+def test_sample_memory_records_watermarks(tmp_path, monkeypatch):
+    jnp.zeros(1).block_until_ready()
+    monkeypatch.setattr(jax, "devices", lambda: [_FakeDevice()])
+    cs.compile_stats._memory_unavailable = False
+    tracer.configure(str(tmp_path))
+    with tracer.span("fit") as sp:
+        out = cs.sample_memory("epoch", span=sp)
+    tracer.configure(None)
+    assert out == {"bytes_in_use": 1000, "peak_bytes_in_use": 2000}
+    grp = metrics.group("ml", "device")
+    assert grp.get_gauge("hbmPeakBytes", labels={"device": "0"}) == 2000
+    assert grp.get_gauge("hbmPeakBytesMax", labels={"site": "epoch"}) == 2000
+    fit = next(s for s in read_spans(str(tmp_path)) if s["name"] == "fit")
+    assert fit["attrs"]["hbm_peak_bytes"] == 2000
+
+
+# -- benchmark runner compile/steady split ------------------------------------
+
+def test_benchmark_records_compile_split():
+    from flink_ml_tpu.benchmark.runner import best_of
+
+    spec = {
+        "stage": {"className": "Binarizer",
+                  "paramMap": {"inputCols": ["features"],
+                               "outputCols": ["out"],
+                               "thresholds": [0.5]}},
+        "inputData": {"className": "DenseVectorGenerator",
+                      "paramMap": {"seed": 2, "colNames": [["features"]],
+                                   "numValues": 200, "vectorDim": 4}},
+    }
+    best = best_of("binarizer-split", spec, runs=1)
+    for key in ("compileCount", "compileTimeMs", "warmupTimeMs",
+                "warmupCompileTimeMs", "warmupCompileCount"):
+        assert key in best, key
+    assert best["warmupTimeMs"] > 0
+    # steady state can't compile more than the warmed process already did
+    assert best["warmupCompileCount"] >= best["compileCount"]
+
+
+# -- mltrace diff -------------------------------------------------------------
+
+def _write_spans(d, rows):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "spans-1.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _span(name, sid, dur_us, parent=None):
+    return {"type": "span", "name": name, "trace": "t", "id": sid,
+            "parent": parent, "ts_us": 0, "dur_us": dur_us, "pid": 1,
+            "tid": 1, "attrs": {}, "events": []}
+
+
+def test_diff_identical_dirs_exit_zero(tmp_path, capsys):
+    a = str(tmp_path / "a")
+    _write_spans(a, [_span("fit", "s1", 100_000),
+                     _span("epoch", "s2", 60_000, parent="s1")])
+    assert trace_diff.main([a, a, "--budget", "5"]) == trace_diff.EXIT_OK
+    out = capsys.readouterr().out
+    assert "span self-time deltas" in out
+
+
+def test_diff_regression_exits_budget_code(tmp_path, capsys):
+    """Golden gate: an injected slowdown must return the documented
+    budget exit code; without --budget the same diff reports and
+    exits 0."""
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    _write_spans(a, [_span("fit", "s1", 100_000),
+                     _span("epoch", "s2", 60_000, parent="s1")])
+    _write_spans(b, [_span("fit", "s1", 100_000),
+                     _span("epoch", "s2", 60_000, parent="s1"),
+                     _span("slow.op", "s3", 500_000, parent="s1")])
+    assert trace_diff.main([a, b, "--budget", "50"]) == trace_diff.EXIT_BUDGET
+    assert "BUDGET EXCEEDED" in capsys.readouterr().out
+    assert trace_diff.main([a, b]) == trace_diff.EXIT_OK
+
+
+def test_diff_small_deltas_under_min_ms_never_gate(tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    _write_spans(a, [_span("fit", "s1", 1_000)])
+    _write_spans(b, [_span("fit", "s1", 3_000)])  # +200% but only +2 ms
+    assert trace_diff.main([a, b, "--budget", "50"]) == trace_diff.EXIT_OK
+    assert trace_diff.main(
+        [a, b, "--budget", "50", "--min-ms", "1"]) == trace_diff.EXIT_BUDGET
+
+
+def test_diff_invalid_side_exits_two(tmp_path):
+    a = str(tmp_path / "a")
+    _write_spans(a, [_span("fit", "s1", 1000)])
+    missing = str(tmp_path / "missing")
+    assert trace_diff.main([missing, a, "--budget", "5"]) \
+        == trace_diff.EXIT_INVALID
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert trace_diff.main([str(empty), a]) == trace_diff.EXIT_INVALID
+
+
+def test_diff_compile_count_gate_from_metrics_snapshots(tmp_path):
+    def snapshot_file(path, n_compiles):
+        reg = MetricsRegistry()
+        hist = reg.group("ml", "compile").histogram(
+            "phaseMs", buckets=cs.COMPILE_BUCKETS,
+            labels={"phase": "backend_compile"})
+        for _ in range(n_compiles):
+            hist.observe(10.0)
+        reg.group("ml", "compile").counter("compiles", n_compiles,
+                                           labels={"fn": "f"})
+        with open(path, "w") as f:
+            json.dump(reg.snapshot(), f)
+        return str(path)
+
+    a = snapshot_file(tmp_path / "a.json", 3)
+    b = snapshot_file(tmp_path / "b.json", 9)
+    assert trace_diff.main([a, b, "--budget", "50"]) == trace_diff.EXIT_BUDGET
+    assert trace_diff.main([a, a, "--budget", "50"]) == trace_diff.EXIT_OK
+    # one stray compile stays under the absolute floor
+    c = snapshot_file(tmp_path / "c.json", 4)
+    assert trace_diff.main([a, c, "--budget", "10"]) == trace_diff.EXIT_OK
+
+
+def test_diff_snapshot_vs_tracedir_does_not_span_gate(tmp_path):
+    """A metrics-snapshot side has no spans; gating B's spans against it
+    would read every span as an infinite regression. Span gating must
+    require span data on both sides (compile gating still applies)."""
+    reg = MetricsRegistry()
+    reg.group("ml", "iteration").histogram("epochMs").observe(1.0)
+    snap_file = tmp_path / "a.json"
+    with open(snap_file, "w") as f:
+        json.dump(reg.snapshot(), f)
+    b = str(tmp_path / "b")
+    _write_spans(b, [_span("fit", "s1", 900_000)])
+    assert trace_diff.main([str(snap_file), b, "--budget", "10"]) \
+        == trace_diff.EXIT_OK
+
+
+def test_compile_totals_split_never_mixes_sources():
+    """The benchmark delta must subtract within one source: compiles
+    recorded only per-function before a run must not make the
+    monitoring-channel delta go negative."""
+    reg = MetricsRegistry()
+    g = reg.group("ml", "compile")
+    for _ in range(5):  # instrumented compiles before any benchmark
+        g.histogram("compileMs", buckets=cs.COMPILE_BUCKETS,
+                    labels={"fn": "pre"}).observe(10.0)
+    before = cs.compile_totals_split(reg.snapshot())
+    for _ in range(3):  # the run's compiles land on the phase channel
+        g.histogram("phaseMs", buckets=cs.COMPILE_BUCKETS,
+                    labels={"phase": "backend_compile"}).observe(20.0)
+    after = cs.compile_totals_split(reg.snapshot())
+    assert after["phase"]["count"] - before["phase"]["count"] == 3
+    assert after["perfn"]["count"] - before["perfn"]["count"] == 0
+
+
+def test_diff_histogram_quantiles_reported_not_gated(tmp_path, capsys):
+    def snapshot_file(path, ms):
+        reg = MetricsRegistry()
+        h = reg.group("ml", "iteration").histogram(
+            "epochMs", labels={"mode": "host"})
+        for _ in range(5):
+            h.observe(ms)
+        with open(path, "w") as f:
+            json.dump(reg.snapshot(), f)
+        return str(path)
+
+    a = snapshot_file(tmp_path / "a.json", 2.0)
+    b = snapshot_file(tmp_path / "b.json", 400.0)
+    # quantiles blew up but are report-only: no violation
+    assert trace_diff.main([a, b, "--budget", "10"]) == trace_diff.EXIT_OK
+    out = capsys.readouterr().out
+    assert "histogram quantile deltas" in out
+    assert "epochMs" in out
+
+
+def test_diff_cli_dispatch_through_mltrace(tmp_path, capsys):
+    """`flink-ml-tpu-trace diff A B` must route to the diff gate."""
+    a = str(tmp_path / "a")
+    _write_spans(a, [_span("fit", "s1", 50_000)])
+    assert trace_cli(["diff", a, a, "--budget", "5"]) == trace_diff.EXIT_OK
+    capsys.readouterr()
+
+
+def test_diff_on_two_traced_fits_end_to_end(tmp_path):
+    """The acceptance scenario with real artifacts: two runs of the same
+    traced fit diff clean; a third with a sleep injected into the epoch
+    body blows the budget."""
+
+    def traced_run(trace_dir, slow_ms=0.0):
+        tracer.configure(str(trace_dir))
+
+        def body(c, e):
+            if slow_ms:
+                time.sleep(slow_ms / 1000.0)
+            return c + 1
+
+        iterate_bounded(np.float64(0.0), body, max_iter=4, jit_round=False,
+                        config=IterationConfig(mode="host"))
+        dump_metrics(str(trace_dir))
+        tracer.configure(None)
+
+    a, b, slow = (str(tmp_path / n) for n in ("a", "b", "slow"))
+    traced_run(a)
+    traced_run(b)
+    traced_run(slow, slow_ms=120.0)
+    assert trace_diff.main([a, b, "--budget", "400", "--min-ms", "100"]) \
+        == trace_diff.EXIT_OK
+    assert trace_diff.main([a, slow, "--budget", "400", "--min-ms", "100"]) \
+        == trace_diff.EXIT_BUDGET

@@ -236,6 +236,27 @@ def test_span_nesting_parent_links(tmp_path):
     assert by_name["outer"]["dur_us"] >= by_name["inner"]["dur_us"]
 
 
+def test_chrome_instant_events_carry_owning_span_id(tmp_path):
+    """Satellite (ISSUE 4): instants must name their owning span (and
+    its parent) in args, or Perfetto shows floating events nobody can
+    correlate back to a span."""
+    from flink_ml_tpu.observability.exporters import chrome_trace_events
+
+    tracer.configure(str(tmp_path))
+    with tracer.span("outer"):
+        with tracer.span("inner"):
+            tracer.event("tick", n=1)
+    tracer.configure(None)
+    spans = read_spans(str(tmp_path))
+    by_name = {s["name"]: s for s in spans}
+    instants = [e for e in chrome_trace_events(spans) if e["ph"] == "i"]
+    assert instants, "no instant events exported"
+    tick = next(e for e in instants if e["name"] == "tick")
+    assert tick["args"]["span_id"] == by_name["inner"]["id"]
+    assert tick["args"]["parent_id"] == by_name["outer"]["id"]
+    assert tick["args"]["n"] == 1  # event attrs still ride along
+
+
 def test_disarmed_tracer_is_noop(tmp_path):
     with tracer.span("ghost") as sp:
         sp.set_attribute("x", 1)
@@ -420,6 +441,50 @@ def test_hostpool_child_spans_merge(tmp_path, monkeypatch):
     hist = metrics.group("ml", "hostpool_test").histogram(
         "rows", buckets=(10.0, 1000.0)).snapshot()
     assert hist["count"] >= 2
+
+
+def test_prometheus_labeled_histograms_across_fork(tmp_path, monkeypatch):
+    """Satellite (ISSUE 4): the composition the separate merge and
+    grammar tests skip — LABELED histograms observed in forked host-pool
+    children must fold into the driver registry and render as one valid
+    Prometheus exposition family with the merged counts."""
+    if not hasattr(os, "fork"):
+        pytest.skip("no fork on this platform")
+    trace_dir = tmp_path / "trace"
+    monkeypatch.setenv(TRACE_DIR_ENV, str(trace_dir))
+    buckets = (1.0, 10.0, 100.0)
+
+    def fn(lo, hi):
+        metrics.group("ml", "forkprom").histogram(
+            "shardRows", buckets=buckets,
+            labels={"site": "child"}).observe(float(hi - lo))
+        return hi - lo
+
+    base = metrics.group("ml", "forkprom").histogram(
+        "shardRows", buckets=buckets,
+        labels={"site": "child"}).snapshot()["count"]
+    out = map_row_shards(fn, 8, workers=2, min_rows=2, shard_cap=4)
+    assert out == [4, 4]
+    tracer.shutdown()
+
+    merged = metrics.group("ml", "forkprom").histogram(
+        "shardRows", buckets=buckets,
+        labels={"site": "child"}).snapshot()
+    assert merged["count"] - base == 2  # both children folded in
+
+    text = prometheus_text(metrics.snapshot())
+    for line in text.strip().splitlines():
+        assert _PROM_LINE.match(line) or _PROM_TYPE.match(line), line
+    # the labeled series rendered cumulative under one family, with the
+    # +Inf bucket equal to the merged observation count
+    inf_line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith('flink_ml_tpu_ml_forkprom_shardRows_bucket'
+                         '{site="child",le="+Inf"}'))
+    assert int(inf_line.rsplit(" ", 1)[1]) == merged["count"]
+    type_lines = [ln for ln in text.splitlines()
+                  if "forkprom_shardRows" in ln and ln.startswith("# TYPE")]
+    assert len(type_lines) == 1
 
 
 def test_hostpool_inline_path_still_counts(monkeypatch):
