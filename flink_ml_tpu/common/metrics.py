@@ -85,7 +85,10 @@ class Histogram:
                     "sum": self.sum, "count": self.count}
 
     def quantile(self, q: float) -> float:
-        """Estimated ``q`` quantile (see :func:`histogram_quantile`)."""
+        """Estimated ``q`` quantile (see :func:`histogram_quantile` for
+        the interpolation rule and the explicit edge-case contract:
+        ValueError outside [0, 1], NaN when empty, 0.0 at q=0, clamp to
+        the last finite bound past it)."""
         return histogram_quantile(self.snapshot(), q)
 
     def merge_snapshot(self, snap: dict) -> None:
@@ -107,13 +110,31 @@ def histogram_quantile(snapshot: dict, q: float) -> float:
     histogram snapshot — Prometheus ``histogram_quantile`` semantics:
     linear interpolation within the winning bucket (from 0 below the
     first bound), observations past the last finite bound clamp to it.
-    NaN for an empty histogram — the artifact-diff tooling
-    (observability/diff.py) must distinguish 'no samples' from 0."""
+
+    Edge cases are explicit contracts, not bucket-math fallout:
+
+    - ``q`` outside ``[0, 1]`` (including NaN) raises ``ValueError`` —
+      a malformed quantile is a caller bug, never a silent estimate;
+    - an empty histogram (or a snapshot without buckets) returns NaN —
+      the artifact-diff tooling (observability/diff.py) must
+      distinguish 'no samples' from 0;
+    - ``q == 0`` returns 0.0, the implicit lower bound of the first
+      bucket (matching the interpolate-from-zero rule above);
+    - ``q == 1`` interpolates to the upper bound of the last bucket
+      holding observations; observations past the last finite bound
+      (the implicit +Inf bucket) clamp to that last finite bound —
+      a single-bucket histogram therefore answers every ``q > 0`` with
+      a value in ``(0, bound]``."""
+    q = float(q)
+    if not 0.0 <= q <= 1.0:  # NaN fails both comparisons and lands here
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
     total = int(snapshot.get("count", 0))
-    if total <= 0:
-        return float("nan")
-    target = q * total
     buckets = snapshot.get("buckets", ())
+    if total <= 0 or not buckets:
+        return float("nan")
+    if q == 0.0:
+        return 0.0
+    target = q * total
     counts = snapshot.get("counts", ())
     prev_count, prev_bound = 0, 0.0
     for bound, count in zip(buckets, counts):
@@ -123,7 +144,7 @@ def histogram_quantile(snapshot: dict, q: float) -> float:
             frac = (target - prev_count) / (count - prev_count)
             return prev_bound + (float(bound) - prev_bound) * frac
         prev_count, prev_bound = count, float(bound)
-    return float(buckets[-1]) if buckets else float("nan")
+    return float(buckets[-1])
 
 
 class MetricGroup:

@@ -109,7 +109,8 @@ def _lloyd_round_math(measure, axes, partials_fn=None):
 
 @functools.lru_cache(maxsize=32)
 def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
-                         unroll: bool = False, use_kernel: bool = False):
+                         unroll: bool = False, use_kernel: bool = False,
+                         health: bool = False):
     """One compiled Lloyd's program per (mesh, measure, maxIter); k and
     shapes are trace-time static, handled by jit's shape cache. With
     ``unroll`` the static round count compiles as a straight-line Python
@@ -118,7 +119,14 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
     ``use_kernel`` (TPU + euclidean) the per-shard partials come from the
     fused pallas assign+accumulate kernel: each round reads the shard
     once instead of once per sub-op; the shard is zero-weight-padded to
-    the kernel tile ONCE, outside the rounds."""
+    the kernel tile ONCE, outside the rounds.
+
+    With ``health`` (observability/health.py) the program returns
+    ``(packed, shifts)`` where ``shifts`` is the per-round Frobenius
+    center-shift series ``(max_iter,)`` — ONE scalar per round folding
+    every centroid element, so a NaN centroid surfaces as a NaN shift
+    with no per-leaf host sync; without it the return is the packed
+    array alone, exactly as before."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     partials_fn = None
@@ -138,28 +146,40 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
                 xl = jnp.pad(xl, ((0, pad), (0, 0)))
                 vl = jnp.pad(vl, (0, pad))
         centroids, counts = c0, jnp.zeros((k,), xl.dtype)
+        shifts = jnp.zeros((max_iter if health else 0,), jnp.float32)
         if unroll:
-            for _ in range(max_iter):
-                centroids, counts = round_step(xl, vl, centroids)
+            for epoch in range(max_iter):
+                new_centroids, counts = round_step(xl, vl, centroids)
+                if health:
+                    shift = jnp.sqrt(jnp.sum(jnp.square(
+                        new_centroids - centroids))).astype(jnp.float32)
+                    shifts = shifts.at[epoch].set(shift)
+                centroids = new_centroids
         else:
             def cond(state):
-                _, _, epoch = state
+                _, _, epoch, _ = state
                 return epoch < max_iter
 
             def step(state):
-                centroids, counts, epoch = state
-                centroids, counts = round_step(xl, vl, centroids)
-                return centroids, counts, epoch + 1
+                centroids, counts, epoch, shifts = state
+                new_centroids, counts = round_step(xl, vl, centroids)
+                if health:
+                    shift = jnp.sqrt(jnp.sum(jnp.square(
+                        new_centroids - centroids))).astype(jnp.float32)
+                    shifts = jax.lax.dynamic_update_index_in_dim(
+                        shifts, shift, epoch, 0)
+                return new_centroids, counts, epoch + 1, shifts
 
-            centroids, counts, _ = jax.lax.while_loop(
-                cond, step, (centroids, counts, jnp.int32(0)))
+            centroids, counts, _, shifts = jax.lax.while_loop(
+                cond, step, (centroids, counts, jnp.int32(0), shifts))
         # one packed output = one device->host fetch for the whole fit
-        return jnp.concatenate([centroids, counts[:, None]], axis=1)
+        packed = jnp.concatenate([centroids, counts[:, None]], axis=1)
+        return (packed, shifts) if health else packed
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, None), P(), P()),
-        out_specs=P(), check_vma=False))
+        out_specs=((P(), P()) if health else P()), check_vma=False))
 
 
 #: fits with at most this many rounds compile fully unrolled — Lloyd's has
@@ -302,6 +322,9 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
 
         from flink_ml_tpu.iteration.iteration import (iterate_bounded,
                                                       needs_host_loop)
+        from flink_ml_tpu.observability import health as _health
+        health_on = _health.armed()
+        shifts = None
         if not needs_host_loop(self._iteration_config,
                                self._iteration_listeners):
             from flink_ml_tpu.ops.pallas_kernels import (
@@ -312,11 +335,18 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                           and pallas_supported()
                           and not _pallas_lloyd_broken
                           and lloyd_kernel_fits(k, dim))
-            try:
+
+            def run_fit(use_kernel):
                 fit = _build_lloyd_program(
                     mesh, self.distance_measure, self.max_iter,
-                    unroll=unroll, use_kernel=use_kernel)
-                packed = np.asarray(fit(xs, n_valid, jnp.asarray(init)))
+                    unroll=unroll, use_kernel=use_kernel,
+                    health=health_on)
+                out = fit(xs, n_valid, jnp.asarray(init))
+                packed, shifts = out if health_on else (out, None)
+                return np.asarray(packed), shifts
+
+            try:
+                packed, shifts = run_fit(use_kernel)
                 # benchmark provenance (runner.py executionPath)
                 self.last_execution_path = (
                     "pallas-lloyd" if use_kernel else "xla-lloyd")
@@ -334,12 +364,15 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                     "pallas Lloyd kernel failed; using the XLA fit path "
                     "for the rest of this process", exc_info=True)
                 _pallas_lloyd_broken = True
-                fit = _build_lloyd_program(
-                    mesh, self.distance_measure, self.max_iter,
-                    unroll=unroll, use_kernel=False)
-                packed = np.asarray(fit(xs, n_valid, jnp.asarray(init)))
+                packed, shifts = run_fit(False)
                 self.last_execution_path = "xla-lloyd"
             centroids, counts = packed[:, :-1], packed[:, -1]
+            if health_on:
+                s = np.asarray(shifts, np.float64)
+                _health.check_fit("KMeans", {"centerShift": s},
+                                  finite=bool(np.isfinite(s).all()))
+            else:
+                _health.guard_final_state("KMeans", centroids)
         else:
 
             round_fn = _build_lloyd_round_program(mesh,
@@ -349,6 +382,21 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                 centroids, _ = carry
                 return round_fn(xs, n_valid, centroids)
 
+            from flink_ml_tpu.iteration.iteration import (
+                device_checkpoint_segment)
+            listeners = self._iteration_listeners
+            seg = device_checkpoint_segment(self._iteration_config,
+                                            listeners)
+            if health_on and not seg:
+                # true host-driven rounds: the center-shift series rides
+                # a listener at the epoch boundary. A segmented device
+                # fit (seg > 0) must NOT gain a listener — that would
+                # demote it to per-round host dispatch; it keeps the
+                # cheap final-state guard instead.
+                listeners = tuple(listeners) + (
+                    _health.ConvergenceListener.for_centroids(
+                        "KMeans", init),)
+
             from jax.sharding import NamedSharding
             repl = NamedSharding(mesh, P())
             centroids, counts = iterate_bounded(
@@ -356,8 +404,11 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                  jax.device_put(jnp.zeros((k,), jnp.float32), repl)),
                 body, max_iter=self.max_iter,
                 config=self._iteration_config,
-                listeners=self._iteration_listeners)
+                listeners=listeners)
             self.last_execution_path = "host-rounds"
+            if not health_on or seg:
+                _health.guard_final_state(
+                    "KMeans", np.asarray(centroids, np.float64))
 
         model = KMeansModel(centroids=np.asarray(centroids, np.float64),
                             weights=np.asarray(counts, np.float64))

@@ -201,16 +201,21 @@ class LinearEstimatorBase(Estimator, LinearTrainParams,
             elastic_net=self.elastic_net)
         init = np.zeros(x.shape[1], np.float32)
         sgd = SGD(params)
+        # the estimator class name labels this fit's model-health
+        # telemetry (ml.health series + divergence events,
+        # observability/health.py) across every SGD execution path
         if sparse.is_csr(x):
             coeffs, _ = sgd.optimize_csr(
                 self.loss, init, x, y, w,
                 config=self._iteration_config,
-                listeners=self._iteration_listeners)
+                listeners=self._iteration_listeners,
+                tag=type(self).__name__)
         else:
             coeffs, _ = sgd.optimize(
                 self.loss, init, x, y, w,
                 config=self._iteration_config,
-                listeners=self._iteration_listeners)
+                listeners=self._iteration_listeners,
+                tag=type(self).__name__)
         # benchmark provenance (runner.py executionPath): which SGD
         # program shape actually trained this model
         self.last_execution_path = getattr(sgd, "last_execution_path",

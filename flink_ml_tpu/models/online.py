@@ -28,6 +28,7 @@ reference's unbounded model-data stream.
 
 from __future__ import annotations
 
+import math
 import time
 from typing import List, Optional, Tuple, Union
 
@@ -95,13 +96,21 @@ def _ftrl_apply(xp, g, coeffs, z, n, alpha, beta, l1, l2):
 
 
 @functools.lru_cache(maxsize=32)
-def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float):
+def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float,
+                  health: bool = False):
     """ONE FTRL global-batch update as a compiled SPMD program: batch
     sharded over the mesh's data axes, (w, z, n) replicated, the gradient
     reduction one psum — the dense-branch math of CalculateLocalGradient:
     364-388 + UpdateModel:295-319 with the TPU doing the batch matmul
     instead of a host numpy loop (the round-2 'online fits leave the
-    device idle' gap)."""
+    device idle' gap).
+
+    With ``health`` (observability/health.py) the program additionally
+    returns the batch's mean logloss — the per-batch convergence/health
+    scalar computed *inside* the jitted step from the dots it already
+    has (DrJAX-style first-class output; a NaN anywhere in the state
+    poisons it, so it doubles as the non-finite sentinel). The host
+    drains these scalars in stacked transfers, never per batch."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -114,22 +123,31 @@ def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float):
 
     def per_shard(xl, yl, n_valid, coeffs, z, n):
         vl = local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
-        p = 1.0 / (1.0 + jnp.exp(-(xl @ coeffs)))
+        dots = xl @ coeffs
+        p = 1.0 / (1.0 + jnp.exp(-dots))
         grad = jax.lax.psum(((p - yl) * vl) @ xl, axes)
         # dense-path reference semantics: weight sum = batch row count at
         # every coordinate
         g = grad / jnp.maximum(n_valid.astype(grad.dtype), 1.0)
-        return _ftrl_apply(jnp, g, coeffs, z, n, alpha, beta, l1, l2)
+        out = _ftrl_apply(jnp, g, coeffs, z, n, alpha, beta, l1, l2)
+        if health:
+            # stable binary logloss from the margins: log(1+e^d) - y·d
+            xent = jnp.logaddexp(0.0, dots) - yl * dots
+            loss = jax.lax.psum(jnp.sum(vl * xent), axes) \
+                / jnp.maximum(n_valid, 1.0)
+            return out + (loss,)
+        return out
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, None), P(spec0), P(), P(), P(), P()),
-        out_specs=(P(), P(), P()), check_vma=False))
+        out_specs=(P(), P(), P()) + ((P(),) if health else ()),
+        check_vma=False))
 
 
 @functools.lru_cache(maxsize=32)
 def _ftrl_sparse_program(mesh, alpha: float, beta: float, l1: float,
-                         l2: float):
+                         l2: float, health: bool = False):
     """ONE sparse-batch FTRL update as a compiled SPMD program — the
     device twin of the host CSR branch (ref CalculateLocalGradient:
     364-388: gradient and weight sums accumulate ONLY at a sample's
@@ -166,12 +184,21 @@ def _ftrl_sparse_program(mesh, alpha: float, beta: float, l1: float,
             wb[row] * valid, col, num_segments=d), axes)
         g = jnp.where(wsum != 0, grad / jnp.where(wsum != 0, wsum, 1.0),
                       0.0)
-        return _ftrl_apply(jnp, g, coeffs, z, n, alpha, beta, l1, l2)
+        out = _ftrl_apply(jnp, g, coeffs, z, n, alpha, beta, l1, l2)
+        if health:
+            # per-batch mean logloss, weighted by the sample weights
+            # (padded rows carry weight 0, so they contribute nothing)
+            xent = jnp.logaddexp(0.0, dots) - yb * dots
+            loss = jax.lax.psum(jnp.sum(wb * xent), axes) \
+                / jnp.maximum(jax.lax.psum(jnp.sum(wb), axes), 1e-30)
+            return out + (loss,)
+        return out
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, None),) * 6 + (P(), P(), P()),
-        out_specs=(P(), P(), P()), check_vma=False))
+        out_specs=(P(), P(), P()) + ((P(),) if health else ()),
+        check_vma=False))
 
 
 def _pack_csr_shards(x, y, w, n_shards: int):
@@ -466,8 +493,38 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
             history[:] = [(int(v), c) for v, c in zip(hv, hc)]
 
         from flink_ml_tpu.linalg import sparse
+        from flink_ml_tpu.observability import health as _mlhealth
         from flink_ml_tpu.parallel.collective import ensure_on_mesh
         from flink_ml_tpu.parallel.mesh import data_axes, default_mesh
+
+        # per-batch model-health telemetry (observability/health.py):
+        # device batches return their mean logloss as a program output;
+        # the scalars stay on device and drain in stacked transfers at
+        # the same cadence as the history snapshots, so the async batch
+        # pipeline keeps zero per-batch syncs
+        health_on = _mlhealth.armed()
+        algo = type(self).__name__
+        loss_pending: List = []  # device loss scalars awaiting drain
+        loss_series: List[float] = []
+
+        def drain_losses():
+            if loss_pending:
+                import jax.numpy as jnp
+
+                vals = np.asarray(jnp.stack(loss_pending), np.float64)
+                loss_pending.clear()
+                loss_series.extend(float(v) for v in vals)
+
+        def check_losses(final=False):
+            """Drain pending device losses; fail fast on a non-finite
+            batch (records the series, raises NonFiniteState)."""
+            drain_losses()
+            if loss_series and not all(np.isfinite(loss_series)):
+                _mlhealth.check_fit(algo, {"loss": loss_series},
+                                    finite=False)
+            elif final:
+                _mlhealth.check_fit(algo, {"loss": loss_series},
+                                    finite=True)
 
         # the mesh initializes the device backend — only on the first
         # device-eligible batch (dense, or sparse above the nnz gate), so
@@ -517,14 +574,22 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                 if mesh is None:
                     mesh = default_mesh()
                     axes = data_axes(mesh)
-                program = _ftrl_program(mesh, alpha, beta, l1, l2)
+                program = _ftrl_program(mesh, alpha, beta, l1, l2,
+                                        health=health_on)
                 xb, n_rows = ensure_on_mesh(mesh, x, axes, jnp.float32)
                 ycol = batch.column(self.label_col)  # device col stays put
                 if isinstance(ycol, np.ndarray):
                     ycol = batch.scalars(self.label_col)
                 yb, _ = ensure_on_mesh(mesh, ycol, axes, jnp.float32)
-                commit_device_state(
-                    program(xb, yb, jnp.float32(n_rows), *device_state()))
+                out = program(xb, yb, jnp.float32(n_rows),
+                              *device_state())
+                if health_on:
+                    *state, batch_loss = out
+                    loss_pending.append(batch_loss)
+                    if len(loss_pending) >= _HISTORY_DEV_CAP:
+                        check_losses()
+                    out = tuple(state)
+                commit_device_state(out)
                 n_dense += 1
                 continue
             y = batch.scalars(self.label_col, np.float64)
@@ -552,13 +617,14 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                         mesh = default_mesh()
                         axes = data_axes(mesh)
                     program = _ftrl_sparse_program(mesh, alpha, beta,
-                                                   l1, l2)
+                                                   l1, l2,
+                                                   health=health_on)
                     packed = _pack_csr_shards(x, y, w_col,
                                               data_shard_count(mesh))
                     sh = NamedSharding(mesh, P(data_pspec(mesh), None))
                     packed_dev = tuple(jax.device_put(a, sh)
                                        for a in packed)
-                    new_state = program(*packed_dev, *device_state())
+                    out = program(*packed_dev, *device_state())
                     if n_sparse_dev == 0:
                         # first sparse-device batch runs SYNCHRONOUSLY:
                         # dispatch is async, so without this an execution
@@ -566,10 +632,26 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                         # a blocking fetch outside this try and crash the
                         # fit instead of degrading. Later batches reuse
                         # the proven program shape and stay async.
-                        jax.block_until_ready(new_state)
+                        jax.block_until_ready(out)
+                    if health_on:
+                        *new_state, batch_loss = out
+                        new_state = tuple(new_state)
+                    else:
+                        new_state, batch_loss = out, None
                     commit_device_state(new_state)
+                    if health_on:
+                        loss_pending.append(batch_loss)
+                        if len(loss_pending) >= _HISTORY_DEV_CAP:
+                            check_losses()
                     n_sparse_dev += 1
                     continue
+                except _mlhealth.NonFiniteState:
+                    # the health drain above found a NaN batch: that is
+                    # the terminal divergence verdict, NOT a device
+                    # failure — it must not be misread as "sparse engine
+                    # broken" (which would demote to host and re-apply
+                    # the already-committed batch)
+                    raise
                 except Exception:
                     # a synchronous device-sparse failure (backend down,
                     # lowering, first-batch execution error) degrades to
@@ -594,7 +676,15 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
             # non-zero coordinates; weightSum adds the sample weight
             # there (dense adds 1.0 everywhere). Never densifies: CSR
             # matvec + bincount scatter at 2^18 dims stays O(nnz).
-            p = 1.0 / (1.0 + np.exp(-(x @ coeffs)))
+            dots = x @ coeffs
+            p = 1.0 / (1.0 + np.exp(-dots))
+            if health_on:
+                xent = np.logaddexp(0.0, dots) - y * dots
+                loss_series.append(
+                    float(np.sum(w_col * xent)
+                          / max(float(w_col.sum()), 1e-30)))
+                if not math.isfinite(loss_series[-1]):
+                    check_losses()
             row_nnz = np.diff(x.indptr)
             d = x.shape[1]
             grad = np.bincount(
@@ -616,6 +706,15 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
         ckpt.complete(pack)
         to_host()
         materialize_history()
+        if health_on:
+            # end-of-stream drain: the full per-batch loss series lands
+            # in ml.health (+ convergence events), a non-finite batch
+            # raises the terminal NonFiniteState
+            check_losses(final=True)
+        # the batch loss is computed from PRE-update coefficients, so a
+        # divergence on the very last update only shows in the state:
+        # the cheap final guard covers it on every path
+        _mlhealth.guard_final_state(algo, coeffs)
         # benchmark provenance (runner.py executionPath): where the FTRL
         # batch updates actually ran
         parts = (("device", n_dense), ("device-csr", n_sparse_dev),

@@ -8,7 +8,10 @@ with ``flink-ml-tpu-trace <dir>``; compare/gate two runs with
 ``FLINK_ML_TPU_PROFILE_DIR`` jax.profiler hook (common/metrics.py)
 rather than replacing it. Compile telemetry (``compilestats``) records
 XLA compile counts/durations, recompile storms, per-program FLOP/byte
-cost and HBM watermarks into the same artifact set.
+cost and HBM watermarks into the same artifact set. Model-health
+telemetry (``health``) adds convergence series, device-side non-finite
+sentinels, divergence events and serving-path metrics — inspect with
+``flink-ml-tpu-trace health <dir>``.
 """
 
 from flink_ml_tpu.observability.compilestats import (
@@ -18,6 +21,17 @@ from flink_ml_tpu.observability.compilestats import (
     compile_totals,
     instrumented_jit,
     sample_memory,
+)
+from flink_ml_tpu.observability.health import (
+    CONVERGENCE_EVENT,
+    HEALTH_EVENT,
+    ConvergenceListener,
+    check_fit,
+    convergence_row,
+    finite_sentinel,
+    guard_final_state,
+    observe_serving,
+    summarize_values,
 )
 from flink_ml_tpu.observability.exporters import (
     chrome_trace,
@@ -37,10 +51,19 @@ from flink_ml_tpu.observability.tracing import (
 )
 
 __all__ = [
+    "CONVERGENCE_EVENT",
+    "HEALTH_EVENT",
     "TRACE_DIR_ENV",
+    "ConvergenceListener",
     "Span",
     "Tracer",
     "aot_compile",
+    "check_fit",
+    "convergence_row",
+    "finite_sentinel",
+    "guard_final_state",
+    "observe_serving",
+    "summarize_values",
     "capture_cost",
     "chrome_trace",
     "compile_stats",

@@ -6,11 +6,13 @@ and did it recompile/retry/checkpoint more than it should?" without
 rerunning anything:
 
     flink-ml-tpu-trace TRACE_DIR                 # summary (text)
-    flink-ml-tpu-trace TRACE_DIR --format json   # summary (machine)
+    flink-ml-tpu-trace summary TRACE_DIR --json  # summary (machine)
+    flink-ml-tpu-trace TRACE_DIR --format json   # same, legacy spelling
     flink-ml-tpu-trace TRACE_DIR --chrome t.json # Perfetto-loadable trace
     flink-ml-tpu-trace TRACE_DIR --prometheus    # metrics text exposition
     flink-ml-tpu-trace TRACE_DIR --check         # exit 2 on empty/invalid
     flink-ml-tpu-trace diff A B --budget 20      # regression gate (exit 4)
+    flink-ml-tpu-trace health TRACE_DIR --check  # model health (exit 3)
 
 Sections: top spans by self-time (time in a span minus its children —
 where work actually happened), per-epoch breakdown (host/device split,
@@ -20,6 +22,11 @@ chronological order. The ``diff`` subcommand (observability/diff.py)
 compares two trace dirs or metrics snapshots — span self-time deltas,
 histogram-quantile deltas, compile-count deltas — and with ``--budget``
 exits 4 on a regression: CI's and the unattended TPU sweep's perf gate.
+The ``health`` subcommand (observability/health.py) renders the
+model-health view — per-fit convergence tables, the ml.health
+divergence timeline, serving metrics — and with ``--check`` exits 3
+when any health event is present: the divergence gate for CI and
+unattended sweeps.
 """
 
 from __future__ import annotations
@@ -155,6 +162,17 @@ def main(argv=None) -> int:
         from flink_ml_tpu.observability.diff import main as diff_main
 
         return diff_main(argv[1:])
+    if argv and argv[0] == "health":
+        # model-health view (observability/health.py); same dispatch
+        # rule — use ./health to summarize a directory named "health"
+        from flink_ml_tpu.observability.health import main as health_main
+
+        return health_main(argv[1:])
+    if argv and argv[0] == "summary":
+        # explicit subcommand spelling for the default view, so
+        # unattended consumers can write `summary --json` without
+        # knowing the bare-positional legacy form
+        argv = argv[1:]
     parser = argparse.ArgumentParser(
         prog="flink-ml-tpu-trace",
         description="Summarize a FLINK_ML_TPU_TRACE_DIR trace directory "
@@ -167,6 +185,9 @@ def main(argv=None) -> int:
                              "Prometheus text exposition format")
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
+    parser.add_argument("--json", action="store_true",
+                        help="shorthand for --format json (machine-"
+                             "readable summary for unattended sweeps)")
     parser.add_argument("--top", type=int, default=15,
                         help="rows in the self-time table")
     parser.add_argument("--check", action="store_true",
@@ -202,7 +223,7 @@ def main(argv=None) -> int:
 
     summary = summarize(spans)
     try:
-        if args.format == "json":
+        if args.json or args.format == "json":
             print(json.dumps(summary, indent=2, default=str))
         else:
             print(render_summary(summary, top_n=args.top))

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from flink_ml_tpu.observability import health as _health
 from flink_ml_tpu.ops.losses import LossFunc
 from flink_ml_tpu.ops.regularization import regularize
 from flink_ml_tpu.parallel.mesh import (
@@ -163,19 +164,30 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
 
 
 @functools.lru_cache(maxsize=128)
-def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams):
+def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams,
+                               health: bool = False):
     """A K-round slice of the training loop as ONE compiled SPMD program:
-    ``segment(xs, ys, ws, coeffs, offsets, epoch0, limit) -> (coeffs,
-    offsets, mean_loss, epoch, stop)``.  The epoch bounds are device
-    scalars, so every segment of a checkpointed fit reuses a single
-    compilation; between segments the host snapshots the carry
-    (iteration.run_segmented) — fault tolerance at fast-path speed, the
-    composition the reference gets from checkpointing *through* the
-    iteration (Checkpoints.java:43).
+    ``segment(xs, ys, ws, coeffs, offsets, epoch0, limit, hist, fin) ->
+    (coeffs, offsets, mean_loss, epoch, stop, hist, fin)``.  The epoch
+    bounds are device scalars, so every segment of a checkpointed fit
+    reuses a single compilation; between segments the host snapshots the
+    carry (iteration.run_segmented) — fault tolerance at fast-path
+    speed, the composition the reference gets from checkpointing
+    *through* the iteration (Checkpoints.java:43).
 
     The plain (uncheckpointed) fit is the degenerate call
     ``segment(..., epoch0=0, limit=max_iter)`` — ONE program serves both,
-    so the two paths cannot drift numerically."""
+    so the two paths cannot drift numerically.
+
+    With ``health`` (observability/health.py), the signature grows two
+    trailing carries and each round writes its ``(loss, update norm,
+    param norm)`` convergence row into the ``hist`` buffer (a replicated
+    ``(max_iter, 3)`` carry — the DrJAX-style first-class numeric
+    output) and folds ONE non-finite sentinel scalar into ``fin``; the
+    host reads both only at segment boundaries, so telemetry adds zero
+    extra device syncs. Without ``health`` the signature is EXACTLY the
+    pre-health 7-in/5-out contract (external callers — the TPU
+    profiling scripts — build with the default flag)."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     p = data_shard_count(mesh)
@@ -183,29 +195,47 @@ def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams):
     wspec = P(model_axis) if model_axis else P()
     round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis)
 
-    def per_shard(xl, yl, wl, coeffs, offsets, epoch0, limit):
+    def run(xl, yl, wl, coeffs, offsets, epoch0, limit, hist, fin):
         def cond(state):
-            _, _, _, epoch, stop = state
+            _, _, _, epoch, stop, _, _ = state
             return jnp.logical_and(epoch < limit, jnp.logical_not(stop))
 
         def step(state):
-            coeffs, offset, _, epoch, _ = state
-            coeffs, new_offset, mean_loss = round_step(xl, yl, wl, coeffs,
-                                                       offset)
-            return (coeffs, new_offset, mean_loss, epoch + 1,
-                    mean_loss < prm.tol)
+            coeffs, offset, _, epoch, _, hist, fin = state
+            new_coeffs, new_offset, mean_loss = round_step(
+                xl, yl, wl, coeffs, offset)
+            if health:
+                row, row_fin = _health.convergence_row(
+                    mean_loss, coeffs, new_coeffs, model_axis)
+                hist = jax.lax.dynamic_update_slice(
+                    hist, row[None], (epoch, jnp.int32(0)))
+                fin = jnp.logical_and(fin, row_fin)
+            return (new_coeffs, new_offset, mean_loss, epoch + 1,
+                    mean_loss < prm.tol, hist, fin)
 
         init = (coeffs, offsets[0], jnp.asarray(jnp.inf, coeffs.dtype),
-                epoch0, jnp.asarray(False))
-        coeffs, offset, mean_loss, epoch, stop = jax.lax.while_loop(
-            cond, step, init)
-        return coeffs, offset[None], mean_loss, epoch, stop
+                epoch0, jnp.asarray(False), hist, fin)
+        coeffs, offset, mean_loss, epoch, stop, hist, fin = \
+            jax.lax.while_loop(cond, step, init)
+        return coeffs, offset[None], mean_loss, epoch, stop, hist, fin
+
+    if health:
+        per_shard = run
+        extra_in, extra_out = (P(), P()), (P(), P())
+    else:
+        def per_shard(xl, yl, wl, coeffs, offsets, epoch0, limit):
+            return run(xl, yl, wl, coeffs, offsets, epoch0, limit,
+                       jnp.zeros((0, 3), jnp.float32),
+                       jnp.asarray(True))[:5]
+
+        extra_in, extra_out = (), ()
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
-                  P(spec0), P(), P()),
-        out_specs=(wspec, P(spec0), P(), P(), P()), check_vma=False))
+                  P(spec0), P(), P()) + extra_in,
+        out_specs=(wspec, P(spec0), P(), P(), P()) + extra_out,
+        check_vma=False))
 
 
 #: plain fits with at most this many rounds compile fully unrolled with
@@ -238,15 +268,21 @@ def _static_batch_schedule(local_n: int, lb: int, max_iter: int):
 
 @functools.lru_cache(maxsize=128)
 def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
-                                use_kernel: bool = False):
+                                use_kernel: bool = False,
+                                health: bool = False):
     """The plain (uncheckpointed, fresh-offset) fit as ONE fully-unrolled
     SPMD program: ``fit(xs, ys, ws, coeffs, offsets) -> (coeffs, offsets,
-    mean_loss, epoch, stop)`` — the same carry as the segment program. The
-    tol early-exit becomes masking (rounds after the stop compute and are
-    discarded by ``where``), so the result — coeffs, final offsets, the
-    loss AT the stopping round, the executed-round count — is identical to
-    the while program's by construction. Only valid for offsets == 0 and
-    gb %% p == 0 (the dispatch in ``optimize`` guarantees both).
+    mean_loss, epoch, stop)`` — the same carry as the segment program.
+    The tol early-exit becomes masking (rounds after the stop compute
+    and are discarded by ``where``), so the result — coeffs, final
+    offsets, the loss AT the stopping round, the executed-round count —
+    is identical to the while program's by construction. Only valid for
+    offsets == 0 and gb %% p == 0 (the dispatch in ``optimize``
+    guarantees both). With ``health`` the outputs grow ``(..., hist,
+    fin)``: the stacked per-round ``(max_iter, 3)`` convergence rows
+    (NaN past the stopping round) and the single non-finite sentinel
+    folded over the executed rounds (observability/health.py); without
+    it the pre-health 5-output contract is unchanged.
 
     With ``use_kernel`` (TPU, DP-only mesh), rounds whose window aligns
     to a shared tile run the fused pallas batch-terms kernel — one pass
@@ -276,6 +312,8 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
         mean_loss = jnp.asarray(jnp.inf, coeffs.dtype)
         epoch = jnp.int32(0)
         stop = jnp.asarray(False)
+        rows = []
+        fin = jnp.asarray(True)
         for start, clip in sched:
             if tile:
                 from flink_ml_tpu.ops.pallas_kernels import sgd_batch_terms
@@ -292,19 +330,35 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
             new_off = jnp.int32(0 if start + clip + lb >= local_n
                                 else start + clip + lb)
             active = jnp.logical_not(stop)
+            if health:
+                # first-class numeric telemetry: the round's convergence
+                # row + ONE isfinite fold over loss and every parameter
+                # element; rounds past the tol stop record NaN rows and
+                # never poison the sentinel (they are masked out anyway)
+                row, row_fin = _health.convergence_row(
+                    new_loss, coeffs, updated, model_axis)
+                rows.append(jnp.where(
+                    active, row, jnp.full((3,), jnp.nan, jnp.float32)))
+                fin = jnp.logical_and(fin, jnp.logical_or(
+                    jnp.logical_not(active), row_fin))
             coeffs = jnp.where(active, updated, coeffs)
             offset = jnp.where(active, new_off, offset)
             mean_loss = jnp.where(active, new_loss, mean_loss)
             epoch = epoch + active.astype(jnp.int32)
             stop = jnp.logical_or(stop, jnp.logical_and(
                 active, new_loss < prm.tol))
+        if health:
+            return (coeffs, offset[None], mean_loss, epoch, stop,
+                    jnp.stack(rows), fin)
         return coeffs, offset[None], mean_loss, epoch, stop
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
                   P(spec0)),
-        out_specs=(wspec, P(spec0), P(), P(), P()), check_vma=False))
+        out_specs=(wspec, P(spec0), P(), P(), P())
+        + ((P(), P()) if health else ()),
+        check_vma=False))
 
 
 @functools.lru_cache(maxsize=128)
@@ -349,6 +403,32 @@ def _tp_prepare_program(rem: int, pad_d: int, sharding):
     return jax.jit(prep, out_shardings=row_major_format(sharding, 2))
 
 
+def _health_tag(loss_func: LossFunc, tag: Optional[str]) -> str:
+    if tag:
+        return tag
+    name = getattr(type(loss_func), "NAME", None)
+    return f"SGD[{name or type(loss_func).__name__}]"
+
+
+def _finish_fit_health(algo: str, health_on: bool, hist, fin, epochs,
+                       mean_loss, coeffs_host, epoch0: int = 0) -> None:
+    """The shared health tail of every SGD fit path: with telemetry
+    armed, record the executed slice of the device-produced convergence
+    history and classify divergence (raising the terminal NonFiniteState
+    when the in-program sentinel tripped); otherwise run the cheap
+    always-on guard over the already-fetched final state."""
+    if health_on and hist is not None:
+        h = np.asarray(hist, np.float64)
+        lo = min(int(epoch0), h.shape[0])
+        hi = min(int(epochs), h.shape[0])
+        _health.check_fit(
+            algo, {"loss": h[lo:hi, 0], "updateNorm": h[lo:hi, 1],
+                   "paramNorm": h[lo:hi, 2]},
+            finite=bool(fin), epoch0=lo)
+    else:
+        _health.guard_final_state(algo, coeffs_host, loss=mean_loss)
+
+
 class SGD:
     """Ref: Optimizer/SGD — optimize(initModel, trainData) → fitted coeffs."""
 
@@ -359,7 +439,8 @@ class SGD:
                      features_csr, labels: np.ndarray,
                      weights: Optional[np.ndarray] = None,
                      mesh: Optional[Mesh] = None,
-                     config=None, listeners=()):
+                     config=None, listeners=(),
+                     tag: Optional[str] = None):
         """Host CSR fallback for wide sparse input (HashingTF at 2^18 dims
         would need terabytes dense — ref trains SparseVector natively,
         OnlineLogisticRegression.java:364-388 / BLAS.java:78).
@@ -422,6 +503,14 @@ class SGD:
 
         from flink_ml_tpu.iteration.iteration import iterate_bounded
 
+        algo = _health_tag(loss_func, tag)
+        health_on = _health.armed()
+        if health_on:
+            # host rounds: convergence telemetry rides a listener at the
+            # epoch boundary — the carry is already host float64 here
+            listeners = tuple(listeners) + (
+                _health.ConvergenceListener.for_params(algo, init_coeffs),)
+
         init = (np.asarray(init_coeffs, np.float64).copy(),
                 np.zeros(p, np.int64), np.float64(np.inf))
         coeffs, _, mean_loss = iterate_bounded(
@@ -429,6 +518,8 @@ class SGD:
             terminate=lambda carry, epoch: carry[2] < prm.tol,
             config=config, listeners=listeners, jit_round=False)
         self.last_execution_path = "csr-host"
+        if not health_on:
+            _health.guard_final_state(algo, coeffs, loss=mean_loss)
         return coeffs, float(mean_loss)
 
     def optimize(self, loss_func: LossFunc, init_coeffs: np.ndarray,
@@ -436,14 +527,24 @@ class SGD:
                  weights: Optional[np.ndarray] = None,
                  mesh: Optional[Mesh] = None,
                  dtype=jnp.float32,
-                 config=None, listeners=()):
+                 config=None, listeners=(),
+                 tag: Optional[str] = None):
         """Returns (coeffs (d,) np.ndarray, final mean loss float).
 
         With ``config``/``listeners`` (an ``IterationConfig`` needing host
         hooks — checkpointing, per-round callbacks), training runs as host-
         driven rounds through ``iterate_bounded``: resumable mid-fit from a
         checkpoint with results identical to the all-device program (the
-        fault-injection bar of BoundedAllRoundCheckpointITCase)."""
+        fault-injection bar of BoundedAllRoundCheckpointITCase).
+
+        ``tag`` labels this fit's model-health telemetry (the estimator
+        class name from models/common.py); with telemetry armed
+        (observability/health.py) the compiled programs return per-epoch
+        convergence rows + a non-finite sentinel, and every path raises
+        the terminal ``NonFiniteState`` on a NaN/Inf state instead of
+        returning garbage coefficients."""
+        algo = _health_tag(loss_func, tag)
+        health_on = _health.armed()
         mesh = mesh or default_mesh()
         n = features.shape[0]
         d = features.shape[1]
@@ -529,15 +630,18 @@ class SGD:
                 try:
                     prog = _build_sgd_unrolled_program(
                         type(loss_func), mesh, self.params,
-                        use_kernel=use_kernel)
+                        use_kernel=use_kernel, health=health_on)
                     # materialize INSIDE the try: async dispatch surfaces
                     # kernel-execution failures only here
-                    coeffs, _, mean_loss, _, _ = prog(xs, ys, ws, init[0],
-                                                      init[1])
+                    res = prog(xs, ys, ws, init[0], init[1])
+                    coeffs, _, mean_loss, epoch, _ = res[:5]
+                    hist, fin = (res[5:] if health_on else (None, True))
                     self.last_execution_path = (
                         "pallas-unrolled" if use_kernel else "xla-unrolled")
-                    return (np.asarray(coeffs, np.float64)[:d],
-                            float(mean_loss))
+                    out = np.asarray(coeffs, np.float64)[:d]
+                    _finish_fit_health(algo, health_on, hist, fin, epoch,
+                                       mean_loss, out)
+                    return out, float(mean_loss)
                 except Exception as e:
                     if not use_kernel or not is_pallas_failure(e):
                         raise
@@ -549,19 +653,57 @@ class SGD:
                     _pallas_sgd_broken = True
                     prog = _build_sgd_unrolled_program(
                         type(loss_func), mesh, self.params,
-                        use_kernel=False)
-                    coeffs, _, mean_loss, _, _ = prog(xs, ys, ws, init[0],
-                                                      init[1])
+                        use_kernel=False, health=health_on)
+                    res = prog(xs, ys, ws, init[0], init[1])
+                    coeffs, _, mean_loss, epoch, _ = res[:5]
+                    hist, fin = (res[5:] if health_on else (None, True))
                 self.last_execution_path = "xla-unrolled"
-                return np.asarray(coeffs, np.float64)[:d], float(mean_loss)
+                out = np.asarray(coeffs, np.float64)[:d]
+                _finish_fit_health(algo, health_on, hist, fin, epoch,
+                                   mean_loss, out)
+                return out, float(mean_loss)
             seg_prog = _build_sgd_segment_program(type(loss_func), mesh,
-                                                  self.params)
+                                                  self.params,
+                                                  health=health_on)
+            # health carry lives OUTSIDE the checkpointed carry so the
+            # snapshot format is identical with telemetry on or off; a
+            # restore simply resumes the series at its epoch (earlier
+            # rows stay NaN and are sliced off by `first`)
+            repl = NamedSharding(mesh, P())
+            hstate = {
+                "hist": jax.device_put(jnp.full(
+                    (self.params.max_iter if health_on else 0, 3),
+                    jnp.nan, jnp.float32), repl),
+                "fin": jax.device_put(jnp.asarray(True), repl),
+                "first": None, "epoch": 0,
+            }
 
             def run_segment(carry, epoch0, limit):
                 coeffs, offsets, _ = carry
-                coeffs, offsets, mean_loss, epoch, stop = seg_prog(
-                    xs, ys, ws, coeffs, offsets,
-                    jnp.int32(epoch0), jnp.int32(limit))
+                if hstate["first"] is None:
+                    hstate["first"] = int(epoch0)
+                if health_on:
+                    (coeffs, offsets, mean_loss, epoch, stop,
+                     hstate["hist"], hstate["fin"]) = seg_prog(
+                        xs, ys, ws, coeffs, offsets,
+                        jnp.int32(epoch0), jnp.int32(limit),
+                        hstate["hist"], hstate["fin"])
+                else:
+                    coeffs, offsets, mean_loss, epoch, stop = seg_prog(
+                        xs, ys, ws, coeffs, offsets,
+                        jnp.int32(epoch0), jnp.int32(limit))
+                if health_on:
+                    # epoch-boundary health check: the segment boundary
+                    # is this mode's host sync point, so reading the
+                    # sentinel costs no extra round-trip — and a NaN
+                    # state fails the fit NOW instead of burning the
+                    # remaining segments
+                    hstate["epoch"] = int(epoch)
+                    if not bool(hstate["fin"]):
+                        _finish_fit_health(
+                            algo, True, hstate["hist"], False,
+                            hstate["epoch"], mean_loss, None,
+                            epoch0=hstate["first"])
                 return (coeffs, offsets, mean_loss), epoch, stop
 
             if seg_k:
@@ -573,7 +715,12 @@ class SGD:
                     init, 0, self.params.max_iter)
             self.last_execution_path = ("xla-while-segments" if seg_k
                                         else "xla-while")
-            return np.asarray(coeffs, np.float64)[:d], float(mean_loss)
+            out = np.asarray(coeffs, np.float64)[:d]
+            _finish_fit_health(
+                algo, health_on, hstate["hist"] if health_on else None,
+                hstate["fin"], hstate["epoch"], mean_loss, out,
+                epoch0=hstate["first"] or 0)
+            return out, float(mean_loss)
 
         from flink_ml_tpu.iteration.iteration import iterate_bounded
 
@@ -586,10 +733,22 @@ class SGD:
                                                   offsets)
             return coeffs, offsets, mean_loss
 
+        if health_on:
+            # host-driven rounds: the health series rides an extra
+            # listener instead of a program variant (the listeners are
+            # what forced this mode); it reads lagged carries so the
+            # loop's listener-vs-device overlap survives
+            listeners = tuple(listeners) + (
+                _health.ConvergenceListener.for_params(
+                    algo, np.asarray(w0)),)
+
         final = iterate_bounded(
             init, body, max_iter=self.params.max_iter,
             terminate=lambda carry, epoch: carry[2] < self.params.tol,
             config=config, listeners=listeners)
         coeffs, _, mean_loss = final
         self.last_execution_path = "host-rounds"
-        return np.asarray(coeffs, np.float64)[:d], float(mean_loss)
+        out = np.asarray(coeffs, np.float64)[:d]
+        if not health_on:
+            _health.guard_final_state(algo, out, loss=mean_loss)
+        return out, float(mean_loss)

@@ -16,6 +16,7 @@ from flink_ml_tpu.resilience.policy import (  # noqa: F401
     RETRYABLE,
     TERMINAL,
     InjectedFault,
+    NonFiniteState,
     RestartsExhausted,
     RetryableFailure,
     RetryPolicy,
@@ -28,6 +29,7 @@ __all__ = [
     "RETRYABLE",
     "TERMINAL",
     "InjectedFault",
+    "NonFiniteState",
     "RestartsExhausted",
     "RetryableFailure",
     "RetryPolicy",

@@ -73,6 +73,26 @@ class RestartsExhausted(TerminalFailure):
             f"gave up after {attempts} restart(s): {reason}")
 
 
+class NonFiniteState(TerminalFailure):
+    """A fit's numeric state (loss or parameters) went NaN/Inf.
+
+    Terminal: SGD-family divergence is deterministic — a restart replays
+    the same batch schedule into the same overflow, so retrying burns the
+    whole restart budget without progress (the exit-3 class). Raised by
+    the model-health layer (observability/health.py) when its non-finite
+    sentinel trips; the ``ml.health`` divergence event carries the same
+    coordinates into the trace."""
+
+    def __init__(self, algo: str, epoch: Optional[int] = None,
+                 detail: str = ""):
+        self.algo = algo
+        self.epoch = epoch
+        where = f" at epoch {epoch}" if epoch is not None else ""
+        tail = f" ({detail})" if detail else ""
+        super().__init__(
+            f"{algo} diverged to a non-finite state{where}{tail}")
+
+
 #: failures that indicate a bug or invalid input — retrying replays the
 #: same deterministic computation into the same wall (the sweep's exit-3
 #: class). NotImplementedError is a RuntimeError subclass, so it must be

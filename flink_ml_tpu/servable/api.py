@@ -8,6 +8,9 @@ servable/types/DataTypes.java.
 from __future__ import annotations
 
 import enum
+import functools
+import logging
+import time
 from typing import Any, List, Optional, Sequence
 
 import numpy as np
@@ -148,8 +151,54 @@ class DataFrame:
         return len(self._rows)
 
 
+def _served(method):
+    """Wrap a servable ``transform`` with serving-path model metrics
+    (observability/health.py): transform latency + row-count histograms
+    and a prediction-distribution summary (min/max/mean/finite-fraction)
+    labeled by servable class — the ``MLMetrics`` role of the
+    reference's servable core, and this repo's drift baseline. Recording
+    failures are logged, never raised: telemetry must not sink a serving
+    call."""
+
+    @functools.wraps(method)
+    def wrapper(self, df: DataFrame) -> DataFrame:
+        start = time.perf_counter()
+        out = method(self, df)
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+        try:
+            from flink_ml_tpu.observability import health
+
+            predictions = None
+            rows = df.num_rows() if isinstance(df, DataFrame) else 0
+            if isinstance(out, DataFrame):
+                rows = out.num_rows()
+                col = getattr(self, "prediction_col", None)
+                if col and col in out.column_names:
+                    predictions = out.get(col).values
+            health.observe_serving(type(self).__name__, rows, elapsed_ms,
+                                   predictions=predictions)
+        except Exception:  # noqa: BLE001 — see docstring
+            logging.getLogger(__name__).warning(
+                "serving metrics recording failed", exc_info=True)
+        return out
+
+    wrapper._served = True
+    return wrapper
+
+
 class TransformerServable:
-    """Ref: servable/api/TransformerServable.java."""
+    """Ref: servable/api/TransformerServable.java.
+
+    Beyond the reference's interface: every concrete ``transform`` is
+    wrapped with the ``ml.serving`` metrics of observability/health.py
+    (latency/row histograms + prediction-distribution summary), the
+    same pattern api/stage.py applies to Estimator/AlgoOperator."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        impl = cls.__dict__.get("transform")
+        if impl is not None and not getattr(impl, "_served", False):
+            cls.transform = _served(impl)
 
     def transform(self, df: DataFrame) -> DataFrame:
         raise NotImplementedError

@@ -66,6 +66,14 @@ class LogisticRegressionModelServable(ModelServable, HasFeaturesCol,
                       else np.asarray(f, np.float64) for f in features])
         dots = x @ self.model_data.coefficient
         prob = 1.0 - 1.0 / (1.0 + np.exp(dots))
+        # probability-distribution drift baseline (observability/
+        # health.py): the 0/1 prediction column the _served wrapper
+        # summarizes hides a NaN margin ((nan >= 0) is False), so the
+        # probabilities are summarized here explicitly — a model serving
+        # garbage raises the ml.health non-finite-probability event
+        from flink_ml_tpu.observability import health
+
+        health.summarize_values(type(self).__name__, "probability", prob)
         predictions = (dots >= 0).astype(np.float64)
         raw = [DenseVector([1 - p, p]) for p in prob]
         df.add_column(self.prediction_col, DataTypes.DOUBLE,

@@ -536,3 +536,130 @@ def test_kmeans_supervised_traced_fit_golden(tmp_path, monkeypatch, rng):
     doc = chrome_trace(str(trace_dir))
     assert any(e["ph"] == "X" and e["name"] == "epoch"
                for e in doc["traceEvents"])
+
+
+# -- summary subcommand + --json (ISSUE 5 satellite) --------------------------
+
+def test_summary_subcommand_json(traced_supervised_fit, capsys):
+    """`flink-ml-tpu-trace summary <dir> --json` — machine-readable
+    output for unattended sweeps, no text scraping."""
+    trace_dir, _ = traced_supervised_fit
+    assert trace_cli(["summary", trace_dir, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["spans"] > 0
+    assert any(r["what"] == "supervisor.restart" for r in doc["timeline"])
+    # the bare-positional legacy spellings keep working
+    assert trace_cli([trace_dir, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["spans"] == doc["spans"]
+    assert trace_cli(["summary", trace_dir]) == 0
+    assert "top spans by self-time:" in capsys.readouterr().out
+
+
+# -- histogram_quantile edge contracts (ISSUE 5 satellite) --------------------
+
+def test_histogram_quantile_rejects_invalid_q():
+    from flink_ml_tpu.common.metrics import histogram_quantile
+    snap = {"buckets": [1.0, 10.0], "counts": [1, 2], "sum": 7.0,
+            "count": 2}
+    for bad in (-0.1, 1.5, float("nan")):
+        with pytest.raises(ValueError):
+            histogram_quantile(snap, bad)
+
+
+def test_histogram_quantile_empty_and_bucketless():
+    import math as _math
+
+    from flink_ml_tpu.common.metrics import Histogram, histogram_quantile
+    assert _math.isnan(histogram_quantile({"count": 0}, 0.5))
+    assert _math.isnan(Histogram(buckets=(1.0, 2.0)).quantile(0.5))
+    # count present but no buckets: still NaN, never IndexError
+    assert _math.isnan(histogram_quantile({"count": 3}, 0.5))
+
+
+def test_histogram_quantile_q0_q1_and_single_bucket():
+    from flink_ml_tpu.common.metrics import Histogram
+    h = Histogram(buckets=(5.0,))
+    h.observe(3.0)
+    h.observe(7.0)  # lands past the last finite bound (+Inf bucket)
+    assert h.quantile(0.0) == 0.0  # implicit lower bound
+    assert 0.0 < h.quantile(0.5) <= 5.0
+    assert h.quantile(1.0) == 5.0  # clamps to the last finite bound
+    # q=1 with every observation inside the finite buckets interpolates
+    # to the winning bucket's upper bound
+    h2 = Histogram(buckets=(1.0, 10.0))
+    h2.observe(0.5)
+    h2.observe(5.0)
+    assert h2.quantile(1.0) == 10.0
+
+
+# -- Prometheus label-value escaping (ISSUE 5 satellite) ----------------------
+
+def test_prometheus_label_value_escaping():
+    r"""Text-format spec: label values escape backslash (\\), newline
+    (\n) and double-quote (\") — round-tripped through metric_key and
+    rendered verbatim by the exposition."""
+    from flink_ml_tpu.common.metrics import metric_key
+
+    assert metric_key("m", {"p": "a\\b"}) == 'm{p="a\\\\b"}'
+    assert metric_key("m", {"p": 'say "hi"'}) == 'm{p="say \\"hi\\""}'
+    assert metric_key("m", {"p": "l1\nl2"}) == 'm{p="l1\\nl2"}'
+
+    reg = MetricsRegistry()
+    g = reg.group("ml", "esc")
+    g.counter("hits", labels={"path": 'a\\b"c'})
+    g.gauge("v", 1.5, labels={"note": "line1\nline2"})
+    g.histogram("h", buckets=(1.0,), labels={"q": '"'}).observe(0.5)
+    text = prometheus_text(reg.snapshot())
+    assert 'flink_ml_tpu_ml_esc_hits_total{path="a\\\\b\\"c"} 1' in text
+    assert 'flink_ml_tpu_ml_esc_v{note="line1\\nline2"} 1.5' in text
+    assert 'flink_ml_tpu_ml_esc_h_bucket{q="\\"",le="1"} 1' in text
+    # the raw newline never reaches the exposition body (it would split
+    # the sample line and break the line-oriented grammar)
+    assert "line1\nline2" not in text
+
+
+# -- health metrics across the host-pool fork (ISSUE 5 satellite) -------------
+
+def test_hostpool_child_health_metrics_merge(tmp_path, monkeypatch):
+    """Model-health series recorded in forked host-pool children
+    (ml.health histograms, ml.serving envelopes) must fold into the
+    driver registry exactly like the systems metrics do."""
+    if not hasattr(os, "fork"):
+        pytest.skip("no fork on this platform")
+    from flink_ml_tpu.observability import health
+
+    trace_dir = tmp_path / "trace"
+    monkeypatch.setenv(TRACE_DIR_ENV, str(trace_dir))
+    algo_labels = {"algo": "ForkFit"}
+    serve_labels = {"servable": "ForkServable"}
+    h_before = metrics.group("ml", "health").histogram(
+        "loss", buckets=health.VALUE_BUCKETS,
+        labels=algo_labels).snapshot()["count"]
+    t_before = metrics.group("ml", "serving").get_counter(
+        "transforms", labels=serve_labels)
+
+    def fn(lo, hi):
+        health.record_fit_series("ForkFit",
+                                 {"loss": [1.0, 0.5], "paramNorm": [1.0, 2.0]})
+        health.observe_serving("ForkServable", hi - lo, 1.25,
+                               predictions=[0.0, 1.0])
+        return hi - lo
+
+    out = map_row_shards(fn, 8, workers=2, min_rows=2, shard_cap=4)
+    assert out == [4, 4]
+    tracer.shutdown()
+
+    merged = metrics.group("ml", "health").histogram(
+        "loss", buckets=health.VALUE_BUCKETS,
+        labels=algo_labels).snapshot()
+    assert merged["count"] - h_before == 4  # 2 children x 2 epochs
+    assert metrics.group("ml", "serving").get_counter(
+        "transforms", labels=serve_labels) - t_before == 2
+    # gauges last-write-win across the merge; fractions stay sane
+    assert metrics.group("ml", "serving").get_gauge(
+        "predictionFiniteFraction", labels=serve_labels) == 1.0
+    # the children's convergence events reached the trace files too
+    spans = read_spans(str(trace_dir))
+    conv = [ev for sp in spans for ev in sp.get("events", ())
+            if ev.get("name") == health.CONVERGENCE_EVENT]
+    assert len(conv) == 4
