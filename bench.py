@@ -204,6 +204,9 @@ def _worker(role: str) -> int:
                         "warmupCompileCount": best.get(
                             "warmupCompileCount", 0),
                         "steadyCompileCount": best.get("compileCount", 0),
+                        # mesh provenance: 1-device fallback vs real mesh
+                        "deviceCount": best.get("deviceCount"),
+                        "meshShape": best.get("meshShape"),
                     }
                     if "executionPath" in best:
                         out[name]["executionPath"] = best["executionPath"]
@@ -227,6 +230,12 @@ def _worker(role: str) -> int:
         "vs_baseline": ratio,
         "platform": ("cpu-fallback" if role == "cpu"
                      else jax.default_backend()),
+        # mesh provenance (runner._mesh_provenance): "cpu-fallback" alone
+        # is ambiguous between 1 host device and the 8-device simulated
+        # mesh — the device count + mesh shape say which mesh this
+        # number actually measured
+        "device_count": best.get("deviceCount"),
+        "mesh_shape": best.get("meshShape"),
         # compile/steady split: the warmup's compile bill (excluded from
         # the measured number, as the JVM baseline excludes JIT warmup)
         # and the measured run's own compile count, which should be 0 —

@@ -95,6 +95,9 @@ def run_benchmark(name: str, spec: dict) -> dict:
                          round(result["inputThroughput"], 1))
         sp.set_attribute("compileCount", result["compileCount"])
         sp.set_attribute("compileTimeMs", result["compileTimeMs"])
+        if "deviceCount" in result:
+            sp.set_attribute("deviceCount", result["deviceCount"])
+            sp.set_attribute("meshShape", result["meshShape"])
     tracing.maybe_dump_root_metrics()
     return result
 
@@ -144,6 +147,11 @@ def _run_benchmark(name: str, spec: dict) -> dict:
     if model_table is not None:
         input_bytes += _table_bytes(model_table)
     return {
+        # mesh provenance: a throughput number from a 1-device cpu
+        # fallback and one from an 8-way mesh must never be confused in
+        # a BENCH artifact (docs/observability.md "Distributed
+        # telemetry")
+        **_mesh_provenance(),
         "totalTimeMs": total_ms,
         "inputRecordNum": input_num,
         "inputThroughput": input_num * 1000.0 / total_ms,
@@ -165,6 +173,23 @@ def _run_benchmark(name: str, spec: dict) -> dict:
         **({"executionPath": stage.last_execution_path}
            if getattr(stage, "last_execution_path", None) else {}),
     }
+
+
+def _mesh_provenance() -> dict:
+    """``deviceCount`` + ``meshShape`` of the default mesh the benchmark
+    actually ran on (``"data=8"`` style) — benchmark rows must say
+    whether their number is a 1-device cpu fallback or a real mesh.
+    Never fails a finished measurement: if the mesh is somehow
+    unavailable the keys are simply absent."""
+    try:
+        from flink_ml_tpu.parallel.mesh import default_mesh
+
+        mesh = default_mesh()
+        return {"deviceCount": int(mesh.devices.size),
+                "meshShape": ",".join(f"{a}={int(mesh.shape[a])}"
+                                      for a in mesh.axis_names)}
+    except Exception:  # noqa: BLE001 — provenance only
+        return {}
 
 
 def _table_bytes(table) -> int:

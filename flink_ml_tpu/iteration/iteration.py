@@ -176,6 +176,13 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
         with tracing.tracer.span("segment", epoch_from=epoch,
                                  epoch_to=limit) as sp:
             carry, e, s = run_segment(carry, epoch, limit)
+            if tracing.tracer.enabled:
+                # per-shard time-to-ready before the int(e) host sync:
+                # the straggler surface of the segment (ml.shard readyMs
+                # with shard=/device= labels, ml.skew on spread)
+                from flink_ml_tpu.observability import meshstats
+                meshstats.observe_shard_ready(carry, span=sp,
+                                              phase="segment")
             rounds = int(e) - epoch
             epoch, stop = int(e), bool(s)
             sp.set_attribute("rounds", rounds)
@@ -324,6 +331,13 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
                     (epoch + 1) % config.checkpoint_interval == 0:
                 mgr.save(carry, epoch + 1)
             host_ms = (_time.perf_counter() - host_start) * 1000.0
+            if jit_round and tracing.tracer.enabled:
+                # per-shard time-to-ready while the async round drains:
+                # per-replica epoch attribution + straggler detection
+                # (ml.shard readyMs{shard=,device=}, ml.skew events)
+                from flink_ml_tpu.observability import meshstats
+                meshstats.observe_shard_ready(carry, span=sp,
+                                              phase="epoch")
             stop = bool(stop)  # host sync point: device round complete
             # per-round wall time split: hostMs = listener/checkpoint
             # work, deviceMs = dispatch + residual device wait after the

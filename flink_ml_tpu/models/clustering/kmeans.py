@@ -34,8 +34,13 @@ from jax.sharding import PartitionSpec as P
 from flink_ml_tpu.api.stage import Estimator, Model
 from flink_ml_tpu.common.table import Table, as_dense_vector_column
 from flink_ml_tpu.linalg.distance import DistanceMeasure
-from flink_ml_tpu.parallel.collective import ensure_on_mesh, local_valid_mask
+from flink_ml_tpu.parallel.collective import (
+    all_reduce_sum,
+    ensure_on_mesh,
+    local_valid_mask,
+)
 from flink_ml_tpu.parallel.mesh import data_axes, data_pspec, default_mesh
+from flink_ml_tpu.parallel.shardmap import shard_map
 from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam
 from flink_ml_tpu.params.shared import (
     HasDistanceMeasure,
@@ -97,7 +102,7 @@ def _lloyd_round_math(measure, axes, partials_fn=None):
 
     def round_step(xl, vl, centroids):
         packed = (partials_fn or local_partials)(xl, vl, centroids)
-        packed = jax.lax.psum(packed, axes)
+        packed = all_reduce_sum(packed, axes)
         sums, counts = packed[:, :-1], packed[:, -1]
         new_centroids = jnp.where(
             counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1),
@@ -176,7 +181,7 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
         packed = jnp.concatenate([centroids, counts[:, None]], axis=1)
         return (packed, shifts) if health else packed
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, None), P(), P()),
         out_specs=((P(), P()) if health else P()), check_vma=False))
@@ -204,7 +209,7 @@ def _build_lloyd_round_program(mesh, measure_name: str):
         vl = local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
         return round_step(xl, vl, centroids)
 
-    return jax.shard_map(
+    return shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, None), P(), P()),
         out_specs=(P(), P()), check_vma=False)
@@ -319,6 +324,15 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
         # padded rows must not join any cluster: the validity mask is
         # derived on-device from the scalar n (no (n,) mask transfer)
         n_valid = jnp.int32(n)
+
+        from flink_ml_tpu.observability import tracing as _tracing
+        if _tracing.tracer.enabled:
+            # mesh telemetry at the fit boundary: per-shard row counts
+            # (imbalance/skew) and per-shard non-finite input counts, so
+            # a bad replica is identifiable before the fit consumes it
+            from flink_ml_tpu.observability import meshstats
+            meshstats.record_shard_rows(mesh, n, axes)
+            meshstats.record_input_health("KMeans", mesh, xs)
 
         from flink_ml_tpu.iteration.iteration import (iterate_bounded,
                                                       needs_host_loop)

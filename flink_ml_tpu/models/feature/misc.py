@@ -409,6 +409,51 @@ class _SqlVectorEval:
         raise self.Unsupported(f"unknown column {name!r}")
 
 
+def _register_math_fallbacks(conn: sqlite3.Connection) -> None:
+    """Register the SQL math functions this framework's statements use on
+    sqlite builds compiled without SQLITE_ENABLE_MATH_FUNCTIONS (probe:
+    ``SELECT SQRT(1)``). NULL in and domain errors out both yield NULL —
+    the built-ins' behavior (``SQRT(-1)`` is NULL, not an error)."""
+    import math
+
+    try:
+        conn.execute("SELECT SQRT(1)")
+        return
+    except sqlite3.OperationalError:
+        pass
+
+    def unary(f):
+        def call(x):
+            if x is None:
+                return None
+            try:
+                return f(float(x))
+            except (ValueError, OverflowError):
+                return None
+        return call
+
+    def binary(f):
+        def call(x, y):
+            if x is None or y is None:
+                return None
+            try:
+                return f(float(x), float(y))
+            except (ValueError, OverflowError):
+                return None
+        return call
+
+    for name, fn in (("SQRT", unary(math.sqrt)), ("EXP", unary(math.exp)),
+                     ("LN", unary(math.log)), ("LOG10", unary(math.log10)),
+                     ("FLOOR", unary(math.floor)),
+                     ("CEIL", unary(math.ceil)),
+                     ("CEILING", unary(math.ceil)),
+                     ("POW", binary(math.pow)),
+                     ("POWER", binary(math.pow)),
+                     ("MOD", binary(math.fmod))):
+        conn.create_function(name, fn.__code__.co_argcount, fn,
+                             deterministic=True)
+
+
 class SQLTransformer(Transformer):
     """SQL SELECT over the input table, with ``__THIS__`` as the table name
     (ref: feature/sqltransformer/SQLTransformer.java — the reference runs
@@ -457,6 +502,7 @@ class SQLTransformer(Transformer):
             pass
         conn = sqlite3.connect(":memory:")
         try:
+            _register_math_fallbacks(conn)
             col_defs = ", ".join(f'"{n}"' for n in host_cols)
             conn.execute(f"CREATE TABLE __input__ ({col_defs})")
             placeholders = ", ".join("?" * len(host_cols))

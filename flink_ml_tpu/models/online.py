@@ -34,6 +34,7 @@ from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
+from flink_ml_tpu.parallel.shardmap import shard_map
 from flink_ml_tpu.api.stage import Estimator, Model
 from flink_ml_tpu.common.table import Table, as_dense_vector_column
 from flink_ml_tpu.iteration.streaming import (
@@ -115,7 +116,8 @@ def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float,
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    from flink_ml_tpu.parallel.collective import local_valid_mask
+    from flink_ml_tpu.parallel.collective import (all_reduce_sum,
+                                                  local_valid_mask)
     from flink_ml_tpu.parallel.mesh import data_axes, data_pspec
 
     axes = data_axes(mesh)
@@ -125,7 +127,7 @@ def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float,
         vl = local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
         dots = xl @ coeffs
         p = 1.0 / (1.0 + jnp.exp(-dots))
-        grad = jax.lax.psum(((p - yl) * vl) @ xl, axes)
+        grad = all_reduce_sum(((p - yl) * vl) @ xl, axes)
         # dense-path reference semantics: weight sum = batch row count at
         # every coordinate
         g = grad / jnp.maximum(n_valid.astype(grad.dtype), 1.0)
@@ -133,12 +135,12 @@ def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float,
         if health:
             # stable binary logloss from the margins: log(1+e^d) - y·d
             xent = jnp.logaddexp(0.0, dots) - yl * dots
-            loss = jax.lax.psum(jnp.sum(vl * xent), axes) \
+            loss = all_reduce_sum(jnp.sum(vl * xent), axes) \
                 / jnp.maximum(n_valid, 1.0)
             return out + (loss,)
         return out
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, None), P(spec0), P(), P(), P(), P()),
         out_specs=(P(), P(), P()) + ((P(),) if health else ()),
@@ -165,6 +167,7 @@ def _ftrl_sparse_program(mesh, alpha: float, beta: float, l1: float,
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from flink_ml_tpu.parallel.collective import all_reduce_sum
     from flink_ml_tpu.parallel.mesh import data_axes, data_pspec
 
     axes = data_axes(mesh)
@@ -178,9 +181,9 @@ def _ftrl_sparse_program(mesh, alpha: float, beta: float, l1: float,
         dots = jax.ops.segment_sum(vals * coeffs[col] * valid, row,
                                    num_segments=rows_s)
         p = 1.0 / (1.0 + jnp.exp(-dots))
-        grad = jax.lax.psum(jax.ops.segment_sum(
+        grad = all_reduce_sum(jax.ops.segment_sum(
             vals * (p - yb)[row] * valid, col, num_segments=d), axes)
-        wsum = jax.lax.psum(jax.ops.segment_sum(
+        wsum = all_reduce_sum(jax.ops.segment_sum(
             wb[row] * valid, col, num_segments=d), axes)
         g = jnp.where(wsum != 0, grad / jnp.where(wsum != 0, wsum, 1.0),
                       0.0)
@@ -189,12 +192,12 @@ def _ftrl_sparse_program(mesh, alpha: float, beta: float, l1: float,
             # per-batch mean logloss, weighted by the sample weights
             # (padded rows carry weight 0, so they contribute nothing)
             xent = jnp.logaddexp(0.0, dots) - yb * dots
-            loss = jax.lax.psum(jnp.sum(wb * xent), axes) \
-                / jnp.maximum(jax.lax.psum(jnp.sum(wb), axes), 1e-30)
+            loss = all_reduce_sum(jnp.sum(wb * xent), axes) \
+                / jnp.maximum(all_reduce_sum(jnp.sum(wb), axes), 1e-30)
             return out + (loss,)
         return out
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, None),) * 6 + (P(), P(), P()),
         out_specs=(P(), P(), P()) + ((P(),) if health else ()),

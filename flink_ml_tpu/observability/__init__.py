@@ -41,6 +41,16 @@ from flink_ml_tpu.observability.exporters import (
     read_spans,
     write_chrome_trace,
 )
+from flink_ml_tpu.observability.meshstats import (
+    SKEW_EVENT,
+    detect_skew,
+    ensure_mesh_recorded,
+    mesh_snapshot,
+    observe_shard_ready,
+    read_mesh,
+    record_input_health,
+    record_shard_rows,
+)
 from flink_ml_tpu.observability.tracing import (
     TRACE_DIR_ENV,
     Span,
@@ -53,6 +63,7 @@ from flink_ml_tpu.observability.tracing import (
 __all__ = [
     "CONVERGENCE_EVENT",
     "HEALTH_EVENT",
+    "SKEW_EVENT",
     "TRACE_DIR_ENV",
     "ConvergenceListener",
     "Span",
@@ -68,12 +79,19 @@ __all__ = [
     "chrome_trace",
     "compile_stats",
     "compile_totals",
+    "detect_skew",
     "dump_metrics",
+    "ensure_mesh_recorded",
     "event",
     "instrumented_jit",
+    "mesh_snapshot",
+    "observe_shard_ready",
     "prometheus_text",
+    "read_mesh",
     "read_metrics",
     "read_spans",
+    "record_input_health",
+    "record_shard_rows",
     "sample_memory",
     "span",
     "tracer",

@@ -13,6 +13,7 @@ rerunning anything:
     flink-ml-tpu-trace TRACE_DIR --check         # exit 2 on empty/invalid
     flink-ml-tpu-trace diff A B --budget 20      # regression gate (exit 4)
     flink-ml-tpu-trace health TRACE_DIR --check  # model health (exit 3)
+    flink-ml-tpu-trace shards TRACE_DIR --check  # per-device mesh view
 
 Sections: top spans by self-time (time in a span minus its children —
 where work actually happened), per-epoch breakdown (host/device split,
@@ -26,7 +27,15 @@ The ``health`` subcommand (observability/health.py) renders the
 model-health view — per-fit convergence tables, the ml.health
 divergence timeline, serving metrics — and with ``--check`` exits 3
 when any health event is present: the divergence gate for CI and
-unattended sweeps.
+unattended sweeps. The ``shards`` subcommand (observability/shards.py)
+renders the per-device mesh view — topology, per-shard rows/ready/skew
+table, collective structure — and with ``--check`` exits 2 when the
+trace recorded no multi-device telemetry: the CI gate proving the mesh
+lane really ran multi-device.
+
+Every subcommand's stdout rendering runs under the shared
+``exporters.pipe_guard`` — ``... | head`` closing the pipe is normal
+CLI usage, never an error or a stack trace.
 """
 
 from __future__ import annotations
@@ -38,6 +47,7 @@ from typing import Dict, List
 
 from flink_ml_tpu.observability.diff import aggregate_self_time
 from flink_ml_tpu.observability.exporters import (
+    pipe_guard,
     prometheus_text,
     read_metrics,
     read_spans,
@@ -168,6 +178,12 @@ def main(argv=None) -> int:
         from flink_ml_tpu.observability.health import main as health_main
 
         return health_main(argv[1:])
+    if argv and argv[0] == "shards":
+        # per-device mesh view (observability/shards.py); same dispatch
+        # rule — use ./shards to summarize a directory named "shards"
+        from flink_ml_tpu.observability.shards import main as shards_main
+
+        return shards_main(argv[1:])
     if argv and argv[0] == "summary":
         # explicit subcommand spelling for the default view, so
         # unattended consumers can write `summary --json` without
@@ -218,20 +234,16 @@ def main(argv=None) -> int:
                   "was written (one lands when an outermost stage span "
                   "closes) or the traced run recorded no metrics",
                   file=sys.stderr)
-        print(prometheus_text(snap), end="")
+        with pipe_guard():
+            print(prometheus_text(snap), end="")
         return 0
 
     summary = summarize(spans)
-    try:
+    with pipe_guard():
         if args.json or args.format == "json":
             print(json.dumps(summary, indent=2, default=str))
         else:
             print(render_summary(summary, top_n=args.top))
-    except BrokenPipeError:  # `... | head` closed the pipe: not an error
-        try:
-            sys.stdout.close()
-        except OSError:
-            pass
     return 0
 
 

@@ -275,12 +275,15 @@ def main(argv=None) -> int:
     viol = (violations(diff, args.budget, args.min_ms)
             if args.budget is not None else [])
 
-    if args.format == "json":
-        print(json.dumps({"diff": diff, "violations": viol,
-                          "budget_pct": args.budget}, indent=2,
-                         default=str))
-    else:
-        print(render_diff(diff, viol, top_n=args.top))
+    from flink_ml_tpu.observability.exporters import pipe_guard
+
+    with pipe_guard():  # a closed `| head` pipe must not mask the gate
+        if args.format == "json":
+            print(json.dumps({"diff": diff, "violations": viol,
+                              "budget_pct": args.budget}, indent=2,
+                             default=str))
+        else:
+            print(render_diff(diff, viol, top_n=args.top))
     return EXIT_BUDGET if viol else EXIT_OK
 
 

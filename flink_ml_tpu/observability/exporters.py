@@ -17,11 +17,13 @@ not a parser.
 
 from __future__ import annotations
 
+import contextlib
 import glob
 import json
 import math
 import os
 import re
+import sys
 from typing import Dict, List, Optional
 
 from flink_ml_tpu.common.metrics import MetricsRegistry, metrics
@@ -33,6 +35,23 @@ SPANS_GLOB = "spans-*.jsonl"
 PROM_PREFIX = "flink_ml_tpu"
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+@contextlib.contextmanager
+def pipe_guard():
+    """Swallow the BrokenPipeError every ``flink-ml-tpu-trace``
+    subcommand's stdout rendering is exposed to (``... | head`` closing
+    the pipe is how the CLI is used, not an error) — shared by summary,
+    diff, health, shards and the exporter paths so the guard cannot
+    drift per subcommand. Exit-code logic stays with the caller: the
+    guard only absorbs the write failure."""
+    try:
+        yield
+    except BrokenPipeError:
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
 
 
 # -- span collection ---------------------------------------------------------

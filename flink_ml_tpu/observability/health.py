@@ -199,22 +199,29 @@ def _health_group():
 
 
 def record_fit_series(algo: str, series: Dict[str, Sequence[float]],
-                      epoch0: int = 0) -> None:
+                      epoch0: int = 0,
+                      labels: Optional[Dict[str, str]] = None) -> None:
     """Record per-epoch convergence series for one fit: each named
     series becomes a labeled ``ml.health`` histogram (every epoch) and
     the epochs become ``ml.convergence`` span events (stride-sampled
     past :data:`MAX_CONVERGENCE_EVENTS`) on the current span so
     ``mltrace health`` can render the curve from the artifacts alone.
     Non-finite values are skipped by the histograms (bucket math cannot
-    hold them) but ride into the events verbatim."""
+    hold them) but ride into the events verbatim.
+
+    ``labels`` (e.g. ``{"shard": "3", "device": "3"}`` from the mesh
+    telemetry layer, docs/observability.md "Distributed telemetry")
+    ride onto every histogram/gauge key and convergence event, so a
+    per-replica series stays attributable through registry merges."""
     group = _health_group()
     named = {k: list(v) for k, v in series.items() if v is not None}
     if not named:
         return
+    key_labels = {"algo": algo, **(labels or {})}
     length = max(len(v) for v in named.values())
     for name, values in named.items():
         hist = group.histogram(name, buckets=VALUE_BUCKETS,
-                               labels={"algo": algo})
+                               labels=key_labels)
         last = None
         for v in values:
             v = float(v)
@@ -222,15 +229,15 @@ def record_fit_series(algo: str, series: Dict[str, Sequence[float]],
                 hist.observe(v)
                 last = v
         if last is not None:
-            group.gauge(f"last_{name}", last, labels={"algo": algo})
-    group.gauge("epochs", epoch0 + length, labels={"algo": algo})
+            group.gauge(f"last_{name}", last, labels=key_labels)
+    group.gauge("epochs", epoch0 + length, labels=key_labels)
     if not tracing.tracer.enabled:
         return
     stride = max(1, -(-length // MAX_CONVERGENCE_EVENTS))
     for i in range(length):
         if i % stride and i != length - 1:
             continue
-        attrs = {"algo": algo, "epoch": epoch0 + i}
+        attrs = {"algo": algo, "epoch": epoch0 + i, **(labels or {})}
         for name, values in named.items():
             if i < len(values):
                 attrs[name] = float(values[i])
@@ -663,7 +670,9 @@ def main(argv=None) -> int:
               f"{args.trace_dir}", file=sys.stderr)
         return 2
     summary = health_summary(spans, snapshot)
-    try:
+    from flink_ml_tpu.observability.exporters import pipe_guard
+
+    with pipe_guard():  # `... | head` closing the pipe is not an error
         if args.json:
             # strict-JSON output: json.dumps would render float('nan')
             # as the bare non-standard `NaN` token — unparseable by jq
@@ -672,11 +681,6 @@ def main(argv=None) -> int:
             print(json.dumps(_json_safe(summary), indent=2, default=str))
         else:
             print(render_health(summary))
-    except BrokenPipeError:  # `... | head` closed the pipe: not an error
-        try:
-            sys.stdout.close()
-        except OSError:
-            pass
     if args.check and summary["health_events"]:
         print(f"flink-ml-tpu-trace health: "
               f"{len(summary['health_events'])} health event(s) present",

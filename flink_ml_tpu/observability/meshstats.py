@@ -1,0 +1,352 @@
+"""Mesh telemetry: topology snapshots, per-shard metrics, skew detection.
+
+The distributed runtime (shard_map fits over the ``parallel/`` mesh) was
+the one layer the observability stack could not see: a trace told you an
+epoch took 40 ms but not how many devices ran it, whether the batch was
+spread evenly over them, or which replica a NaN came from. This module
+adds the missing mesh dimension (docs/observability.md "Distributed
+telemetry"), DrJAX-style (arXiv:2403.07128): per-replica quantities are
+first-class outputs of the jitted program or host-side shard math —
+never per-element device probes.
+
+Four surfaces, all JL107-clean (recording happens at host boundaries;
+anything device-side is folded to per-shard scalars inside the program):
+
+- **Topology**: :func:`ensure_mesh_recorded` — called from the
+  ``parallel.shardmap`` build seam — writes the mesh snapshot (device
+  count, axis layout, platform, per-device ids) once per mesh as
+  ``ml.mesh`` gauges, root-span attributes and a ``mesh.json`` trace
+  artifact, so every later reader knows whether a trace is a 1-device
+  cpu fallback or a real mesh.
+- **Per-shard labels**: ``ml.shard`` gauges/histograms carry
+  ``shard=``/``device=`` labels — ``shard`` is the dim-0 block index in
+  the mesh's row-major device order, ``device`` the JAX device id — so
+  registry merges (host-pool fork, multi-process traces) keep replicas
+  apart.
+- **Skew/straggler detection**: :func:`detect_skew` gauges the
+  max/median spread of any per-shard series (ready-time, row counts)
+  and emits an ``ml.skew`` event when it exceeds
+  ``FLINK_ML_TPU_SKEW_FACTOR`` (default 4.0×) past an absolute floor.
+- **Per-shard health**: :func:`record_input_health` runs one tiny
+  shard_mapped reduction returning per-shard non-finite counts, so bad
+  input data is attributable to a replica before the fit consumes it.
+
+Inspect with ``flink-ml-tpu-trace shards <dir>``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+from flink_ml_tpu.observability import tracing
+
+__all__ = [
+    "MESH_FILE",
+    "SKEW_EVENT",
+    "SKEW_FACTOR_ENV",
+    "SKEW_FLOOR_MS_ENV",
+    "detect_skew",
+    "ensure_mesh_recorded",
+    "mesh_snapshot",
+    "observe_shard_ready",
+    "read_mesh",
+    "record_input_health",
+    "record_shard_rows",
+    "skew_factor",
+]
+
+#: the mesh-topology artifact in a trace dir (one file, every mesh the
+#: traced processes built, newest-last)
+MESH_FILE = "mesh.json"
+
+#: instant-event name for a detected straggler/imbalance
+SKEW_EVENT = "ml.skew"
+
+#: max/median ratio above which a per-shard spread is skew (default 4.0)
+SKEW_FACTOR_ENV = "FLINK_ML_TPU_SKEW_FACTOR"
+
+#: absolute ready-time spread floor (ms) below which the ratio never
+#: fires — a simulated CPU mesh has ~0 medians, and 0.2 ms vs 0.05 ms is
+#: not a straggler (default 50 ms)
+SKEW_FLOOR_MS_ENV = "FLINK_ML_TPU_SKEW_FLOOR_MS"
+
+#: meshes already recorded by THIS process (pid in the key: a forked
+#: host-pool child must re-record into its own artifacts)
+_recorded: set = set()
+
+
+def _shard_group():
+    return metrics.group(ML_GROUP, "shard")
+
+
+def _mesh_group():
+    return metrics.group(ML_GROUP, "mesh")
+
+
+def skew_factor() -> float:
+    try:
+        return float(os.environ.get(SKEW_FACTOR_ENV, "4.0"))
+    except ValueError:
+        return 4.0
+
+
+def _skew_floor_ms() -> float:
+    try:
+        return float(os.environ.get(SKEW_FLOOR_MS_ENV, "50.0"))
+    except ValueError:
+        return 50.0
+
+
+# -- topology -----------------------------------------------------------------
+
+def mesh_snapshot(mesh) -> dict:
+    """The JSON-ready topology of one mesh: what a reader needs to tell
+    a 1-device cpu fallback from an 8-way data mesh from a (2, 4)
+    dcn×data hybrid, and to resolve ``shard`` indices to devices."""
+    devices = list(mesh.devices.flat)
+    return {
+        "device_count": len(devices),
+        "axis_names": list(mesh.axis_names),
+        "shape": {name: int(mesh.shape[name]) for name in mesh.axis_names},
+        "platform": devices[0].platform if devices else None,
+        "devices": [{"id": int(d.id),
+                     "process": int(getattr(d, "process_index", 0)),
+                     "platform": d.platform} for d in devices],
+    }
+
+
+def _mesh_key(mesh):
+    return (os.getpid(), tuple(mesh.axis_names),
+            tuple(int(d.id) for d in mesh.devices.flat),
+            mesh.devices.shape)
+
+
+def ensure_mesh_recorded(mesh) -> None:
+    """Record one mesh's topology — gauges, root-span attrs, mesh.json —
+    exactly once per (process, mesh). No-op when the tracer is disarmed:
+    topology without a trace dir has nowhere to land."""
+    tracer = tracing.tracer
+    if mesh is None or not tracer.enabled:
+        return
+    key = _mesh_key(mesh)
+    if key in _recorded:
+        return
+    _recorded.add(key)
+    snap = mesh_snapshot(mesh)
+    group = _mesh_group()
+    group.gauge("deviceCount", snap["device_count"])
+    for name, size in snap["shape"].items():
+        group.gauge("axisSize", size, labels={"axis": name})
+    root = tracer.root()
+    if root is not None:
+        root.set_attribute("mesh_devices", snap["device_count"])
+        root.set_attribute("mesh_axes", ",".join(
+            f"{k}={v}" for k, v in snap["shape"].items()))
+        if snap["platform"]:
+            root.set_attribute("mesh_platform", snap["platform"])
+    _append_mesh_file(tracer.trace_dir, snap)
+
+
+def _append_mesh_file(trace_dir: str, snap: dict) -> None:
+    """Append ``snap`` to the dir's ``mesh.json`` (read-modify-replace:
+    concurrent traced processes at worst drop a duplicate topology, never
+    tear the file)."""
+    path = os.path.join(trace_dir, MESH_FILE)
+    doc = {"meshes": []}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            existing = json.load(f)
+        if isinstance(existing, dict) and \
+                isinstance(existing.get("meshes"), list):
+            doc = existing
+    except (OSError, json.JSONDecodeError):
+        pass
+    if snap in doc["meshes"]:
+        return
+    doc["meshes"].append(snap)
+    os.makedirs(trace_dir, exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, path)
+
+
+def read_mesh(trace_dir: str) -> Optional[dict]:
+    """The newest mesh snapshot from a trace dir's ``mesh.json`` (the
+    one the run actually fitted on), or None when the artifact is
+    absent/unreadable."""
+    path = os.path.join(trace_dir, MESH_FILE)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        meshes = doc.get("meshes") or []
+        return meshes[-1] if meshes else None
+    except (OSError, json.JSONDecodeError, AttributeError):
+        return None
+
+
+# -- skew/straggler detection -------------------------------------------------
+
+def detect_skew(kind: str, values: Sequence[float],
+                floor: float = 0.0, **attrs) -> Optional[float]:
+    """Gauge the max/median spread of a per-shard series and emit an
+    ``ml.skew`` event when it exceeds the configured factor.
+
+    Returns the spread (max/median), or None for an empty/degenerate
+    series. The event only fires when the absolute max-median gap also
+    clears ``floor`` — ratios over near-zero medians (a simulated CPU
+    mesh's ready times) are noise, not stragglers."""
+    vals = [float(v) for v in values if math.isfinite(float(v))]
+    if len(vals) < 2:
+        return None
+    med = float(np.median(vals))
+    mx = max(vals)
+    if med <= 0.0:
+        spread = math.inf if mx > 0 else 1.0
+    else:
+        spread = mx / med
+    group = _shard_group()
+    group.gauge("skew", spread if math.isfinite(spread) else -1.0,
+                labels={"kind": kind})
+    factor = skew_factor()
+    if spread > factor and (mx - med) > floor:
+        group.counter("skewEvents", labels={"kind": kind})
+        tracing.tracer.event(
+            SKEW_EVENT, kind=kind, spread=round(spread, 2)
+            if math.isfinite(spread) else "inf",
+            max=round(mx, 3), median=round(med, 3),
+            shard=int(np.argmax(vals)), factor=factor, **attrs)
+    return spread
+
+
+# -- per-shard series ---------------------------------------------------------
+
+def shard_row_counts(mesh, n: int, axis_name=None) -> List[int]:
+    """Valid (un-padded) rows each dim-0 shard holds after
+    ``shard_batch``'s zero-padding — pure host math from the scalar
+    ``n``, in the mesh's row-major shard order."""
+    from flink_ml_tpu.parallel.mesh import data_shard_count
+
+    shards = data_shard_count(mesh) if axis_name is None else None
+    if shards is None:
+        axes = ((axis_name,) if isinstance(axis_name, str)
+                else tuple(axis_name))
+        shards = int(np.prod([mesh.shape[a] for a in axes]))
+    local_n = -(-n // shards)  # ceil: padded rows land on the tail shards
+    return [int(min(max(n - i * local_n, 0), local_n))
+            for i in range(shards)]
+
+
+def record_shard_rows(mesh, n: int, axis_name=None) -> List[int]:
+    """Per-shard row-count gauges (``ml.shard rows{shard=,device=}``) +
+    the row-imbalance skew check. Returns the per-shard counts."""
+    counts = shard_row_counts(mesh, n, axis_name)
+    devices = list(mesh.devices.flat)
+    group = _shard_group()
+    for i, rows in enumerate(counts):
+        dev = devices[i] if i < len(devices) else None
+        group.gauge("rows", rows, labels={
+            "shard": str(i),
+            "device": str(int(dev.id)) if dev is not None else "?"})
+    detect_skew("rows", counts)
+    return counts
+
+
+def observe_shard_ready(tree, span=None, phase: str = "epoch"
+                        ) -> Optional[List[float]]:
+    """Per-shard time-to-ready of the first sharded device array in
+    ``tree``: each addressable shard's ``block_until_ready`` is timed in
+    device order, so after an async dispatch the waits approximate each
+    replica's remaining work — the straggler surface of the epoch.
+    Records ``ml.shard readyMs{shard=,device=,phase=}`` histograms, the
+    ready-time skew check, and (optionally) the spread onto ``span``.
+    Returns the per-shard times (ms), or None when ``tree`` holds no
+    multi-shard device array."""
+    import jax
+
+    arr = None
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and \
+                len(getattr(leaf, "addressable_shards", ())) > 1:
+            arr = leaf
+            break
+    if arr is None:
+        return None
+    group = _shard_group()
+    times = []
+    for i, shard in enumerate(arr.addressable_shards):
+        t0 = time.perf_counter()
+        shard.data.block_until_ready()
+        ms = (time.perf_counter() - t0) * 1000.0
+        times.append(ms)
+        group.histogram("readyMs", labels={
+            "shard": str(i), "device": str(int(shard.device.id)),
+            "phase": phase}).observe(ms)
+    spread = detect_skew("readyMs", times, floor=_skew_floor_ms(),
+                         phase=phase)
+    if span is not None:
+        span.set_attribute("shard_ready_ms",
+                           [round(t, 3) for t in times])
+        if spread is not None and math.isfinite(spread):
+            span.set_attribute("shard_skew", round(spread, 2))
+    return times
+
+
+# -- per-shard health ---------------------------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _nonfinite_program(mesh, ndim: int):
+    """Per-shard non-finite element counts of a dim-0-sharded array as
+    ONE ``(n_shards,)`` output — the count folds inside the shard_map
+    body (JL107-clean), the host fetches one tiny vector."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from flink_ml_tpu.parallel.mesh import data_pspec
+    from flink_ml_tpu.parallel.shardmap import shard_map
+
+    spec0 = data_pspec(mesh)
+
+    def per_shard(xl):
+        bad = jnp.sum(jnp.logical_not(jnp.isfinite(xl)))
+        return bad.astype(jnp.int32)[None]
+
+    return jax.jit(shard_map(
+        per_shard, mesh=mesh,
+        in_specs=P(spec0, *([None] * (ndim - 1))),
+        out_specs=P(spec0), check_vma=False))
+
+
+def record_input_health(algo: str, mesh, array) -> Optional[List[int]]:
+    """Per-shard non-finite counts of a mesh-resident input
+    (``ml.shard nonFinite{algo=,shard=,device=}`` gauges) so corrupt
+    data is attributable to a replica before the fit consumes it.
+    Returns the counts, or None when the array is not multi-sharded."""
+    import jax
+
+    if not isinstance(array, jax.Array) or \
+            len(getattr(array, "addressable_shards", ())) < 2:
+        return None
+    counts = np.asarray(_nonfinite_program(mesh, array.ndim)(array))
+    devices = list(mesh.devices.flat)
+    group = _shard_group()
+    for i, bad in enumerate(counts):
+        dev = devices[i] if i < len(devices) else None
+        group.gauge("nonFinite", int(bad), labels={
+            "algo": algo, "shard": str(i),
+            "device": str(int(dev.id)) if dev is not None else "?"})
+    if counts.any():
+        tracing.tracer.event(
+            "ml.health", algo=algo, kind="non-finite-input",
+            shards=",".join(str(i) for i in np.nonzero(counts)[0]),
+            total=int(counts.sum()))
+    return [int(c) for c in counts]

@@ -183,6 +183,12 @@ class Tracer:
         stack = self._stack()
         return stack[-1] if stack else None
 
+    def root(self) -> Optional[Span]:
+        """The outermost open span on this thread (the fit/transform
+        root) — where run-wide attributes like the mesh topology belong."""
+        stack = self._stack()
+        return stack[0] if stack else None
+
     def span(self, name: str, **attrs):
         """Open a span under the current one (or as a new trace root).
         Use as a context manager; yields the :class:`Span`."""

@@ -51,7 +51,12 @@ from flink_ml_tpu.parallel.mesh import (
     default_mesh,
     model_axis_of,
 )
-from flink_ml_tpu.parallel.collective import ensure_on_mesh, ones_on_mesh
+from flink_ml_tpu.parallel.collective import (
+    all_reduce_sum,
+    ensure_on_mesh,
+    ones_on_mesh,
+)
+from flink_ml_tpu.parallel.shardmap import shard_map
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,7 +86,7 @@ def _sgd_update_math(loss_func, prm: SGDParams, axes, model_axis=None):
     over the mesh's data ``axes``."""
 
     def apply_packed(coeffs, packed_local):
-        packed = jax.lax.psum(packed_local, axes)
+        packed = all_reduce_sum(packed_local, axes)
         grad, total_w, total_loss = packed[:-2], packed[-2], packed[-1]
 
         # ref updateModel (SGD.java:231-243); skip when no weight
@@ -98,7 +103,7 @@ def _sgd_update_math(loss_func, prm: SGDParams, axes, model_axis=None):
             loss_sum, grad_sum = loss_func.loss_and_gradient(coeffs, xb, yb,
                                                              wb)
         else:
-            dots = jax.lax.psum(xb @ coeffs, model_axis)
+            dots = all_reduce_sum(xb @ coeffs, model_axis)
             loss_sum, multipliers = loss_func.terms(dots, yb, wb)
             grad_sum = xb.T @ multipliers  # local feature shard
         packed = jnp.concatenate([
@@ -230,7 +235,7 @@ def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams,
 
         extra_in, extra_out = (), ()
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
                   P(spec0), P(), P()) + extra_in,
@@ -352,7 +357,7 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
                     jnp.stack(rows), fin)
         return coeffs, offset[None], mean_loss, epoch, stop
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
                   P(spec0)),
@@ -379,7 +384,7 @@ def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams):
                                                    offsets[0])
         return coeffs, new_offset[None], mean_loss
 
-    return jax.shard_map(
+    return shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
                   P(spec0)),

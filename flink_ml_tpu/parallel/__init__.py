@@ -30,3 +30,7 @@ from flink_ml_tpu.parallel.collective import (  # noqa: F401
     replicate,
     termination_vote,
 )
+from flink_ml_tpu.parallel.shardmap import (  # noqa: F401
+    axis_size,
+    shard_map,
+)

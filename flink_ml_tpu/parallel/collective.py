@@ -13,11 +13,24 @@ Ref parity (flink-ml-core):
 
 The in-axis functions are for use inside ``shard_map``/``pjit`` bodies; the
 host-level helpers (``shard_batch``) place host arrays onto the mesh.
+
+Telemetry (docs/observability.md "Distributed telemetry"): the in-axis
+collectives are the named seams of every SPMD program, so each records
+its payload into ``ml.collective`` at TRACE time — op count and payload
+bytes labeled ``{op=,axis=,devices=}``. That is per *compiled program
+structure*, not per executed step (the compiled body contains no Python;
+JL107's whole point), which is exactly the right meaning here: it
+answers "what collectives does this program issue, over which axes, at
+what sizes". Runtime timing comes from the host-level helpers below,
+which ARE host boundaries: each records an ``ml.collective
+opMs{op=,devices=}`` histogram and, when tracing is armed, a
+``collective.host`` span.
 """
 
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 
@@ -26,6 +39,52 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_ml_tpu.parallel.mesh import DATA_AXIS
+from flink_ml_tpu.parallel.shardmap import axis_size  # noqa: F401 — re-export
+
+#: byte-shaped histogram bounds for collective payloads (the default
+#: buckets are latency-shaped)
+PAYLOAD_BUCKETS = (256.0, 4096.0, 65536.0, 1048576.0, 16777216.0,
+                   268435456.0, 4294967296.0)
+
+
+def _collective_group():
+    from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+
+    return metrics.group(ML_GROUP, "collective")
+
+
+def _payload_bytes(x) -> int:
+    """Static per-shard payload of a traced operand (shape/dtype are
+    trace-time constants even when the values are tracers)."""
+    shape = jnp.shape(x)
+    return int(np.prod(shape, dtype=np.int64)) * jnp.result_type(x).itemsize
+
+
+def _note_traced(op: str, x, axis_name) -> None:
+    """Trace-time accounting of one in-axis collective site: op count +
+    payload bytes into ``ml.collective``, and an instant event on the
+    open span (the fit/transform span is open while its program traces).
+    Never raises — telemetry must not sink a trace."""
+    try:
+        axes = ((axis_name,) if isinstance(axis_name, str)
+                else tuple(axis_name))
+        devices = axis_size(axes[0]) if len(axes) == 1 else int(
+            np.prod([axis_size(a) for a in axes]))
+        nbytes = _payload_bytes(x)
+        labels = {"op": op, "axis": ",".join(str(a) for a in axes),
+                  "devices": str(devices)}
+        group = _collective_group()
+        group.counter("tracedOps", labels=labels)
+        group.histogram("payloadBytes", buckets=PAYLOAD_BUCKETS,
+                        labels=labels).observe(nbytes)
+        from flink_ml_tpu.observability import tracing
+
+        if tracing.tracer.current() is not None:
+            tracing.tracer.event("ml.collective.traced", op=op,
+                                 axis=labels["axis"], devices=devices,
+                                 payload_bytes=nbytes)
+    except Exception:
+        pass
 
 
 # -- in-axis collectives (inside shard_map / with named axes) ---------------
@@ -37,18 +96,22 @@ def all_reduce_sum(x, axis_name=DATA_AXIS):
     hybrid multi-slice mesh — in which case XLA emits the hierarchical
     all-reduce (in-slice over ICI, one cross-slice DCN exchange).
     """
+    _note_traced("psum", x, axis_name)
     return jax.lax.psum(x, axis_name)
 
 
 def all_reduce_mean(x, axis_name: str = DATA_AXIS):
+    _note_traced("pmean", x, axis_name)
     return jax.lax.pmean(x, axis_name)
 
 
 def all_reduce_max(x, axis_name: str = DATA_AXIS):
+    _note_traced("pmax", x, axis_name)
     return jax.lax.pmax(x, axis_name)
 
 
 def all_gather(x, axis_name: str = DATA_AXIS, axis: int = 0, tiled: bool = True):
+    _note_traced("all_gather", x, axis_name)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
@@ -57,6 +120,7 @@ def broadcast_from(x, src: int = 0, axis_name: str = DATA_AXIS):
 
     Implemented as a masked psum so it stays a single ICI collective.
     """
+    _note_traced("broadcast", x, axis_name)
     idx = jax.lax.axis_index(axis_name)
     masked = jnp.where(idx == src, x, jnp.zeros_like(x))
     return jax.lax.psum(masked, axis_name)
@@ -65,6 +129,7 @@ def broadcast_from(x, src: int = 0, axis_name: str = DATA_AXIS):
 def termination_vote(local_count, axis_name: str = DATA_AXIS):
     """True iff the global count is zero — the reference coordinator's
     termination rule (SharedProgressAligner.java:277-292) as one psum."""
+    _note_traced("termination_vote", local_count, axis_name)
     total = jax.lax.psum(local_count, axis_name)
     return total == 0
 
@@ -80,6 +145,51 @@ def local_valid_mask(axes, local_n: int, n_valid, dtype=jnp.float32):
 
 # -- host-level placement ----------------------------------------------------
 
+class _HostOp:
+    """Time one host-boundary collective/placement op into
+    ``ml.collective opMs{op=,devices=}`` (+ payload bytes), with a
+    ``collective.host`` span when tracing is armed. Also the seam that
+    records the mesh topology: a host placement op is proof the mesh is
+    in use."""
+
+    __slots__ = ("op", "mesh", "nbytes", "_t0", "_span_cm", "_span")
+
+    def __init__(self, op: str, mesh: Mesh, nbytes: int = 0):
+        self.op = op
+        self.mesh = mesh
+        self.nbytes = int(nbytes)
+        self._span_cm = None
+        self._span = None
+
+    def __enter__(self):
+        from flink_ml_tpu.observability import meshstats, tracing
+
+        try:  # an unwritable trace dir must not sink the data path
+            meshstats.ensure_mesh_recorded(self.mesh)
+        except Exception:
+            pass
+        if tracing.tracer.enabled:
+            self._span_cm = tracing.tracer.span(
+                "collective.host", op=self.op,
+                devices=self.mesh.devices.size,
+                payload_bytes=self.nbytes)
+            self._span = self._span_cm.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        ms = (time.perf_counter() - self._t0) * 1000.0
+        labels = {"op": self.op, "devices": str(self.mesh.devices.size)}
+        group = _collective_group()
+        group.histogram("opMs", labels=labels).observe(ms)
+        if self.nbytes:
+            group.histogram("payloadBytes", buckets=PAYLOAD_BUCKETS,
+                            labels=labels).observe(self.nbytes)
+        if self._span_cm is not None:
+            self._span_cm.__exit__(*exc)
+        return False
+
+
 def row_major_format(sharding, ndim: int):
     """The sharding pinned to a ROW-MAJOR device layout. Every producer of
     batch-dim-sharded device arrays (datagen, the prepare programs,
@@ -88,10 +198,20 @@ def row_major_format(sharding, ndim: int):
     (f32[10M,100]{1,0} copy of a {0,1} parameter) purely because the
     datagen program's compiler-chosen output layout was column-major
     while the fit wanted row-major. Random generation has no layout
-    preference, so pinning the producer is free."""
-    from jax.experimental.layout import Format, Layout
+    preference, so pinning the producer is free.
 
-    return Format(Layout(major_to_minor=tuple(range(ndim))), sharding)
+    API skew: the pair is spelled ``Format(Layout(major_to_minor),
+    sharding)`` on new JAX and ``Layout(DeviceLocalLayout(major_to_minor),
+    sharding)`` on the 0.4.x line — same object either way."""
+    try:
+        from jax.experimental.layout import Format, Layout
+
+        return Format(Layout(major_to_minor=tuple(range(ndim))), sharding)
+    except ImportError:
+        from jax.experimental.layout import DeviceLocalLayout, Layout
+
+        return Layout(DeviceLocalLayout(major_to_minor=tuple(range(ndim))),
+                      sharding)
 
 
 def _dim0_layout(mesh: Mesh, axis_name, ndim: int):
@@ -120,13 +240,17 @@ def shard_batch(mesh: Mesh, array, axis_name: str = DATA_AXIS):
     if rem:
         pad = np.zeros((rem,) + array.shape[1:], dtype=array.dtype)
         array = np.concatenate([array, pad], axis=0)
-    return jax.device_put(array, sharding), n
+    with _HostOp("shard_batch", mesh, array.nbytes):
+        return jax.device_put(array, sharding), n
 
 
 def replicate(mesh: Mesh, tree):
     """Replicate a pytree across the whole mesh (broadcast-variable parity)."""
     sharding = NamedSharding(mesh, P())
-    return jax.device_put(tree, sharding)
+    nbytes = sum(getattr(leaf, "nbytes", 0)
+                 for leaf in jax.tree_util.tree_leaves(tree))
+    with _HostOp("replicate", mesh, nbytes):
+        return jax.device_put(tree, sharding)
 
 
 @functools.lru_cache(maxsize=128)
@@ -161,15 +285,16 @@ def ensure_on_mesh(mesh: Mesh, array, axis_name=DATA_AXIS, dtype=None):
     n_shards, sharding = _dim0_layout(mesh, axis_name, array.ndim)
     rem = (-n) % n_shards
     want = jnp.dtype(dtype) if dtype is not None else array.dtype
-    if rem == 0 and array.dtype == want:
-        # device_put with a matching placement is a no-op; a mismatched
-        # one is a device-to-device reshard/relayout — still no PCIe leg,
-        # and normalizing the layout HERE (once) spares every consumer
-        # program its own full-input relayout copy (r3 trace: 14.4 ms)
-        return jax.device_put(
-            array, row_major_format(sharding, array.ndim)), n
-    return _prepare_program(rem, want.name, sharding,
-                            array.ndim)(array), n
+    with _HostOp("ensure_on_mesh", mesh, array.nbytes):
+        if rem == 0 and array.dtype == want:
+            # device_put with a matching placement is a no-op; a mismatched
+            # one is a device-to-device reshard/relayout — still no PCIe leg,
+            # and normalizing the layout HERE (once) spares every consumer
+            # program its own full-input relayout copy (r3 trace: 14.4 ms)
+            return jax.device_put(
+                array, row_major_format(sharding, array.ndim)), n
+        return _prepare_program(rem, want.name, sharding,
+                                array.ndim)(array), n
 
 
 @functools.lru_cache(maxsize=128)

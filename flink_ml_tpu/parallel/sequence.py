@@ -31,6 +31,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from flink_ml_tpu.parallel.shardmap import shard_map
+from flink_ml_tpu.parallel.shardmap import axis_size as _axis_size
+
 SEQ_AXIS = "seq"
 
 
@@ -53,7 +56,7 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False):
     around the ring (``ppermute``) — compute and communication overlap
     naturally under XLA async collectives.
     """
-    axis_size = jax.lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     l_local, num_heads, d_head = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d_head, q.dtype))
@@ -111,7 +114,7 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     size. all_to_all gathers the full sequence while scattering heads, runs
     dense attention on H/P heads, then re-shards back to sequence parallel.
     """
-    axis_size = jax.lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
 
     def to_head_parallel(x):
         # (L_local, H, Dh) → (L_global, H/P, Dh)
@@ -156,7 +159,7 @@ def _build_sharded_attention(mesh: Mesh, kind: str, causal: bool,
         return fn(q, k, v, axis_name=axis_name, causal=causal)
 
     spec = P(axis_name, None, None)
-    return jax.jit(jax.shard_map(per_shard, mesh=mesh,
+    return jax.jit(shard_map(per_shard, mesh=mesh,
                                  in_specs=(spec, spec, spec),
                                  out_specs=spec, check_vma=False))
 

@@ -21,8 +21,10 @@ from flink_ml_tpu.parallel import (
 
 
 def shard_map_over(mesh, fn, in_specs, out_specs):
-    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_vma=False))
+    from flink_ml_tpu.parallel.shardmap import shard_map
+
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False))
 
 
 def test_all_reduce_sum(mesh8, rng):

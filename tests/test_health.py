@@ -31,10 +31,6 @@ from flink_ml_tpu.observability.health import main as health_cli
 from flink_ml_tpu.observability.tracing import TRACE_DIR_ENV, tracer
 from flink_ml_tpu.resilience import NonFiniteState, RetryPolicy
 
-_HAS_SHARD_MAP = hasattr(jax, "shard_map")
-needs_shard_map = pytest.mark.skipif(
-    not _HAS_SHARD_MAP, reason="jax.shard_map unavailable (seed-known)")
-
 
 @pytest.fixture(autouse=True)
 def _clean(monkeypatch):
@@ -418,7 +414,6 @@ def test_health_cli_check_empty_dir(tmp_path):
 
 # -- compiled program variants (shard_map-gated, run in CI) -------------------
 
-@needs_shard_map
 def test_dense_unrolled_fit_records_series(tmp_path, monkeypatch, rng):
     trace_dir = tmp_path / "trace"
     monkeypatch.setenv(TRACE_DIR_ENV, str(trace_dir))
@@ -432,7 +427,6 @@ def test_dense_unrolled_fit_records_series(tmp_path, monkeypatch, rng):
     assert all(math.isfinite(ev["attrs"]["loss"]) for ev in conv)
 
 
-@needs_shard_map
 def test_dense_nan_fit_raises_with_sentinel(tmp_path, monkeypatch, rng):
     trace_dir = tmp_path / "trace"
     monkeypatch.setenv(TRACE_DIR_ENV, str(trace_dir))
@@ -445,7 +439,6 @@ def test_dense_nan_fit_raises_with_sentinel(tmp_path, monkeypatch, rng):
     assert any(ev["attrs"]["kind"] == "non-finite" for ev in events)
 
 
-@needs_shard_map
 def test_segmented_fit_fails_at_segment_boundary(tmp_path, monkeypatch,
                                                  rng):
     """Device-mode checkpointed fit: the sentinel is checked at the
@@ -468,7 +461,6 @@ def test_segmented_fit_fails_at_segment_boundary(tmp_path, monkeypatch,
     assert _events(trace_dir, health.HEALTH_EVENT)
 
 
-@needs_shard_map
 def test_tensor_parallel_fit_records_series(tmp_path, monkeypatch, rng):
     """convergence_row's model-axis psum branch: a TP-mesh fit under
     trace yields the same global norms a DP fit would (the squared sums
@@ -499,7 +491,6 @@ def test_tensor_parallel_fit_records_series(tmp_path, monkeypatch, rng):
         float(np.linalg.norm(coeffs_tp)), rel=1e-4)
 
 
-@needs_shard_map
 def test_kmeans_center_shift_series(tmp_path, monkeypatch, rng):
     from flink_ml_tpu.models.clustering.kmeans import KMeans
     trace_dir = tmp_path / "trace"

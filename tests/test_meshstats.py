@@ -1,0 +1,294 @@
+"""Mesh telemetry (observability/meshstats.py + the ``shards`` CLI):
+topology snapshots, per-shard labels surviving registry merges, skew
+detection, and the shard_map compat seam itself."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from flink_ml_tpu.common.metrics import MetricsRegistry, metrics
+from flink_ml_tpu.observability import meshstats, tracing
+from flink_ml_tpu.observability.cli import main as trace_cli
+from flink_ml_tpu.observability.diff import main as diff_main
+from flink_ml_tpu.observability.exporters import dump_metrics, read_spans
+from flink_ml_tpu.parallel import DATA_AXIS, create_mesh
+from flink_ml_tpu.parallel.shardmap import axis_size, shard_map
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(tracing.TRACE_DIR_ENV, raising=False)
+    monkeypatch.delenv(meshstats.SKEW_FACTOR_ENV, raising=False)
+    monkeypatch.delenv(meshstats.SKEW_FLOOR_MS_ENV, raising=False)
+    yield
+    tracing.tracer.shutdown()
+    metrics.clear()
+    meshstats._recorded.clear()
+
+
+# -- shard_map compat seam ----------------------------------------------------
+
+def test_shard_map_compat_runs_and_axis_size(mesh8):
+    def per_shard(x):
+        assert axis_size(DATA_AXIS) == 8
+        return jax.lax.psum(x, DATA_AXIS)
+
+    fn = jax.jit(shard_map(per_shard, mesh=mesh8,
+                           in_specs=P(DATA_AXIS, None),
+                           out_specs=P(None, None), check_vma=False))
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)
+    np.testing.assert_allclose(np.asarray(fn(x)),
+                               x.sum(axis=0, keepdims=True))
+
+
+# -- topology -----------------------------------------------------------------
+
+def test_mesh_snapshot_shape(mesh8):
+    snap = meshstats.mesh_snapshot(mesh8)
+    assert snap["device_count"] == 8
+    assert snap["axis_names"] == [DATA_AXIS]
+    assert snap["shape"] == {DATA_AXIS: 8}
+    assert len(snap["devices"]) == 8
+    json.dumps(snap)  # must be a JSON-ready artifact
+
+
+def test_mesh_recorded_once_into_trace_dir(tmp_path, mesh8):
+    tracing.tracer.configure(str(tmp_path))
+    meshstats.ensure_mesh_recorded(mesh8)
+    meshstats.ensure_mesh_recorded(mesh8)  # idempotent
+    doc = json.load(open(tmp_path / meshstats.MESH_FILE))
+    assert len(doc["meshes"]) == 1
+    assert meshstats.read_mesh(str(tmp_path))["device_count"] == 8
+    assert metrics.group("ml", "mesh").get_gauge("deviceCount") == 8
+
+
+def test_mesh_recorded_on_root_span_attrs(tmp_path, mesh8):
+    tracing.tracer.configure(str(tmp_path))
+    with tracing.tracer.span("Fit.fit"):
+        with tracing.tracer.span("epoch"):
+            meshstats.ensure_mesh_recorded(mesh8)
+    spans = read_spans(str(tmp_path))
+    root = [sp for sp in spans if sp["name"] == "Fit.fit"][0]
+    assert root["attrs"]["mesh_devices"] == 8
+    assert root["attrs"]["mesh_axes"] == "data=8"
+
+
+def test_shard_map_build_records_mesh(tmp_path, mesh8):
+    """Wrapping a program over a mesh is the telemetry seam itself."""
+    tracing.tracer.configure(str(tmp_path))
+    shard_map(lambda x: x, mesh=mesh8, in_specs=P(DATA_AXIS),
+              out_specs=P(DATA_AXIS), check_vma=False)
+    assert meshstats.read_mesh(str(tmp_path))["device_count"] == 8
+
+
+# -- per-shard series + skew --------------------------------------------------
+
+def test_record_shard_rows_and_labels(mesh8):
+    counts = meshstats.record_shard_rows(mesh8, 13)
+    assert counts == [2, 2, 2, 2, 2, 2, 1, 0]
+    group = metrics.group("ml", "shard")
+    assert group.get_gauge("rows", labels={"shard": "0",
+                                           "device": "0"}) == 2
+    assert group.get_gauge("rows", labels={"shard": "7",
+                                           "device": "7"}) == 0
+
+
+def test_detect_skew_event_fires_past_factor(tmp_path, monkeypatch):
+    monkeypatch.setenv(meshstats.SKEW_FACTOR_ENV, "2.0")
+    tracing.tracer.configure(str(tmp_path))
+    with tracing.tracer.span("fit"):
+        spread = meshstats.detect_skew("readyMs", [10.0, 10.0, 100.0])
+    assert spread == pytest.approx(10.0)
+    spans = read_spans(str(tmp_path))
+    events = [ev for sp in spans for ev in sp.get("events", ())
+              if ev["name"] == meshstats.SKEW_EVENT]
+    assert len(events) == 1
+    assert events[0]["attrs"]["shard"] == 2
+    assert metrics.group("ml", "shard").get_counter(
+        "skewEvents", labels={"kind": "readyMs"}) == 1
+
+
+def test_detect_skew_respects_absolute_floor(tmp_path, monkeypatch):
+    """A huge ratio over a near-zero median (simulated CPU mesh ready
+    times) is noise, not a straggler."""
+    monkeypatch.setenv(meshstats.SKEW_FACTOR_ENV, "2.0")
+    tracing.tracer.configure(str(tmp_path))
+    with tracing.tracer.span("fit"):
+        meshstats.detect_skew("readyMs", [0.01, 0.01, 1.0], floor=50.0)
+    events = [ev for sp in read_spans(str(tmp_path))
+              for ev in sp.get("events", ())
+              if ev["name"] == meshstats.SKEW_EVENT]
+    assert events == []
+
+
+def test_observe_shard_ready_labels_per_device(tmp_path, mesh8):
+    tracing.tracer.configure(str(tmp_path))
+    from flink_ml_tpu.parallel import shard_batch
+
+    arr, _ = shard_batch(mesh8, np.ones((16, 2), np.float32))
+    with tracing.tracer.span("epoch") as sp:
+        times = meshstats.observe_shard_ready(arr, span=sp)
+    assert times is not None and len(times) == 8
+    snap = metrics.group("ml", "shard").snapshot()
+    ready_keys = [k for k in snap["histograms"] if k.startswith("readyMs")]
+    assert len(ready_keys) == 8
+    assert any('shard="3"' in k and 'device="3"' in k for k in ready_keys)
+    span = [sp for sp in read_spans(str(tmp_path))
+            if sp["name"] == "epoch"][0]
+    assert len(span["attrs"]["shard_ready_ms"]) == 8
+
+
+def test_record_input_health_attributes_bad_shard(mesh8):
+    from flink_ml_tpu.parallel import shard_batch
+
+    x = np.ones((16, 2), np.float32)
+    x[4, 1] = np.nan  # rows 4-5 land on shard 2
+    arr, _ = shard_batch(mesh8, x)
+    counts = meshstats.record_input_health("KMeans", mesh8, arr)
+    assert counts == [0, 0, 1, 0, 0, 0, 0, 0]
+    assert metrics.group("ml", "shard").get_gauge(
+        "nonFinite", labels={"algo": "KMeans", "shard": "2",
+                             "device": "2"}) == 1
+
+
+# -- device-labeled metrics survive merges ------------------------------------
+
+def test_registry_merge_keeps_shard_labels():
+    """The host-pool fork merge contract: a child registry's
+    shard-labeled series fold into the driver registry with their
+    labels (and per-shard identities) intact."""
+    child = MetricsRegistry()
+    grp = child.group("ml", "shard")
+    for i in range(4):
+        labels = {"shard": str(i), "device": str(i)}
+        grp.gauge("rows", 10 + i, labels=labels)
+        grp.histogram("readyMs", labels=labels).observe(float(i))
+        grp.counter("skewEvents", labels={"kind": "rows"})
+
+    driver = MetricsRegistry()
+    driver.group("ml", "shard").histogram(
+        "readyMs", labels={"shard": "0", "device": "0"}).observe(7.0)
+    driver.merge(child.snapshot())
+
+    got = driver.group("ml", "shard")
+    for i in range(4):
+        labels = {"shard": str(i), "device": str(i)}
+        assert got.get_gauge("rows", labels=labels) == 10 + i
+    # same-label histograms add, distinct labels stay apart
+    snap = got.snapshot()["histograms"]
+    assert snap['readyMs{device="0",shard="0"}']["count"] == 2
+    assert snap['readyMs{device="3",shard="3"}']["count"] == 1
+    assert got.get_counter("skewEvents", labels={"kind": "rows"}) == 4
+
+
+def test_two_mesh_snapshots_diff_cleanly(tmp_path, mesh8):
+    """Two traced mesh runs (mesh.json + shard-labeled metrics) must
+    flow through `mltrace diff` without error — exit 0 within budget."""
+    for name in ("a", "b"):
+        d = tmp_path / name
+        tracing.tracer.configure(str(d))
+        with tracing.tracer.span("fit"):
+            meshstats.ensure_mesh_recorded(mesh8)
+            meshstats.record_shard_rows(mesh8, 16)
+        dump_metrics(str(d))
+        tracing.tracer.shutdown()
+        metrics.clear()
+        meshstats._recorded.clear()
+    rc = diff_main([str(tmp_path / "a"), str(tmp_path / "b"),
+                    "--budget", "50"])
+    assert rc == 0
+
+
+# -- shards CLI ---------------------------------------------------------------
+
+def _traced_mesh_dir(tmp_path, mesh8):
+    tracing.tracer.configure(str(tmp_path))
+    from flink_ml_tpu.parallel import shard_batch
+
+    with tracing.tracer.span("fit"):
+        meshstats.ensure_mesh_recorded(mesh8)
+        meshstats.record_shard_rows(mesh8, 16)
+        arr, _ = shard_batch(mesh8, np.ones((16, 2), np.float32))
+        meshstats.observe_shard_ready(arr)
+    dump_metrics(str(tmp_path))
+    tracing.tracer.shutdown()
+    return str(tmp_path)
+
+
+def test_shards_cli_renders_one_row_per_device(tmp_path, mesh8, capsys):
+    d = _traced_mesh_dir(tmp_path, mesh8)
+    assert trace_cli(["shards", d]) == 0
+    out = capsys.readouterr().out
+    assert "8 device(s)" in out
+    for shard in range(8):
+        assert f"\n  {shard:>5} " in out or out.startswith(f"  {shard:>5} ")
+
+
+def test_shards_cli_json_and_check(tmp_path, mesh8, capsys):
+    d = _traced_mesh_dir(tmp_path, mesh8)
+    assert trace_cli(["shards", d, "--json", "--check"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["mesh"]["device_count"] == 8
+    assert len(doc["shards"]) == 8
+    assert all(r["rows"] == 2 for r in doc["shards"])
+
+
+def test_shards_cli_check_fails_on_empty_dir(tmp_path, capsys):
+    os.makedirs(tmp_path / "empty", exist_ok=True)
+    assert trace_cli(["shards", str(tmp_path / "empty"),
+                      "--check"]) == 2
+
+
+def test_pipe_guard_absorbs_broken_pipe(monkeypatch):
+    import io
+    import sys
+
+    from flink_ml_tpu.observability.exporters import pipe_guard
+
+    # the guard closes the (dead) stdout; give it a throwaway one so
+    # pytest's capture file stays open
+    monkeypatch.setattr(sys, "stdout", io.StringIO())
+    with pipe_guard():
+        raise BrokenPipeError()
+    with pytest.raises(ValueError):
+        with pipe_guard():  # only BrokenPipeError is absorbed
+            raise ValueError("x")
+
+
+# -- collective seam telemetry ------------------------------------------------
+
+def test_collective_seam_records_traced_sites(tmp_path, mesh8):
+    tracing.tracer.configure(str(tmp_path))
+    from flink_ml_tpu.parallel import all_reduce_sum
+
+    def per_shard(x):
+        return all_reduce_sum(x, DATA_AXIS)
+
+    fn = jax.jit(shard_map(per_shard, mesh=mesh8,
+                           in_specs=P(DATA_AXIS, None),
+                           out_specs=P(None, None), check_vma=False))
+    fn(np.ones((8, 4), np.float32))  # trace happens here
+    group = metrics.group("ml", "collective")
+    labels = {"op": "psum", "axis": DATA_AXIS, "devices": "8"}
+    assert group.get_counter("tracedOps", labels=labels) == 1
+    hist = group.snapshot()["histograms"]
+    key = [k for k in hist if k.startswith("payloadBytes")
+           and 'op="psum"' in k]
+    assert key and hist[key[0]]["sum"] == 16.0  # (1, 4) f32 per shard
+
+
+def test_host_op_histogram_records(mesh8):
+    from flink_ml_tpu.parallel import shard_batch
+
+    shard_batch(mesh8, np.ones((8, 2), np.float32))
+    hist = metrics.group("ml", "collective").snapshot()["histograms"]
+    key = [k for k in hist if k.startswith("opMs")
+           and 'op="shard_batch"' in k]
+    assert key and hist[key[0]]["count"] >= 1

@@ -46,10 +46,6 @@ from flink_ml_tpu.observability.cli import main as trace_cli
 from flink_ml_tpu.observability.cli import render_summary, summarize
 from flink_ml_tpu.resilience import RetryPolicy, faults
 
-_HAS_SHARD_MAP = hasattr(jax, "shard_map")
-needs_shard_map = pytest.mark.skipif(
-    not _HAS_SHARD_MAP, reason="jax.shard_map unavailable (seed-known)")
-
 
 @pytest.fixture(autouse=True)
 def _clean_tracer(monkeypatch):
@@ -501,7 +497,6 @@ def test_hostpool_inline_path_still_counts(monkeypatch):
 
 # -- model-level golden trace (needs shard_map) -------------------------------
 
-@needs_shard_map
 def test_kmeans_supervised_traced_fit_golden(tmp_path, monkeypatch, rng):
     """The ISSUE acceptance run verbatim: KMeans under run_supervised
     with one injected fault, trace armed — nested fit→epoch→checkpoint

@@ -168,7 +168,8 @@ def test_lloyd_fit_program_with_kernel_partials(rng):
             centroids, counts = partials(xl, vl, centroids)
         return jnp.concatenate([centroids, counts[:, None]], axis=1)
 
-    fit_k = jax.jit(jax.shard_map(
+    from flink_ml_tpu.parallel.shardmap import shard_map
+    fit_k = jax.jit(shard_map(
         per_shard, mesh=mesh, in_specs=(P(spec0, None), P(), P()),
         out_specs=P(), check_vma=False))
     got = np.asarray(fit_k(xs, jnp.int32(n), init))

@@ -34,12 +34,6 @@ from flink_ml_tpu.resilience import (
 )
 from flink_ml_tpu.resilience import faults
 
-#: the dense model fast paths need jax.shard_map; on builds without it the
-#: model-level chaos tests skip (the same paths' own tests skip/fail
-#: identically at the seed) — the driver-level tests below cover recovery
-#: logic without it
-_HAS_SHARD_MAP = hasattr(jax, "shard_map")
-
 
 @pytest.fixture(autouse=True)
 def _no_ambient_chaos(monkeypatch):
@@ -605,10 +599,7 @@ def test_run_segmented_supervised_chaos_identical(tmp_path):
     assert not any(n.endswith(".tmp") for n in os.listdir(mgr.base_dir))
 
 
-# -- end-to-end recovery (model level, needs shard_map) ----------------------
-
-needs_shard_map = pytest.mark.skipif(
-    not _HAS_SHARD_MAP, reason="jax.shard_map unavailable (seed-known)")
+# -- end-to-end recovery (model level, shard_map fit paths) ------------------
 
 
 @pytest.fixture
@@ -626,7 +617,6 @@ def _lr():
                               learning_rate=0.1)
 
 
-@needs_shard_map
 def test_lr_supervised_host_mode_chaos_identical(lr_data, tmp_path):
     with faults.suppressed():
         expected = _lr().fit(lr_data).coefficients
@@ -640,7 +630,6 @@ def test_lr_supervised_host_mode_chaos_identical(lr_data, tmp_path):
     np.testing.assert_allclose(got, expected, rtol=1e-6)
 
 
-@needs_shard_map
 def test_lr_supervised_device_mode_chaos_identical(lr_data, tmp_path):
     with faults.suppressed():
         expected = _lr().fit(lr_data).coefficients
@@ -654,7 +643,6 @@ def test_lr_supervised_device_mode_chaos_identical(lr_data, tmp_path):
     np.testing.assert_allclose(got, expected, rtol=1e-6)
 
 
-@needs_shard_map
 def test_kmeans_supervised_segmented_chaos_identical(rng, tmp_path):
     from flink_ml_tpu.common.table import Table
     from flink_ml_tpu.models.clustering import KMeans
@@ -673,7 +661,6 @@ def test_kmeans_supervised_segmented_chaos_identical(rng, tmp_path):
     np.testing.assert_allclose(got, expected, rtol=1e-6)
 
 
-@needs_shard_map
 def test_lr_seeded_rate_chaos_deterministic_recovery(lr_data, tmp_path):
     """The CI chaos configuration in miniature: a seeded rate plan over
     the recovery sites; a fixed seed must recover to the exact clean
