@@ -43,21 +43,31 @@ def _profiled(method, kind: str):
     @functools.wraps(method)
     def wrapper(self, *args, **kwargs):
         from flink_ml_tpu.common.metrics import PROFILE_DIR_ENV, profile
-        from flink_ml_tpu.observability import compilestats, tracing
+        from flink_ml_tpu.observability import (
+            compilestats,
+            server,
+            tracing,
+        )
 
+        # env-armed live endpoint (FLINK_ML_TPU_METRICS_PORT): one dict
+        # lookup when unarmed, and arming it flips tracer.active so
+        # spans reach the /spans/recent ring even without a trace dir
+        server.maybe_start()
         trace_dir = os.environ.get(PROFILE_DIR_ENV)
         tracer = tracing.tracer
-        if not trace_dir and not tracer.enabled:
+        if not trace_dir and not tracer.active:
             return method(self, *args, **kwargs)
         region = f"{type(self).__name__}.{kind}"
         try:
             with contextlib.ExitStack() as stack:
                 sp = None
-                if tracer.enabled:
-                    compilestats.install()
+                if tracer.active:
+                    if tracer.enabled:
+                        compilestats.install()
                     sp = stack.enter_context(tracer.span(
                         region, kind=kind, stage=type(self).__name__))
-                    stack.enter_context(compilestats.fit_window())
+                    if tracer.enabled:
+                        stack.enter_context(compilestats.fit_window())
                 if trace_dir:
                     stack.enter_context(profile(
                         os.path.join(trace_dir, region), name=region))

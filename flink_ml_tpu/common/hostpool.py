@@ -103,6 +103,14 @@ def _child_main(fn, lo, hi, wfd, chaos_action=None):
 
     tracing.tracer.reseed_child()
     metrics.reseed_child()
+    # the live telemetry endpoint is driver-only: if the parent armed
+    # one, close the inherited listener fd and pin it shut in the child
+    # (only when the module is already loaded — don't pay its import)
+    import sys as _sys
+
+    _srv = _sys.modules.get("flink_ml_tpu.observability.server")
+    if _srv is not None:
+        _srv.reseed_child()
     try:
         if chaos_action is not None:
             # decided in the PARENT pre-fork so the schedule counter
@@ -219,8 +227,17 @@ def _finalize(child):
 
         try:
             metrics.merge(snap)
-        except ValueError:  # a bucket-drift snapshot must not fail the map
-            pass
+        except ValueError:
+            # a bucket-drift snapshot must not fail the map — but it
+            # must not vanish either: count + log the drop so the
+            # missing child metrics are explainable from the driver
+            import logging
+
+            metrics.group("ml", "hostpool").counter(
+                "droppedChildSnapshots")
+            logging.getLogger(__name__).warning(
+                "dropping worker %d metric snapshot (bucket drift)",
+                child.idx, exc_info=True)
     return envelope["result"]
 
 

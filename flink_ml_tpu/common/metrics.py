@@ -18,10 +18,21 @@ per-subtask metric aggregation, done by Flink's JobManager there).
 Labeled metrics render their key in Prometheus label syntax
 (``name{site="epoch"}``) so a snapshot is one string-split away from text
 exposition (observability/exporters.py).
+
+Live serving telemetry (docs/observability.md "Live telemetry & SLOs")
+adds **sliding windows** on top of the cumulative primitives:
+:class:`WindowedHistogram` keeps a ring of bucket-snapshot slices so
+"p99 over the last 60 seconds" is answerable from a running process,
+and :class:`WindowedCounter` gives rates/deltas over the same horizon.
+Both preserve the cumulative view — ``snapshot`` / Prometheus
+exposition / :meth:`MetricsRegistry.merge` are byte-identical to the
+plain classes, so the fork-boundary merge and every artifact reader
+keep working unchanged.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
 import time
@@ -55,6 +66,56 @@ def metric_key(name: str, labels: Optional[Dict[str, str]] = None) -> str:
     return f"{name}{{{inner}}}"
 
 
+def check_histogram_snapshot(key, snap: dict,
+                             expected_buckets=None) -> None:
+    """Validate a histogram snapshot's bucket layout BEFORE any fold:
+    ``buckets``/``counts`` must be equal-length numeric sequences with
+    sorted bounds, and — when ``expected_buckets`` is given — the bounds
+    must match it exactly. Raises ValueError naming ``key`` (pass None
+    for a bare histogram). One shared checker so every merge path
+    (:meth:`Histogram.merge_snapshot`, :meth:`MetricGroup.check_snapshot`,
+    :meth:`MetricsRegistry.merge`) rejects a drifted or malformed
+    snapshot loudly instead of folding it partially — a short ``counts``
+    array used to fold silently and a long one blew up mid-merge."""
+    where = f"histogram {key!r}" if key is not None else "histogram"
+    buckets = snap.get("buckets")
+    counts = snap.get("counts")
+    if (not isinstance(buckets, (list, tuple))
+            or not isinstance(counts, (list, tuple))):
+        raise ValueError(f"{where}: malformed snapshot — buckets/counts "
+                         f"must be sequences, got {type(buckets).__name__}"
+                         f"/{type(counts).__name__}")
+    try:
+        bounds = tuple(float(b) for b in buckets)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{where}: non-numeric bucket bounds {list(buckets)!r}")
+    if len(bounds) != len(counts):
+        raise ValueError(
+            f"{where}: bucket layout mismatch — {len(bounds)} bound(s) "
+            f"vs {len(counts)} count(s)")
+    try:
+        for c in counts:
+            int(c)
+        float(snap.get("sum", 0.0))
+        int(snap.get("count", 0))
+    except (TypeError, ValueError):
+        # validate the fold's inputs HERE, before any mutation — a junk
+        # count that only failed inside _merge_locked would leave the
+        # histogram partially folded
+        raise ValueError(
+            f"{where}: non-numeric counts/sum/count in snapshot")
+    if list(bounds) != sorted(bounds):
+        # constructing a Histogram from these would silently re-sort the
+        # bounds while the counts stay in snapshot order — misaligned
+        raise ValueError(
+            f"{where}: unsorted bucket bounds {list(bounds)}")
+    if expected_buckets is not None and bounds != tuple(expected_buckets):
+        raise ValueError(
+            f"{where} bucket mismatch: {list(bounds)} "
+            f"vs {list(expected_buckets)}")
+
+
 class Histogram:
     """Cumulative-bucket histogram (Prometheus semantics): ``counts[i]``
     tallies observations <= ``buckets[i]``; an implicit +Inf bucket is
@@ -69,14 +130,17 @@ class Histogram:
         self.count = 0
         self._lock = threading.Lock()
 
+    def _observe_locked(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                self.counts[i] += 1
+
     def observe(self, value: float) -> None:
         value = float(value)
         with self._lock:
-            self.sum += value
-            self.count += 1
-            for i, bound in enumerate(self.buckets):
-                if value <= bound:
-                    self.counts[i] += 1
+            self._observe_locked(value)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -91,18 +155,21 @@ class Histogram:
         the last finite bound past it)."""
         return histogram_quantile(self.snapshot(), q)
 
+    def _merge_locked(self, snap: dict) -> None:
+        for i, c in enumerate(snap["counts"]):
+            self.counts[i] += int(c)
+        # .get: a snapshot missing sum/count merges as zeros instead of
+        # escaping with a KeyError mid-merge (callers catch ValueError)
+        self.sum += float(snap.get("sum", 0.0))
+        self.count += int(snap.get("count", 0))
+
     def merge_snapshot(self, snap: dict) -> None:
-        """Fold a child histogram snapshot in (bucket bounds must match —
-        both sides derive them from the same instrumentation site)."""
+        """Fold a child histogram snapshot in (bucket layout must match —
+        both sides derive it from the same instrumentation site;
+        :func:`check_histogram_snapshot` rejects drift loudly)."""
+        check_histogram_snapshot(None, snap, self.buckets)
         with self._lock:
-            if tuple(snap.get("buckets", ())) != self.buckets:
-                raise ValueError(
-                    f"histogram bucket mismatch: {snap.get('buckets')} "
-                    f"vs {list(self.buckets)}")
-            for i, c in enumerate(snap["counts"]):
-                self.counts[i] += int(c)
-            self.sum += float(snap["sum"])
-            self.count += int(snap["count"])
+            self._merge_locked(snap)
 
 
 def histogram_quantile(snapshot: dict, q: float) -> float:
@@ -147,12 +214,238 @@ def histogram_quantile(snapshot: dict, q: float) -> float:
     return float(buckets[-1])
 
 
+#: default sliding-window horizon / slice count for windowed metrics —
+#: 15 minutes at 10-second granularity covers the default SLO burn
+#: windows (observability/slo.py) while keeping the ring ≤ ~91 entries
+DEFAULT_HORIZON_S = 900.0
+DEFAULT_SLICES = 90
+
+
+class WindowedHistogram(Histogram):
+    """Sliding-window view on top of a cumulative histogram.
+
+    A ring of **bucket-snapshot slices**: every ``horizon_s / slices``
+    seconds (lazily, on the next observe/merge/query — no timer thread)
+    the cumulative bucket state is pushed onto the ring; a window query
+    subtracts the newest ring entry at least ``window_s`` old from the
+    current cumulative state, yielding a cumulative-bucket snapshot of
+    just the observations inside the window (so
+    :func:`histogram_quantile` applies unchanged). Window edges are
+    slice-granular by design.
+
+    The cumulative view is untouched: :meth:`snapshot`, Prometheus
+    exposition and :meth:`merge_snapshot` behave exactly like the base
+    class, so registry merges (host-pool children) and artifact readers
+    need no changes — and counts merged from a child land in the
+    *current* slice, i.e. they appear in the driver's windowed view at
+    merge time. ``clock`` is injectable for deterministic tests.
+    Thread-safe."""
+
+    __slots__ = ("horizon_s", "_slice_s", "_clock", "_ring",
+                 "_last_slice", "_t0")
+
+    def __init__(self, buckets=DEFAULT_BUCKETS,
+                 horizon_s: float = DEFAULT_HORIZON_S,
+                 slices: int = DEFAULT_SLICES, clock=time.monotonic):
+        super().__init__(buckets)
+        if horizon_s <= 0 or int(slices) < 1:
+            raise ValueError("horizon_s must be > 0 and slices >= 1")
+        self.horizon_s = float(horizon_s)
+        self._slice_s = self.horizon_s / int(slices)
+        self._clock = clock
+        self._ring = collections.deque()  # (t, counts, sum, count)
+        now = clock()
+        self._t0 = now
+        self._last_slice = now
+
+    def _rotate_locked(self, now: float) -> None:
+        if now - self._last_slice < self._slice_s:
+            return
+        # anything observed after the current slice ended would have
+        # rotated first, so the cumulative state is unchanged since then
+        # — stamping the entry at the slice end (not ``now``) keeps a
+        # dormant histogram's stale observations out of future windows
+        t = min(now, self._last_slice + self._slice_s)
+        self._ring.append((t, tuple(self.counts), self.sum, self.count))
+        self._last_slice = now
+        cutoff = now - self.horizon_s
+        # keep ONE entry at/past the full horizon as the baseline
+        while len(self._ring) >= 2 and self._ring[1][0] <= cutoff:
+            self._ring.popleft()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._rotate_locked(self._clock())
+            self._observe_locked(value)
+
+    def merge_snapshot(self, snap: dict) -> None:
+        check_histogram_snapshot(None, snap, self.buckets)
+        with self._lock:
+            self._rotate_locked(self._clock())
+            self._merge_locked(snap)
+
+    def window_snapshot(self, window_s: Optional[float] = None) -> dict:
+        """Cumulative-bucket snapshot of the observations inside the
+        last ``window_s`` seconds (default, and upper bound: the full
+        horizon) — same shape as :meth:`snapshot` plus ``window_s``
+        (requested) and ``elapsed_s`` (actually covered, shorter early
+        in the histogram's life)."""
+        w = self.horizon_s if window_s is None \
+            else min(float(window_s), self.horizon_s)
+        with self._lock:
+            now = self._clock()
+            self._rotate_locked(now)
+            cutoff = now - w
+            base = None
+            for entry in reversed(self._ring):
+                if entry[0] <= cutoff:
+                    base = entry
+                    break
+            if base is None:  # younger than the window: zeros baseline
+                bt, bcounts, bsum, bcount = (
+                    self._t0, (0,) * len(self.buckets), 0.0, 0)
+            else:
+                bt, bcounts, bsum, bcount = base
+            return {"buckets": list(self.buckets),
+                    "counts": [c - b for c, b in
+                               zip(self.counts, bcounts)],
+                    "sum": self.sum - bsum,
+                    "count": self.count - bcount,
+                    "window_s": w,
+                    "elapsed_s": max(now - bt, 0.0)}
+
+    def window_quantile(self, q: float,
+                        window_s: Optional[float] = None) -> float:
+        """``q`` quantile over the sliding window (NaN when the window
+        holds no observations — same contract as
+        :func:`histogram_quantile`)."""
+        return histogram_quantile(self.window_snapshot(window_s), q)
+
+    def window_rate(self, window_s: Optional[float] = None) -> float:
+        """Observations per second over the sliding window (0.0 before
+        anything lands)."""
+        snap = self.window_snapshot(window_s)
+        elapsed = snap.get("elapsed_s") or 0.0
+        if elapsed <= 0.0:
+            return 0.0
+        return snap["count"] / elapsed
+
+
+class WindowedCounter:
+    """Sliding-window view over ONE (possibly labeled) counter of a
+    :class:`MetricGroup`. The group's plain counter stays THE cumulative
+    value — snapshots, merges and Prometheus exposition are untouched;
+    this object only keeps timestamped baselines of it, so increments a
+    host-pool child folded in through :meth:`MetricsRegistry.merge`
+    show up in the window too. Obtain via
+    :meth:`MetricGroup.windowed_counter`; thread-safe."""
+
+    __slots__ = ("horizon_s", "_slice_s", "_clock", "_ring",
+                 "_last_slice", "_last_seen", "_initial", "_t0",
+                 "_read", "_inc", "_lock")
+
+    def __init__(self, read, inc, horizon_s: float = DEFAULT_HORIZON_S,
+                 slices: int = DEFAULT_SLICES, clock=time.monotonic):
+        if horizon_s <= 0 or int(slices) < 1:
+            raise ValueError("horizon_s must be > 0 and slices >= 1")
+        self.horizon_s = float(horizon_s)
+        self._slice_s = self.horizon_s / int(slices)
+        self._clock = clock
+        self._read = read   # () -> current cumulative value
+        self._inc = inc     # (n) -> new cumulative value
+        self._ring = collections.deque()  # (t, cumulative)
+        self._lock = threading.Lock()
+        now = clock()
+        self._t0 = now
+        self._last_slice = now
+        # pre-existing counts must not appear in any window: they are
+        # both the backdating watermark and the no-ring-entry baseline
+        self._initial = self._last_seen = int(read())
+
+    def _rotate_locked(self, now: float) -> None:
+        if now - self._last_slice < self._slice_s:
+            return
+        cur = int(self._read())
+        t = min(now, self._last_slice + self._slice_s)
+        if cur == self._last_seen:
+            # dormant since the last boundary: backdate the stamp so
+            # stale counts never re-enter a fresh window
+            self._ring.append((t, cur))
+        else:
+            # the counter moved outside inc() — a plain counter() call
+            # or a registry merge. We only know the old value held at
+            # the last boundary and the new one holds now: stamp both,
+            # so the delta stays window-visible from the merge onward
+            self._ring.append((t, self._last_seen))
+            self._ring.append((max(now, t), cur))
+        self._last_seen = cur
+        self._last_slice = now
+        cutoff = now - self.horizon_s
+        while len(self._ring) >= 2 and self._ring[1][0] <= cutoff:
+            self._ring.popleft()
+
+    def inc(self, n: int = 1) -> int:
+        """Increment the underlying group counter (rotating the window
+        ring first, so the boundary excludes this increment)."""
+        with self._lock:
+            self._rotate_locked(self._clock())
+            value = int(self._inc(n))
+            # accounted for at inc time: the next rotation may backdate
+            # its boundary stamp safely (no merge/raw-counter movement)
+            self._last_seen = max(self._last_seen, value)
+        return value
+
+    @property
+    def value(self) -> int:
+        """The cumulative value (the group's plain counter)."""
+        return int(self._read())
+
+    def window_delta(self, window_s: Optional[float] = None) -> int:
+        """Increments inside the last ``window_s`` seconds (default,
+        and upper bound: the horizon)."""
+        w = self.horizon_s if window_s is None \
+            else min(float(window_s), self.horizon_s)
+        with self._lock:
+            now = self._clock()
+            self._rotate_locked(now)
+            cutoff = now - w
+            # no entry old enough → the window reaches past this view's
+            # birth: baseline at the CONSTRUCTION value, never 0, so
+            # counts that pre-date the windowed view stay out of it
+            base = self._initial
+            for entry in reversed(self._ring):
+                if entry[0] <= cutoff:
+                    base = entry[1]
+                    break
+            return int(self._read()) - base
+
+    def window_rate(self, window_s: Optional[float] = None) -> float:
+        """Increments per second over the sliding window."""
+        w = self.horizon_s if window_s is None \
+            else min(float(window_s), self.horizon_s)
+        with self._lock:
+            now = self._clock()
+            self._rotate_locked(now)
+            cutoff = now - w
+            bt, base = self._t0, self._initial  # see window_delta
+            for entry in reversed(self._ring):
+                if entry[0] <= cutoff:
+                    bt, base = entry
+                    break
+            elapsed = max(now - bt, 0.0)
+            if elapsed <= 0.0:
+                return 0.0
+            return (int(self._read()) - base) / elapsed
+
+
 class MetricGroup:
     def __init__(self, name: str):
         self.name = name
         self._gauges: Dict[str, float] = {}
         self._counters: Dict[str, int] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._windowed_counters: Dict[str, WindowedCounter] = {}
         self._lock = threading.Lock()
 
     def gauge(self, name: str, value,
@@ -177,6 +470,55 @@ class MetricGroup:
             if hist is None:
                 hist = self._histograms[key] = Histogram(buckets)
             return hist
+
+    def windowed_histogram(self, name: str, buckets=DEFAULT_BUCKETS,
+                           horizon_s: float = DEFAULT_HORIZON_S,
+                           slices: int = DEFAULT_SLICES,
+                           labels: Optional[Dict[str, str]] = None
+                           ) -> WindowedHistogram:
+        """The :class:`WindowedHistogram` registered under ``name``
+        (+labels), created on first use. A plain histogram already
+        registered under the key (e.g. a child snapshot merged before
+        the driver's first live observation) is upgraded in place — its
+        cumulative state folds into the new window's current slice."""
+        key = metric_key(name, labels)
+        with self._lock:
+            hist = self._histograms.get(key)
+            if isinstance(hist, WindowedHistogram):
+                return hist
+            wh = WindowedHistogram(
+                buckets if hist is None else hist.buckets,
+                horizon_s=horizon_s, slices=slices)
+            if hist is not None:
+                wh.merge_snapshot(hist.snapshot())
+            self._histograms[key] = wh
+            return wh
+
+    def windowed_counter(self, name: str,
+                         horizon_s: float = DEFAULT_HORIZON_S,
+                         slices: int = DEFAULT_SLICES,
+                         labels: Optional[Dict[str, str]] = None
+                         ) -> WindowedCounter:
+        """The :class:`WindowedCounter` view over counter ``name``
+        (+labels), created on first use. Increment through its
+        :meth:`~WindowedCounter.inc` (or keep using :meth:`counter` —
+        the plain counter stays the single cumulative source of truth;
+        this object only adds window baselines over it)."""
+        key = metric_key(name, labels)
+        with self._lock:
+            wc = self._windowed_counters.get(key)
+            if wc is None:
+                wc = self._windowed_counters[key] = WindowedCounter(
+                    read=lambda: self._counters.get(key, 0),
+                    inc=lambda n: self.counter(name, n, labels),
+                    horizon_s=horizon_s, slices=slices)
+            return wc
+
+    def windowed_counter_items(self):
+        """``(key, WindowedCounter)`` pairs registered on this group —
+        the SLO engine's enumeration seam (observability/slo.py)."""
+        with self._lock:
+            return list(self._windowed_counters.items())
 
     def get_gauge(self, name: str,
                   labels: Optional[Dict[str, str]] = None):
@@ -214,17 +556,18 @@ class MetricGroup:
                            ).merge_snapshot(hsnap)
 
     def check_snapshot(self, snap: dict) -> None:
-        """Raise ValueError if merging ``snap`` would fail (histogram
-        bucket drift against an existing series) — called before any
-        mutation so merges are all-or-nothing."""
+        """Raise ValueError if merging ``snap`` would fail — histogram
+        bucket drift against an existing series, or a malformed bucket
+        layout (short/long/unsorted/non-numeric) that would previously
+        fold partially or blow up mid-merge. Called before any mutation
+        so merges are all-or-nothing; see
+        :func:`check_histogram_snapshot` for the full contract."""
         for key, hsnap in snap.get("histograms", {}).items():
             with self._lock:
                 existing = self._histograms.get(key)
-            if existing is not None and \
-                    tuple(hsnap.get("buckets", ())) != existing.buckets:
-                raise ValueError(
-                    f"histogram {key!r} bucket mismatch: "
-                    f"{hsnap.get('buckets')} vs {list(existing.buckets)}")
+            check_histogram_snapshot(
+                key, hsnap,
+                existing.buckets if existing is not None else None)
 
 
 class MetricsRegistry:

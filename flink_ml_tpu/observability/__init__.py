@@ -36,10 +36,25 @@ from flink_ml_tpu.observability.health import (
 from flink_ml_tpu.observability.exporters import (
     chrome_trace,
     dump_metrics,
+    latest_trace_dir,
     prometheus_text,
     read_metrics,
     read_spans,
+    resolve_trace_dir,
     write_chrome_trace,
+)
+from flink_ml_tpu.observability.slo import (
+    SLO,
+    SLO_EVENT,
+    SLO_SPEC_ENV,
+    default_slos,
+    evaluate_slos,
+    load_specs,
+)
+from flink_ml_tpu.observability.server import (
+    METRICS_PORT_ENV,
+    TelemetryServer,
+    maybe_start,
 )
 from flink_ml_tpu.observability.meshstats import (
     SKEW_EVENT,
@@ -63,10 +78,15 @@ from flink_ml_tpu.observability.tracing import (
 __all__ = [
     "CONVERGENCE_EVENT",
     "HEALTH_EVENT",
+    "METRICS_PORT_ENV",
     "SKEW_EVENT",
+    "SLO",
+    "SLO_EVENT",
+    "SLO_SPEC_ENV",
     "TRACE_DIR_ENV",
     "ConvergenceListener",
     "Span",
+    "TelemetryServer",
     "Tracer",
     "aot_compile",
     "check_fit",
@@ -79,11 +99,16 @@ __all__ = [
     "chrome_trace",
     "compile_stats",
     "compile_totals",
+    "default_slos",
     "detect_skew",
     "dump_metrics",
     "ensure_mesh_recorded",
+    "evaluate_slos",
     "event",
     "instrumented_jit",
+    "latest_trace_dir",
+    "load_specs",
+    "maybe_start",
     "mesh_snapshot",
     "observe_shard_ready",
     "prometheus_text",
@@ -92,6 +117,7 @@ __all__ = [
     "read_spans",
     "record_input_health",
     "record_shard_rows",
+    "resolve_trace_dir",
     "sample_memory",
     "span",
     "tracer",

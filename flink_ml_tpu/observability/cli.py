@@ -14,6 +14,8 @@ rerunning anything:
     flink-ml-tpu-trace diff A B --budget 20      # regression gate (exit 4)
     flink-ml-tpu-trace health TRACE_DIR --check  # model health (exit 3)
     flink-ml-tpu-trace shards TRACE_DIR --check  # per-device mesh view
+    flink-ml-tpu-trace slo TRACE_DIR --check     # SLO verdicts (exit 4)
+    flink-ml-tpu-trace ROOT --latest             # newest trace dir under ROOT
 
 Sections: top spans by self-time (time in a span minus its children —
 where work actually happened), per-epoch breakdown (host/device split,
@@ -31,7 +33,14 @@ unattended sweeps. The ``shards`` subcommand (observability/shards.py)
 renders the per-device mesh view — topology, per-shard rows/ready/skew
 table, collective structure — and with ``--check`` exits 2 when the
 trace recorded no multi-device telemetry: the CI gate proving the mesh
-lane really ran multi-device.
+lane really ran multi-device. The ``slo`` subcommand
+(observability/slo.py) evaluates declarative latency/error-rate SLOs
+against the metrics artifacts and with ``--check`` exits 4 on a
+violation — the serving twin of the ``diff`` perf gate; the live,
+windowed verdicts come from the ``/slo`` endpoint of a running process
+(observability/server.py). Every subcommand accepts ``--latest``:
+treat the positional dir as a root and resolve the newest trace dir
+under it (exporters.resolve_trace_dir) — no more hand-globbing.
 
 Every subcommand's stdout rendering runs under the shared
 ``exporters.pipe_guard`` — ``... | head`` closing the pipe is normal
@@ -184,6 +193,12 @@ def main(argv=None) -> int:
         from flink_ml_tpu.observability.shards import main as shards_main
 
         return shards_main(argv[1:])
+    if argv and argv[0] == "slo":
+        # SLO verdicts (observability/slo.py); same dispatch rule —
+        # use ./slo to summarize a directory named "slo"
+        from flink_ml_tpu.observability.slo import main as slo_main
+
+        return slo_main(argv[1:])
     if argv and argv[0] == "summary":
         # explicit subcommand spelling for the default view, so
         # unattended consumers can write `summary --json` without
@@ -209,9 +224,17 @@ def main(argv=None) -> int:
     parser.add_argument("--check", action="store_true",
                         help="exit 2 when the trace has no spans (CI "
                              "smoke gate)")
+    parser.add_argument("--latest", action="store_true",
+                        help="treat TRACE_DIR as a root and pick the "
+                             "newest trace dir under it")
     args = parser.parse_args(argv)
 
     try:
+        from flink_ml_tpu.observability.exporters import (
+            resolve_trace_dir,
+        )
+
+        args.trace_dir = resolve_trace_dir(args.trace_dir, args.latest)
         spans = read_spans(args.trace_dir)
     except OSError as e:
         print(f"flink-ml-tpu-trace: cannot read {args.trace_dir}: {e}",

@@ -262,9 +262,22 @@ def main(argv=None) -> int:
                         default="text")
     parser.add_argument("--top", type=int, default=15,
                         help="rows per section in text output")
+    parser.add_argument("--latest", action="store_true",
+                        help="treat each directory side as a root and "
+                             "pick the newest trace dir under it "
+                             "(snapshot-file sides pass through)")
     args = parser.parse_args(argv)
 
     try:
+        from flink_ml_tpu.observability.exporters import (
+            resolve_trace_dir,
+        )
+
+        if args.latest:
+            if os.path.isdir(args.a):
+                args.a = resolve_trace_dir(args.a, True)
+            if os.path.isdir(args.b):
+                args.b = resolve_trace_dir(args.b, True)
         side_a = load_side(args.a)
         side_b = load_side(args.b)
     except (OSError, ValueError, json.JSONDecodeError) as e:

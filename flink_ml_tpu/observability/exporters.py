@@ -54,6 +54,46 @@ def pipe_guard():
             pass
 
 
+# -- trace-dir resolution -----------------------------------------------------
+def latest_trace_dir(root: str) -> Optional[str]:
+    """The newest trace dir under ``root``: ``root`` itself or any
+    direct child holding ``spans-*.jsonl`` / ``metrics-*.json``
+    artifacts, newest by the artifacts' own mtimes (a dir's newest
+    artifact decides). Returns None when nothing qualifies — shared by
+    every CLI subcommand's ``--latest`` so CI and humans stop
+    hand-globbing ``trace-*`` dirs."""
+    candidates: Dict[str, float] = {}
+    for pat in (SPANS_GLOB, METRICS_GLOB):
+        for path in (glob.glob(os.path.join(root, pat))
+                     + glob.glob(os.path.join(root, "*", pat))):
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            d = os.path.dirname(path)
+            candidates[d] = max(candidates.get(d, 0.0), mtime)
+    if not candidates:
+        return None
+    # mtime ties (same-second writes) break on the path so the pick is
+    # deterministic
+    return max(candidates.items(), key=lambda kv: (kv[1], kv[0]))[0]
+
+
+def resolve_trace_dir(path: str, latest: bool = False) -> str:
+    """The ``--latest`` seam of the trace CLI: with ``latest``, treat
+    ``path`` as a root and return its newest trace dir (raising
+    FileNotFoundError — an OSError, so existing exit-2 paths catch it —
+    when none exists); otherwise return ``path`` unchanged."""
+    if not latest:
+        return path
+    resolved = latest_trace_dir(path)
+    if resolved is None:
+        raise FileNotFoundError(
+            f"{path}: no trace dirs with spans-*.jsonl or "
+            f"metrics-*.json under it")
+    return resolved
+
+
 # -- span collection ---------------------------------------------------------
 def read_spans(trace_dir: str) -> List[dict]:
     """All span records from every ``spans-*.jsonl`` in ``trace_dir``

@@ -37,7 +37,14 @@ Serving path: every :class:`~flink_ml_tpu.servable.api
 and a prediction-distribution summary (min/max/mean/finite-fraction)
 into ``ml.serving`` — the drift baseline; a batch with non-finite
 predictions emits an ``ml.health`` event but never fails the serving
-call.
+call. The latency/row histograms and the transform/error counters are
+**windowed** (common/metrics.py WindowedHistogram/WindowedCounter, the
+cumulative view unchanged) so the SLO engine (observability/slo.py)
+and the live ``/slo`` endpoint (observability/server.py) can answer
+"p99 over the last 60 seconds" from a running process; the seam also
+tracks an in-flight gauge, per-exception-class error counters, and
+probabilistically samples request-scoped spans
+(``FLINK_ML_TPU_TRACE_SAMPLE``).
 
 Inspect with ``flink-ml-tpu-trace health <dir>`` (``--check`` exits 3 —
 the sweep's correctness class — when any ``ml.health`` event is
@@ -48,6 +55,7 @@ from __future__ import annotations
 
 import math
 import os
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -72,8 +80,13 @@ __all__ = [
     "check_fit",
     "guard_final_state",
     "ConvergenceListener",
+    "SAMPLE_ENV",
     "observe_serving",
+    "observe_serving_error",
+    "serving_inflight",
     "summarize_values",
+    "trace_sample_rate",
+    "trace_sampled",
     "health_summary",
     "render_health",
     "main",
@@ -102,6 +115,16 @@ VALUE_BUCKETS = (1e-8, 1e-6, 1e-4, 1e-3, 1e-2, 0.1, 0.25, 0.5, 1.0, 2.5,
 #: row-count-shaped bounds for serving batch sizes
 COUNT_BUCKETS = (1.0, 8.0, 32.0, 128.0, 512.0, 2048.0, 8192.0, 65536.0,
                  1048576.0)
+
+#: probabilistic request-trace sampling rate for the serving seam
+#: (0..1; default 1.0 — every request, turn it down under load)
+SAMPLE_ENV = "FLINK_ML_TPU_TRACE_SAMPLE"
+
+#: sliding-window horizon for the serving metrics: covers the default
+#: SLO burn windows (observability/slo.py, up to 300 s) at 10-second
+#: slice granularity
+SERVING_HORIZON_S = 900.0
+SERVING_SLICES = 90
 
 #: at most this many ml.convergence span events per fit (stride-sampled,
 #: first/last always kept) — a 10k-epoch host loop must not bloat the
@@ -420,6 +443,66 @@ class ConvergenceListener:
 
 # -- serving-path metrics -----------------------------------------------------
 
+def trace_sample_rate() -> float:
+    """The request-span sampling rate from ``FLINK_ML_TPU_TRACE_SAMPLE``
+    (clamped to [0, 1]; default 1.0 — unparseable values fall back to
+    the default rather than silently disabling tracing)."""
+    raw = os.environ.get(SAMPLE_ENV)
+    if raw is None or raw == "":
+        return 1.0
+    try:
+        return min(1.0, max(0.0, float(raw)))
+    except ValueError:
+        return 1.0
+
+
+def trace_sampled() -> bool:
+    """One Bernoulli draw at the configured sampling rate — the serving
+    seam's per-request span decision (0 and 1 skip the RNG)."""
+    rate = trace_sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    import random
+
+    return random.random() < rate
+
+
+_inflight: Dict[str, int] = {}
+_inflight_lock = threading.Lock()
+
+
+def serving_inflight(servable: str, delta: int) -> int:
+    """Track concurrent in-flight transforms per servable as the
+    ``ml.serving inFlight{servable=}`` gauge (clamped at 0 — a lone
+    decrement from an unbalanced error path must not go negative).
+    Returns the new value."""
+    with _inflight_lock:
+        value = max(0, _inflight.get(servable, 0) + int(delta))
+        _inflight[servable] = value
+    metrics.group(ML_GROUP, "serving").gauge(
+        "inFlight", value, labels={"servable": servable})
+    return value
+
+
+def observe_serving_error(servable: str, exception: str,
+                          latency_ms: float) -> None:
+    """Record one FAILED servable transform: the windowed
+    ``errors{servable=}`` counter (the error-rate SLO numerator), a
+    per-exception-class ``errorsByClass{servable=,exception=}``
+    counter, and the failure latency as an ``errorMs`` histogram —
+    kept apart from ``transformMs`` so fast-failing requests cannot
+    flatter the success latency distribution."""
+    group = metrics.group(ML_GROUP, "serving")
+    labels = {"servable": servable}
+    group.windowed_counter("errors", horizon_s=SERVING_HORIZON_S,
+                           slices=SERVING_SLICES, labels=labels).inc()
+    group.counter("errorsByClass",
+                  labels={"servable": servable, "exception": exception})
+    group.histogram("errorMs", labels=labels).observe(latency_ms)
+
+
 def summarize_values(servable: str, name: str, values) -> None:
     """Record a distribution summary — ``<name>Min/Max/Mean/
     FiniteFraction`` gauges in ``ml.serving``, labeled by servable — for
@@ -449,18 +532,34 @@ def summarize_values(servable: str, name: str, values) -> None:
 
 def observe_serving(servable: str, rows: int, latency_ms: float,
                     predictions=None) -> None:
-    """Record one servable ``transform`` into ``ml.serving``: latency +
-    row-count histograms (labeled by servable) and, when a numeric
-    prediction column is available, its :func:`summarize_values`
-    distribution summary. Non-finite predictions emit an ``ml.health``
-    event but never fail the serving call."""
+    """Record one servable ``transform`` into ``ml.serving``: windowed
+    latency + row-count histograms and transform/row counters (labeled
+    by servable — cumulative views unchanged, so merges and Prometheus
+    keep working while the SLO engine reads sliding windows) and, when
+    a numeric prediction column is available, its
+    :func:`summarize_values` distribution summary. Non-finite
+    predictions emit an ``ml.health`` event but never fail the serving
+    call."""
     group = metrics.group(ML_GROUP, "serving")
     labels = {"servable": servable}
-    group.counter("transforms", labels=labels)
-    group.counter("rowsTotal", int(rows), labels=labels)
-    group.histogram("transformMs", labels=labels).observe(latency_ms)
-    group.histogram("rows", buckets=COUNT_BUCKETS,
-                    labels=labels).observe(float(rows))
+    group.windowed_counter("transforms", horizon_s=SERVING_HORIZON_S,
+                           slices=SERVING_SLICES, labels=labels).inc()
+    group.windowed_counter("rowsTotal", horizon_s=SERVING_HORIZON_S,
+                           slices=SERVING_SLICES,
+                           labels=labels).inc(int(rows))
+    # registering the errors window here (no increment) keeps the
+    # error-rate SLO's numerator and denominator on the same windowed
+    # source even before the first failure
+    group.windowed_counter("errors", horizon_s=SERVING_HORIZON_S,
+                           slices=SERVING_SLICES, labels=labels)
+    group.windowed_histogram("transformMs",
+                             horizon_s=SERVING_HORIZON_S,
+                             slices=SERVING_SLICES,
+                             labels=labels).observe(latency_ms)
+    group.windowed_histogram("rows", buckets=COUNT_BUCKETS,
+                             horizon_s=SERVING_HORIZON_S,
+                             slices=SERVING_SLICES,
+                             labels=labels).observe(float(rows))
     if predictions is not None:
         summarize_values(servable, "prediction", predictions)
 
@@ -643,6 +742,7 @@ def main(argv=None) -> int:
     from flink_ml_tpu.observability.exporters import (
         read_metrics,
         read_spans,
+        resolve_trace_dir,
     )
 
     parser = argparse.ArgumentParser(
@@ -656,9 +756,13 @@ def main(argv=None) -> int:
     parser.add_argument("--check", action="store_true",
                         help="exit 3 when a health event is present, "
                              "2 on empty/unreadable artifacts")
+    parser.add_argument("--latest", action="store_true",
+                        help="treat TRACE_DIR as a root and pick the "
+                             "newest trace dir under it")
     args = parser.parse_args(argv)
 
     try:
+        args.trace_dir = resolve_trace_dir(args.trace_dir, args.latest)
         spans = read_spans(args.trace_dir)
     except OSError as e:
         print(f"flink-ml-tpu-trace health: cannot read "

@@ -1,0 +1,228 @@
+"""Embedded live-telemetry HTTP endpoint: scrape a *running* process.
+
+Every artifact so far (spans, metrics snapshots) is read post-mortem
+from a trace dir; this module serves the live half — a stdlib
+``http.server`` daemon thread, env-armed by
+``FLINK_ML_TPU_METRICS_PORT`` (``0`` binds an ephemeral port; read it
+back from :attr:`TelemetryServer.port`), started lazily by the first
+instrumented seam that runs (api/stage.py fit/transform, the servable
+``_served`` wrapper). Routes:
+
+- ``/metrics`` — the process registry in Prometheus text exposition
+  (observability/exporters.py), cumulative histograms included, so any
+  scraper computes its own windows;
+- ``/healthz`` — liveness JSON (status, pid, uptime);
+- ``/slo`` — live SLO verdicts (observability/slo.py) over the
+  registry's *windowed* metrics; violations emit their events/counters
+  on every evaluation, so scraping doubles as the burn-rate alerter;
+- ``/spans/recent`` — the tracer's in-memory ring of recently closed
+  spans (tracing.RECENT_SPANS; arming the endpoint flips
+  ``tracer.keep_recent`` so request-scoped spans exist even without a
+  trace dir).
+
+**Driver-only.** Host-pool children (common/hostpool.py) never listen:
+:func:`maybe_start` refuses in any pid other than the one that imported
+this module, and the fork reseed (:func:`reseed_child`) closes the
+inherited listener fd and pins the module shut — children keep shipping
+metric snapshots through the existing merge path instead. Binding
+failures are logged once and latch the module off; telemetry must never
+take the serving process down.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from flink_ml_tpu.common.metrics import metrics
+from flink_ml_tpu.observability import tracing
+
+__all__ = ["METRICS_PORT_ENV", "METRICS_HOST_ENV", "TelemetryServer",
+           "maybe_start", "stop", "reseed_child"]
+
+#: env var holding the port to serve on; unset → no endpoint, ``0`` →
+#: an ephemeral port (tests, the serve smoke)
+METRICS_PORT_ENV = "FLINK_ML_TPU_METRICS_PORT"
+#: bind address (default loopback — a sidecar scraper; widen explicitly)
+METRICS_HOST_ENV = "FLINK_ML_TPU_METRICS_HOST"
+
+ROUTES = ("/metrics", "/healthz", "/slo", "/spans/recent")
+
+_PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+_JSON_CTYPE = "application/json"
+
+_log = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_FAILED = object()   # latched off: bad port / bind failure / forked child
+_server = None       # None | TelemetryServer | _FAILED
+_owner_pid = os.getpid()
+_t0 = time.monotonic()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "flink-ml-tpu-telemetry"
+
+    def log_message(self, fmt, *args):  # stdout silence: debug log only
+        _log.debug("telemetry: " + fmt, *args)
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — http.server's casing
+        path = self.path.split("?", 1)[0]
+        if path != "/" and path.endswith("/"):
+            path = path.rstrip("/")
+        try:
+            if path == "/metrics":
+                from flink_ml_tpu.observability.exporters import (
+                    prometheus_text,
+                )
+
+                self._send(200, prometheus_text(metrics.snapshot()),
+                           _PROM_CTYPE)
+            elif path == "/healthz":
+                self._send(200, json.dumps(
+                    {"status": "ok", "pid": os.getpid(),
+                     "uptime_s": round(time.monotonic() - _t0, 3),
+                     "tracing": tracing.tracer.enabled}), _JSON_CTYPE)
+            elif path == "/slo":
+                from flink_ml_tpu.observability import slo
+
+                verdicts = slo.evaluate_slos(slo.active_slos(),
+                                             emit=True)
+                self._send(200, json.dumps(
+                    {"source": "windowed", "verdicts": verdicts,
+                     "violated": [v["slo"] for v in verdicts
+                                  if not v["ok"]]},
+                    default=str), _JSON_CTYPE)
+            elif path == "/spans/recent":
+                # deque.append is thread-safe but ITERATION is not:
+                # serving threads ring spans concurrently, and a
+                # mid-iteration append raises RuntimeError — retry
+                spans = []
+                for _ in range(8):
+                    try:
+                        spans = list(tracing.tracer.recent)
+                        break
+                    except RuntimeError:
+                        continue
+                self._send(200, json.dumps({"spans": spans},
+                                           default=str), _JSON_CTYPE)
+            else:
+                self._send(404, json.dumps(
+                    {"error": f"no route {path!r}",
+                     "routes": list(ROUTES)}), _JSON_CTYPE)
+        except (BrokenPipeError, ConnectionError):
+            pass  # scraper went away mid-write: not our problem
+        except Exception as e:  # noqa: BLE001 — a route bug must never
+            # take the serving process down; report it to the scraper
+            _log.warning("telemetry route %s failed", path,
+                         exc_info=True)
+            try:
+                self._send(500, json.dumps({"error": repr(e)}),
+                           _JSON_CTYPE)
+            except OSError:
+                pass
+
+
+class TelemetryServer:
+    """The endpoint: a ThreadingHTTPServer on a daemon thread. Port 0
+    resolves to the bound ephemeral port."""
+
+    def __init__(self, port: int, host: Optional[str] = None):
+        if host is None:
+            host = os.environ.get(METRICS_HOST_ENV, "127.0.0.1")
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.daemon_threads = True
+        self.host, self.port = self.httpd.server_address[:2]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name="flink-ml-tpu-telemetry", daemon=True)
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def maybe_start(port: Optional[int] = None) -> Optional[TelemetryServer]:
+    """Start the endpoint once per driver process when armed; return it
+    (or None when unarmed/latched off). ``port=None`` reads
+    ``FLINK_ML_TPU_METRICS_PORT``; instrumented seams call this on
+    every entry, so the unarmed fast path is one dict lookup."""
+    global _server
+    if _server is not None:
+        return _server if isinstance(_server, TelemetryServer) else None
+    if port is None:
+        raw = os.environ.get(METRICS_PORT_ENV)
+        if not raw:
+            return None
+        try:
+            port = int(raw)
+        except ValueError:
+            _log.warning("invalid %s=%r: telemetry endpoint disabled",
+                         METRICS_PORT_ENV, raw)
+            with _lock:
+                if _server is None:
+                    _server = _FAILED
+            return None
+    if os.getpid() != _owner_pid:
+        return None  # forked child: driver-only by contract
+    with _lock:
+        if _server is None:
+            try:
+                srv = TelemetryServer(int(port))
+                srv.start()
+            except (OSError, OverflowError, ValueError) as e:
+                # OverflowError: port outside 0-65535; the seams call
+                # maybe_start unguarded, so ANY failure must latch the
+                # endpoint off instead of re-raising on every fit
+                _log.warning("telemetry endpoint failed to bind port "
+                             "%s: %s", port, e)
+                _server = _FAILED
+                return None
+            # request-scoped spans must exist for /spans/recent even
+            # when no trace dir is armed
+            tracing.tracer.keep_recent = True
+            _server = srv
+            _log.info("telemetry endpoint listening on %s:%d",
+                      srv.host, srv.port)
+    return _server if isinstance(_server, TelemetryServer) else None
+
+
+def stop() -> None:
+    """Shut the endpoint down and disarm the span ring (tests; also
+    un-latches a failed start so a new port can be tried)."""
+    global _server
+    with _lock:
+        srv, _server = _server, None
+    if isinstance(srv, TelemetryServer):
+        srv.stop()
+    tracing.tracer.keep_recent = False
+
+
+def reseed_child() -> None:
+    """Called in a freshly forked host-pool child: close the inherited
+    listener fd (the parent keeps serving on its own copy) and latch
+    this process's endpoint shut — children never listen."""
+    global _server, _owner_pid
+    _owner_pid = -1
+    srv, _server = _server, _FAILED
+    if isinstance(srv, TelemetryServer):
+        try:
+            srv.httpd.socket.close()
+        except OSError:
+            pass

@@ -222,6 +222,7 @@ def main(argv=None) -> int:
         pipe_guard,
         read_metrics,
         read_spans,
+        resolve_trace_dir,
     )
     from flink_ml_tpu.observability.meshstats import read_mesh
 
@@ -236,9 +237,13 @@ def main(argv=None) -> int:
     parser.add_argument("--check", action="store_true",
                         help="exit 2 unless the trace recorded a "
                              "multi-device mesh or per-shard series")
+    parser.add_argument("--latest", action="store_true",
+                        help="treat TRACE_DIR as a root and pick the "
+                             "newest trace dir under it")
     args = parser.parse_args(argv)
 
     try:
+        args.trace_dir = resolve_trace_dir(args.trace_dir, args.latest)
         spans = read_spans(args.trace_dir)
     except OSError as e:
         print(f"flink-ml-tpu-trace shards: cannot read "

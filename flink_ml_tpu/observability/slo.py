@@ -1,0 +1,516 @@
+"""SLO engine: declarative latency/error-rate objectives, multi-window
+burn-rate evaluation, and the ``flink-ml-tpu-trace slo`` gate.
+
+The serving seam (servable/api.py) records windowed latency histograms
+and error counters into ``ml.serving`` (common/metrics.py
+:class:`~flink_ml_tpu.common.metrics.WindowedHistogram` /
+:class:`~flink_ml_tpu.common.metrics.WindowedCounter`); this module
+turns them into verdicts:
+
+- an :class:`SLO` pairs a metric selector with ONE objective — a
+  latency quantile bound (``p99 of transformMs <= threshold_ms``) or a
+  max error ratio (``errors / (errors + transforms) <= max``) — over a
+  primary ``window_s``;
+- every SLO additionally evaluates **multi-window burn rates** (Google
+  SRE style): the fraction of the error budget being consumed, per
+  window — ``bad_fraction / budget`` where the budget is ``1 -
+  quantile`` for latency and ``max_error_ratio`` for errors. A short
+  window catches fast burns, a long one slow ones; each has its own
+  ``max_burn_rate``;
+- violations emit ``ml.slo`` instant events (tracing) and
+  ``slo_violations{slo=...}`` counters in the ``ml.slo`` registry
+  group, so the trace artifacts carry the verdict history.
+
+Specs load from JSON (any Python) or TOML (Python 3.11+, stdlib
+``tomllib``) — see docs/observability.md "Live telemetry & SLOs" for
+the format — or fall back to :func:`default_slos`. Evaluation sources:
+
+- **live** (the ``/slo`` endpoint, observability/server.py): sliding
+  windows straight from the process registry's windowed metrics;
+- **artifacts** (``flink-ml-tpu-trace slo <dir>``): the merged
+  ``metrics-*.json`` snapshots are cumulative, so every objective
+  evaluates the run-total distribution and is tagged
+  ``source: "cumulative"`` — the windowed half needs the live endpoint.
+
+CLI: ``flink-ml-tpu-trace slo <dir> [--spec F] [--check] [--json]
+[--latest]`` — with ``--check`` exits :data:`EXIT_VIOLATION` (4) on any
+violated SLO, :data:`EXIT_INVALID` (2) on broken artifacts or an
+unreadable spec; consistent with ``diff`` (docs/observability.md exit
+codes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from flink_ml_tpu.common.metrics import (
+    ML_GROUP,
+    WindowedHistogram,
+    histogram_quantile,
+    metrics,
+)
+from flink_ml_tpu.observability import tracing
+
+__all__ = [
+    "EXIT_OK",
+    "EXIT_INVALID",
+    "EXIT_VIOLATION",
+    "SLO_EVENT",
+    "SLO_SPEC_ENV",
+    "SLO",
+    "default_slos",
+    "active_slos",
+    "load_specs",
+    "evaluate_slos",
+    "render_verdicts",
+    "main",
+]
+
+EXIT_OK = 0
+EXIT_INVALID = 2
+#: the documented violation exit code — same class as ``diff --budget``
+EXIT_VIOLATION = 4
+
+#: instant-event name for SLO violations in the trace
+SLO_EVENT = "ml.slo"
+
+#: env var holding a spec file path; when set, the live ``/slo``
+#: endpoint evaluates it instead of :func:`default_slos`
+SLO_SPEC_ENV = "FLINK_ML_TPU_SLO_SPEC"
+
+#: default multi-window burn-rate gates: (window_s, max_burn_rate) —
+#: the SRE-handbook fast/slow pair scaled to a process-local horizon
+DEFAULT_BURN_WINDOWS = ((60.0, 14.4), (300.0, 6.0))
+
+_KINDS = ("latency", "error-rate")
+
+
+@dataclasses.dataclass
+class SLO:
+    """One declarative objective over a metric family. Fields unused by
+    the ``kind`` (e.g. ``threshold_ms`` for error-rate) are ignored."""
+
+    name: str
+    kind: str = "latency"            # "latency" | "error-rate"
+    group: str = f"{ML_GROUP}.serving"
+    histogram: str = "transformMs"   # latency source (ms histogram)
+    total: str = "transforms"        # error-rate denominator counter
+    errors: str = "errors"           # error-rate numerator counter
+    labels: Optional[Dict[str, str]] = None  # None → every series
+    quantile: float = 0.99
+    threshold_ms: float = 500.0
+    max_error_ratio: float = 0.01
+    window_s: float = 60.0
+    burn_windows: Tuple[Tuple[float, float], ...] = DEFAULT_BURN_WINDOWS
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"SLO {self.name!r}: unknown kind {self.kind!r} "
+                f"(expected one of {_KINDS})")
+        if not 0.0 < float(self.quantile) < 1.0:
+            raise ValueError(
+                f"SLO {self.name!r}: quantile must be in (0, 1)")
+        if float(self.window_s) <= 0:
+            raise ValueError(f"SLO {self.name!r}: window_s must be > 0")
+        self.burn_windows = tuple(
+            (float(w), float(m)) for w, m in self.burn_windows)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SLO":
+        if not isinstance(d, dict) or "name" not in d:
+            raise ValueError(f"SLO spec entry must be a mapping with a "
+                             f"'name', got {d!r}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"SLO {d.get('name')!r}: unknown spec "
+                             f"key(s) {sorted(unknown)}")
+        return cls(**d)
+
+    def to_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["burn_windows"] = [list(bw) for bw in self.burn_windows]
+        return out
+
+
+def default_slos() -> List[SLO]:
+    """The out-of-the-box serving SLOs: p99 transform latency and the
+    aggregate error ratio, each across every servable's series."""
+    return [SLO(name="serving-latency-p99", kind="latency"),
+            SLO(name="serving-error-rate", kind="error-rate")]
+
+
+def load_specs(path: str) -> List[SLO]:
+    """Parse an SLO spec file — JSON anywhere, TOML on Python 3.11+
+    (stdlib ``tomllib``; no new dependency). The document is a
+    ``{"slos": [...]}`` mapping (TOML: ``[[slos]]`` tables) or a bare
+    JSON list. Raises ValueError on malformed specs."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if path.endswith(".toml"):
+        try:
+            import tomllib
+        except ImportError as e:  # Python 3.10: no stdlib TOML parser
+            raise ValueError(
+                "TOML SLO specs need Python 3.11+ (tomllib); "
+                "use the JSON spelling instead") from e
+        try:
+            doc = tomllib.loads(raw.decode("utf-8"))
+        except tomllib.TOMLDecodeError as e:
+            raise ValueError(f"{path}: invalid TOML: {e}") from e
+    else:
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}: invalid JSON: {e}") from e
+    items = doc.get("slos") if isinstance(doc, dict) else doc
+    if not isinstance(items, list) or not items:
+        raise ValueError(f"{path}: expected a non-empty 'slos' list")
+    specs = [SLO.from_dict(d) for d in items]
+    names = [s.name for s in specs]
+    if len(set(names)) != len(names):
+        raise ValueError(f"{path}: duplicate SLO names in spec")
+    return specs
+
+
+def active_slos() -> List[SLO]:
+    """The SLOs the live endpoint evaluates: ``FLINK_ML_TPU_SLO_SPEC``
+    (a spec file path) when set, else :func:`default_slos`."""
+    path = os.environ.get(SLO_SPEC_ENV)
+    if path:
+        return load_specs(path)
+    return default_slos()
+
+
+# -- series matching / combination -------------------------------------------
+
+def _match_key(key: str, name: str,
+               labels: Optional[Dict[str, str]]) -> bool:
+    base, _, rest = key.partition("{")
+    if base != name:
+        return False
+    if not labels:
+        return True
+    from flink_ml_tpu.observability.health import _parse_labels
+
+    got = _parse_labels(rest[:-1] if rest else "")
+    return all(got.get(k) == str(v) for k, v in labels.items())
+
+
+def _combine(snaps: Sequence[dict]) -> dict:
+    """Sum matching labeled histogram series into one snapshot (they
+    must share a bucket layout — ``ml.serving transformMs`` does by
+    construction; drift raises, surfacing as broken artifacts)."""
+    buckets = tuple(float(b) for b in snaps[0].get("buckets", ()))
+    out = {"buckets": list(buckets), "counts": [0] * len(buckets),
+           "sum": 0.0, "count": 0}
+    for s in snaps:
+        if tuple(float(b) for b in s.get("buckets", ())) != buckets:
+            raise ValueError(
+                "mismatched bucket layouts across matching SLO series — "
+                "narrow the SLO with labels")
+        for i, c in enumerate(s.get("counts", ())):
+            out["counts"][i] += int(c)
+        out["sum"] += float(s.get("sum", 0.0))
+        out["count"] += int(s.get("count", 0))
+    return out
+
+
+def _fraction_le(snap: dict, bound: float) -> float:
+    """Fraction of observations <= ``bound`` (linear interpolation
+    inside the winning bucket, same rule as histogram_quantile);
+    observations past the last finite bucket count as above."""
+    total = int(snap.get("count", 0))
+    if total <= 0:
+        return 1.0
+    prev_b, prev_c = 0.0, 0
+    for b, c in zip(snap.get("buckets", ()), snap.get("counts", ())):
+        b = float(b)
+        if bound <= b:
+            if b <= prev_b:
+                return c / total
+            frac = (bound - prev_b) / (b - prev_b)
+            return (prev_c + (c - prev_c) * frac) / total
+        prev_b, prev_c = b, int(c)
+    return prev_c / total
+
+
+class _RegistrySource:
+    """Live evaluation: sliding windows from the process registry's
+    windowed metrics; plain series fall back to cumulative."""
+
+    def __init__(self, registry):
+        self._registry = registry
+
+    def hist_window(self, group: str, name: str,
+                    labels: Optional[Dict[str, str]], window_s: float):
+        grp = self._registry.group(*group.split("."))
+        keys = [k for k in grp.snapshot().get("histograms", {})
+                if _match_key(k, name, labels)]
+        snaps, sources = [], set()
+        for key in keys:
+            # a fully-rendered key passes through metric_key unchanged,
+            # so histogram(key) returns the existing registered object
+            h = grp.histogram(key)
+            if isinstance(h, WindowedHistogram):
+                snaps.append(h.window_snapshot(window_s))
+                sources.add("windowed")
+            else:
+                snaps.append(h.snapshot())
+                sources.add("cumulative")
+        if not snaps:
+            return None, "windowed"
+        return _combine(snaps), ("windowed" if sources == {"windowed"}
+                                 else "cumulative")
+
+    def counter_window(self, group: str, name: str,
+                       labels: Optional[Dict[str, str]],
+                       window_s: float):
+        grp = self._registry.group(*group.split("."))
+        wcs = [wc for key, wc in grp.windowed_counter_items()
+               if _match_key(key, name, labels)]
+        if wcs:
+            return (sum(wc.window_delta(window_s) for wc in wcs),
+                    "windowed")
+        counters = grp.snapshot().get("counters", {})
+        vals = [int(v) for k, v in counters.items()
+                if _match_key(k, name, labels)]
+        if vals:
+            return sum(vals), "cumulative"
+        return 0, "none"
+
+
+class _SnapshotSource:
+    """Artifact evaluation: a merged registry snapshot is cumulative —
+    window sizes are ignored and every value is tagged accordingly."""
+
+    def __init__(self, snapshot: Dict[str, dict]):
+        self._snap = snapshot or {}
+
+    def hist_window(self, group, name, labels, window_s):
+        hists = (self._snap.get(group) or {}).get("histograms", {})
+        snaps = [h for k, h in hists.items()
+                 if _match_key(k, name, labels)]
+        if not snaps:
+            return None, "cumulative"
+        return _combine(snaps), "cumulative"
+
+    def counter_window(self, group, name, labels, window_s):
+        counters = (self._snap.get(group) or {}).get("counters", {})
+        vals = [int(v) for k, v in counters.items()
+                if _match_key(k, name, labels)]
+        if vals:
+            return sum(vals), "cumulative"
+        return 0, "none"
+
+
+# -- evaluation ---------------------------------------------------------------
+
+def _eval_latency(slo: SLO, source) -> List[dict]:
+    objectives = []
+    snap, src = source.hist_window(slo.group, slo.histogram, slo.labels,
+                                   slo.window_s)
+    n = int(snap["count"]) if snap else 0
+    value = histogram_quantile(snap, slo.quantile) if snap else \
+        float("nan")
+    ok = not (n > 0 and value > slo.threshold_ms)
+    objectives.append({
+        "objective": "latency-quantile", "window_s": slo.window_s,
+        "quantile": slo.quantile,
+        "value_ms": None if math.isnan(value) else round(value, 3),
+        "threshold_ms": slo.threshold_ms, "samples": n, "ok": ok,
+        "source": src})
+    budget = max(1.0 - slo.quantile, 1e-9)
+    for window_s, max_burn in slo.burn_windows:
+        snap, src = source.hist_window(slo.group, slo.histogram,
+                                       slo.labels, window_s)
+        n = int(snap["count"]) if snap else 0
+        bad = (1.0 - _fraction_le(snap, slo.threshold_ms)) if n else 0.0
+        burn = bad / budget
+        objectives.append({
+            "objective": "latency-burn", "window_s": window_s,
+            "bad_fraction": round(bad, 6),
+            "budget_fraction": round(budget, 6),
+            "burn_rate": round(burn, 3), "max_burn_rate": max_burn,
+            "samples": n, "ok": n == 0 or burn <= max_burn,
+            "source": src})
+    return objectives
+
+
+def _eval_error_rate(slo: SLO, source) -> List[dict]:
+    objectives = []
+    windows = [(slo.window_s, None)] + list(slo.burn_windows)
+    for window_s, max_burn in windows:
+        errors, esrc = source.counter_window(slo.group, slo.errors,
+                                             slo.labels, window_s)
+        total, tsrc = source.counter_window(slo.group, slo.total,
+                                            slo.labels, window_s)
+        requests = int(errors) + int(total)
+        ratio = (errors / requests) if requests else 0.0
+        src = ("windowed" if {esrc, tsrc} <= {"windowed", "none"}
+               else "cumulative")
+        if max_burn is None:  # the primary objective
+            objectives.append({
+                "objective": "error-ratio", "window_s": window_s,
+                "errors": int(errors), "requests": requests,
+                "value": round(ratio, 6),
+                "max_error_ratio": slo.max_error_ratio,
+                "ok": requests == 0 or ratio <= slo.max_error_ratio,
+                "source": src})
+        else:
+            budget = max(slo.max_error_ratio, 1e-9)
+            burn = ratio / budget
+            objectives.append({
+                "objective": "error-burn", "window_s": window_s,
+                "bad_fraction": round(ratio, 6),
+                "budget_fraction": round(budget, 6),
+                "burn_rate": round(burn, 3), "max_burn_rate": max_burn,
+                "samples": requests,
+                "ok": requests == 0 or burn <= max_burn,
+                "source": src})
+    return objectives
+
+
+def evaluate_slos(slos: Optional[Sequence[SLO]] = None, registry=None,
+                  snapshot: Optional[Dict[str, dict]] = None,
+                  emit: bool = False) -> List[dict]:
+    """Evaluate ``slos`` (default: :func:`active_slos`) against either a
+    live ``registry`` (default: the process registry — sliding windows)
+    or an artifact ``snapshot`` (cumulative). With ``emit``, every
+    violated SLO lands an ``ml.slo`` trace event plus a
+    ``slo_violations{slo=...}`` counter in the ``ml.slo`` group of the
+    process registry. Returns one verdict dict per SLO."""
+    if slos is None:
+        slos = active_slos()
+    if snapshot is not None:
+        source = _SnapshotSource(snapshot)
+    else:
+        source = _RegistrySource(metrics if registry is None
+                                 else registry)
+    verdicts = []
+    for slo in slos:
+        objectives = (_eval_latency(slo, source)
+                      if slo.kind == "latency"
+                      else _eval_error_rate(slo, source))
+        ok = all(o["ok"] for o in objectives)
+        verdicts.append({"slo": slo.name, "kind": slo.kind, "ok": ok,
+                         "objectives": objectives})
+        if emit and not ok:
+            failing = [o["objective"] for o in objectives
+                       if not o["ok"]]
+            metrics.group(ML_GROUP, "slo").counter(
+                "slo_violations", labels={"slo": slo.name})
+            tracing.tracer.event(SLO_EVENT, slo=slo.name, ok=False,
+                                 failing=",".join(failing))
+    return verdicts
+
+
+# -- rendering / CLI ----------------------------------------------------------
+
+def render_verdicts(verdicts: List[dict]) -> str:
+    bad = sum(1 for v in verdicts if not v["ok"])
+    out = [f"{len(verdicts)} SLO(s), {bad} violated"]
+    for v in verdicts:
+        out.append("")
+        out.append(f"SLO {v['slo']} ({v['kind']})  "
+                   f"[{'ok' if v['ok'] else 'VIOLATED'}]")
+        for o in v["objectives"]:
+            window = f"window {o['window_s']:g}s ({o['source']})"
+            if o["objective"] == "latency-quantile":
+                val = "-" if o["value_ms"] is None else \
+                    f"{o['value_ms']:g} ms"
+                detail = (f"p{o['quantile'] * 100:g} {val} "
+                          f"(<= {o['threshold_ms']:g} ms, "
+                          f"{o['samples']} sample(s))")
+            elif o["objective"] == "error-ratio":
+                detail = (f"ratio {o['value']:g} "
+                          f"(<= {o['max_error_ratio']:g}, "
+                          f"{o['errors']}/{o['requests']} request(s))")
+            else:
+                detail = (f"burn {o['burn_rate']:g}x "
+                          f"(max {o['max_burn_rate']:g}x, bad "
+                          f"{o['bad_fraction']:g} of budget "
+                          f"{o['budget_fraction']:g})")
+            flag = "ok" if o["ok"] else "VIOLATED"
+            out.append(f"  {o['objective']:<17} {window:<26} {detail}"
+                       f"  [{flag}]")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    """``flink-ml-tpu-trace slo <dir>`` — evaluate SLOs against the
+    metrics artifacts of a trace dir (cumulative; the windowed view
+    lives on the ``/slo`` endpoint of a running process). ``--check``
+    exits 4 on any violated SLO, 2 on broken artifacts/spec."""
+    import argparse
+    import sys
+
+    from flink_ml_tpu.observability.exporters import (
+        pipe_guard,
+        read_metrics,
+        resolve_trace_dir,
+    )
+
+    parser = argparse.ArgumentParser(
+        prog="flink-ml-tpu-trace slo",
+        description="SLO verdicts from a FLINK_ML_TPU_TRACE_DIR's "
+                    "metrics artifacts (latency quantiles, error "
+                    "ratios, burn rates).")
+    parser.add_argument("trace_dir")
+    parser.add_argument("--spec", metavar="FILE",
+                        help="SLO spec file (JSON, or TOML on Python "
+                             "3.11+); default: the built-in serving "
+                             "SLOs")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 4 when any SLO is violated, 2 on "
+                             "broken artifacts")
+    parser.add_argument("--latest", action="store_true",
+                        help="treat TRACE_DIR as a root and pick the "
+                             "newest trace dir under it")
+    args = parser.parse_args(argv)
+
+    try:
+        trace_dir = resolve_trace_dir(args.trace_dir, args.latest)
+        snapshot = read_metrics(trace_dir)
+    except OSError as e:
+        print(f"flink-ml-tpu-trace slo: cannot read {args.trace_dir}: "
+              f"{e}", file=sys.stderr)
+        return EXIT_INVALID
+    if not snapshot:
+        print(f"flink-ml-tpu-trace slo: no metrics-*.json artifacts in "
+              f"{trace_dir}", file=sys.stderr)
+        return EXIT_INVALID
+    try:
+        slos = load_specs(args.spec) if args.spec else default_slos()
+        verdicts = evaluate_slos(slos, snapshot=snapshot)
+    except (OSError, ValueError) as e:
+        print(f"flink-ml-tpu-trace slo: {e}", file=sys.stderr)
+        return EXIT_INVALID
+
+    with pipe_guard():
+        if args.json:
+            print(json.dumps({"trace_dir": trace_dir,
+                              "source": "cumulative",
+                              "verdicts": verdicts}, indent=2,
+                             default=str))
+        else:
+            print(render_verdicts(verdicts))
+    violated = [v["slo"] for v in verdicts if not v["ok"]]
+    if args.check and violated:
+        print(f"flink-ml-tpu-trace slo: {len(violated)} violated "
+              f"SLO(s): {', '.join(violated)}", file=sys.stderr)
+        return EXIT_VIOLATION
+    return EXIT_OK
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

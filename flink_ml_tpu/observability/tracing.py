@@ -29,6 +29,7 @@ tracer captures the host-side structure around them.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import json
 import os
@@ -39,6 +40,11 @@ from typing import Dict, List, Optional
 #: env var holding a directory; when set, instrumented seams emit spans
 #: as ``spans-<pid>.jsonl`` files there (docs/observability.md)
 TRACE_DIR_ENV = "FLINK_ML_TPU_TRACE_DIR"
+
+#: closed spans kept in memory for the live ``/spans/recent`` endpoint
+#: (observability/server.py) — populated only while ``keep_recent`` is
+#: armed, so the ring costs nothing in untelemetered processes
+RECENT_SPANS = 256
 
 _id_counter = itertools.count(1)
 _id_lock = threading.Lock()
@@ -144,6 +150,10 @@ class Tracer:
         self._sink_lock = threading.Lock()
         # a frozen (trace_id, span_id) parent inherited across fork
         self._remote_parent = None
+        # the live-endpoint ring: recently closed span records, armed by
+        # observability/server.py (spans then exist even without a dir)
+        self.keep_recent = False
+        self.recent = collections.deque(maxlen=RECENT_SPANS)
 
     # -- arming --------------------------------------------------------------
     @property
@@ -153,6 +163,13 @@ class Tracer:
     @property
     def enabled(self) -> bool:
         return bool(self.trace_dir)
+
+    @property
+    def active(self) -> bool:
+        """Spans are being recorded somewhere: to the trace dir
+        (``enabled``) and/or to the in-memory recent ring for the live
+        telemetry endpoint (``keep_recent``)."""
+        return self.enabled or self.keep_recent
 
     def configure(self, trace_dir: Optional[str]) -> None:
         """Programmatic arming (tests, embedding); ``None`` reverts to
@@ -192,7 +209,7 @@ class Tracer:
     def span(self, name: str, **attrs):
         """Open a span under the current one (or as a new trace root).
         Use as a context manager; yields the :class:`Span`."""
-        if not self.enabled:
+        if not self.active:
             return _NOOP
         stack = self._stack()
         if stack:
@@ -211,7 +228,7 @@ class Tracer:
         open, emit a standalone zero-duration span carrying it — the
         event must reach the trace either way (a supervisor restart
         outside any fit still matters)."""
-        if not self.enabled:
+        if not self.active:
             return
         cur = self.current()
         if cur is not None:
@@ -230,7 +247,10 @@ class Tracer:
                 stack.remove(sp)
             except ValueError:
                 pass
-        self._write(sp)
+        record = sp.to_record(os.getpid(), threading.get_ident())
+        if self.keep_recent:
+            self.recent.append(record)  # deque.append is thread-safe
+        self._write(record)
 
     # -- sink ----------------------------------------------------------------
     def span_file(self) -> Optional[str]:
@@ -239,11 +259,10 @@ class Tracer:
             return None
         return os.path.join(d, f"spans-{os.getpid()}.jsonl")
 
-    def _write(self, sp: Span) -> None:
+    def _write(self, record: dict) -> None:
         path = self.span_file()
         if path is None:
             return
-        record = sp.to_record(os.getpid(), threading.get_ident())
         line = json.dumps(record, default=str) + "\n"
         with self._sink_lock:
             if self._sink is not None and self._sink_pid != os.getpid():
@@ -281,6 +300,10 @@ class Tracer:
         self._sink_pid = None
         self._sink_path = None
         self._sink_lock = threading.Lock()
+        # the live endpoint is driver-only (observability/server.py):
+        # a forked child neither serves nor rings
+        self.keep_recent = False
+        self.recent = collections.deque(maxlen=RECENT_SPANS)
 
 
 #: default process-wide tracer

@@ -152,18 +152,67 @@ class DataFrame:
 
 
 def _served(method):
-    """Wrap a servable ``transform`` with serving-path model metrics
-    (observability/health.py): transform latency + row-count histograms
-    and a prediction-distribution summary (min/max/mean/finite-fraction)
-    labeled by servable class — the ``MLMetrics`` role of the
-    reference's servable core, and this repo's drift baseline. Recording
-    failures are logged, never raised: telemetry must not sink a serving
-    call."""
+    """Wrap a servable ``transform`` with the live serving telemetry
+    (observability/health.py; docs/observability.md "Live telemetry &
+    SLOs"): windowed latency + row-count histograms and a
+    prediction-distribution summary labeled by servable class — the
+    ``MLMetrics`` role of the reference's servable core, and this
+    repo's drift baseline — plus an in-flight gauge, per-exception-class
+    error counters (the error-rate SLO input; the exception re-raises
+    after being counted), a request-scoped span sampled at
+    ``FLINK_ML_TPU_TRACE_SAMPLE``, and a best-effort start of the
+    embedded metrics endpoint (``FLINK_ML_TPU_METRICS_PORT``).
+    Telemetry failures are logged, never raised: recording must not
+    sink a serving call."""
 
     @functools.wraps(method)
     def wrapper(self, df: DataFrame) -> DataFrame:
+        servable = type(self).__name__
+        log = logging.getLogger(__name__)
+        span_cm, entered = None, False
+        try:
+            from flink_ml_tpu.observability import health, server, tracing
+
+            server.maybe_start()
+            health.serving_inflight(servable, +1)
+            entered = True
+            if tracing.tracer.active and health.trace_sampled():
+                rows_in = df.num_rows() if isinstance(df, DataFrame) \
+                    else 0
+                span_cm = tracing.tracer.span(
+                    "serving.request", servable=servable,
+                    rows_in=rows_in)
+        except Exception:  # noqa: BLE001 — see docstring
+            span_cm = None
+            log.warning("serving telemetry setup failed", exc_info=True)
         start = time.perf_counter()
-        out = method(self, df)
+        try:
+            if span_cm is not None:
+                with span_cm:
+                    out = method(self, df)
+            else:
+                out = method(self, df)
+        except Exception as e:
+            elapsed_ms = (time.perf_counter() - start) * 1000.0
+            try:
+                from flink_ml_tpu.observability import health
+
+                health.observe_serving_error(servable,
+                                             type(e).__name__,
+                                             elapsed_ms)
+            except Exception:  # noqa: BLE001 — see docstring
+                log.warning("serving error recording failed",
+                            exc_info=True)
+            raise
+        finally:
+            if entered:
+                try:
+                    from flink_ml_tpu.observability import health
+
+                    health.serving_inflight(servable, -1)
+                except Exception:  # noqa: BLE001 — see docstring
+                    log.warning("serving in-flight recording failed",
+                                exc_info=True)
         elapsed_ms = (time.perf_counter() - start) * 1000.0
         try:
             from flink_ml_tpu.observability import health
@@ -175,7 +224,7 @@ def _served(method):
                 col = getattr(self, "prediction_col", None)
                 if col and col in out.column_names:
                     predictions = out.get(col).values
-            health.observe_serving(type(self).__name__, rows, elapsed_ms,
+            health.observe_serving(servable, rows, elapsed_ms,
                                    predictions=predictions)
         except Exception:  # noqa: BLE001 — see docstring
             logging.getLogger(__name__).warning(
