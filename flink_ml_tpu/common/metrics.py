@@ -48,6 +48,13 @@ VERSION_GAUGE = "version"
 DEFAULT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
                    1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0)
 
+#: fraction-shaped bounds (0..1] for ratio histograms — batch fill and
+#: padding waste in the serving micro-batcher (serving/batcher.py),
+#: where the latency-shaped defaults would collapse every observation
+#: into the first bucket
+RATIO_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
+                 0.95, 1.0)
+
 
 def _escape_label(value) -> str:
     """Prometheus label-value escaping: backslash, double-quote, newline."""

@@ -69,8 +69,11 @@ MANIFEST_VERSION = 2
 
 
 class CorruptCheckpoint(Exception):
-    """Internal: a checkpoint directory failed integrity validation.
-    Never escapes ``restore()`` — it routes to quarantine + fallback."""
+    """A checkpoint directory failed integrity validation. Never escapes
+    ``restore()`` — it routes to quarantine + fallback. Public for the
+    serving model registry (serving/registry.py), which validates
+    candidate model data through :func:`load_validated` and must treat
+    this as "reject the candidate", never "crash the server"."""
 
 
 def _leaf_digest(arr: np.ndarray) -> Optional[str]:
@@ -91,6 +94,117 @@ def _fsync_path(path: str) -> None:
         # digests still catch a torn write on restore
     finally:
         os.close(fd)
+
+
+def load_validated(ckpt_dir: str, expected_leaves: Optional[int] = None
+                   ) -> Tuple[List[np.ndarray], int]:
+    """(host leaves, epoch) of one checkpoint directory, validated
+    against its v2 manifest (per-leaf sha256/dtype/shape); raises
+    :class:`CorruptCheckpoint` describing what failed — and ONLY that:
+    any unexpected exception during validation (a manifest mangled into
+    the wrong JSON shape raises KeyError/AttributeError, not json
+    errors) is itself corruption evidence and is re-raised as
+    CorruptCheckpoint, so every caller's reject/quarantine path fires.
+    The shared integrity seam: :meth:`CheckpointManager.restore` uses
+    it for resume, and the serving model registry (serving/registry.py)
+    uses it to vet candidate model data before a hot-swap — a
+    bit-flipped snapshot must never become the serving model.
+    ``expected_leaves`` is optional there: the registry learns the leaf
+    count from the manifest itself."""
+    try:
+        return _validate_checkpoint(ckpt_dir, expected_leaves)
+    except CorruptCheckpoint:
+        raise
+    except Exception as e:  # noqa: BLE001 — see docstring
+        raise CorruptCheckpoint(
+            f"validation failed: {type(e).__name__}: {e}") from e
+
+
+def _validate_checkpoint(ckpt_dir: str, expected_leaves: Optional[int]
+                         ) -> Tuple[List[np.ndarray], int]:
+    try:
+        with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CorruptCheckpoint(f"manifest unreadable: {e}") from e
+    num = manifest.get("num_leaves")
+    if not isinstance(num, int):
+        raise CorruptCheckpoint("manifest lacks num_leaves")
+    if expected_leaves is not None and num != expected_leaves:
+        # an incompatible snapshot takes the same fallback path as a
+        # failed digest; quarantine renames, never deletes — if EVERY
+        # checkpoint trips this, the template (not the data) changed,
+        # and the dirs can be renamed back by hand
+        raise CorruptCheckpoint(
+            f"checkpoint has {num} leaves, template has "
+            f"{expected_leaves} (a mismatch on every checkpoint "
+            "means the template/config changed, not the data)")
+    records = manifest.get("leaves")
+    try:
+        with np.load(os.path.join(ckpt_dir, "leaves.npz")) as z:
+            host_leaves = [z[f"leaf_{i}"] for i in range(num)]
+    except Exception as e:  # noqa: BLE001 — BadZipFile, KeyError,
+        # OSError, truncated-stream ValueError: all mean "unreadable"
+        raise CorruptCheckpoint(f"leaves unreadable: {e}") from e
+    if records is not None:  # version >= 2: verify integrity records
+        if len(records) != num:
+            raise CorruptCheckpoint("manifest leaf records truncated")
+        for i, (arr, rec) in enumerate(zip(host_leaves, records)):
+            if (rec.get("dtype") is not None
+                    and str(arr.dtype) != rec["dtype"]):
+                raise CorruptCheckpoint(
+                    f"leaf_{i} dtype {arr.dtype} != manifest "
+                    f"{rec['dtype']}")
+            if (rec.get("shape") is not None
+                    and list(arr.shape) != list(rec["shape"])):
+                raise CorruptCheckpoint(
+                    f"leaf_{i} shape {list(arr.shape)} != manifest "
+                    f"{rec['shape']}")
+            want = rec.get("sha256")
+            if want is not None and _leaf_digest(arr) != want:
+                raise CorruptCheckpoint(f"leaf_{i} sha256 mismatch")
+    return host_leaves, manifest["epoch"]
+
+
+def list_checkpoint_names(base_dir: str) -> List[str]:
+    """Sorted ``ckpt-<number>`` directory names under ``base_dir``
+    (empty when the directory is missing/unreadable) — THE naming
+    scheme, shared by :meth:`CheckpointManager.list_checkpoints` and
+    the serving registry's watcher so a future rename cannot split
+    them."""
+    try:
+        names = os.listdir(base_dir)
+    except OSError:
+        return []
+    return sorted(d for d in names
+                  if d.startswith("ckpt-") and d[len("ckpt-"):].isdigit())
+
+
+def quarantine_checkpoint(ckpt_dir: str, reason: str) -> str:
+    """Rename a corrupt checkpoint directory to ``*.corrupt`` (never
+    delete — forensic evidence), record the ``quarantined`` counter and
+    the ``checkpoint.quarantine`` trace event; returns the quarantine
+    path (or ``"<removed>"`` when the rename itself failed). Shared by
+    restore-fallback and the serving registry's candidate vetting."""
+    target = ckpt_dir + ".corrupt"
+    n = 0
+    while os.path.exists(target):
+        n += 1
+        target = f"{ckpt_dir}.corrupt{n}"
+    try:
+        os.rename(ckpt_dir, target)
+    except OSError:  # already gone / unrenameable: drop it instead
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        target = "<removed>"
+    logger.warning("corrupt checkpoint %s quarantined as %s (%s)",
+                   ckpt_dir, target, reason)
+    from flink_ml_tpu.observability import tracing
+
+    _ckpt_group().counter("quarantined")
+    tracing.tracer.event("checkpoint.quarantine",
+                         checkpoint=os.path.basename(ckpt_dir),
+                         reason=reason)
+    return target
 
 
 class CheckpointManager:
@@ -183,29 +297,10 @@ class CheckpointManager:
 
     # -- read ----------------------------------------------------------------
     def list_checkpoints(self):
-        return sorted(d for d in os.listdir(self.base_dir)
-                      if d.startswith("ckpt-") and d[len("ckpt-"):].isdigit())
+        return list_checkpoint_names(self.base_dir)
 
     def _quarantine(self, ckpt_dir: str, reason: str) -> None:
-        target = ckpt_dir + ".corrupt"
-        n = 0
-        while os.path.exists(target):
-            n += 1
-            target = f"{ckpt_dir}.corrupt{n}"
-        try:
-            os.rename(ckpt_dir, target)
-        except OSError:  # already gone / unrenameable: drop it instead
-            shutil.rmtree(ckpt_dir, ignore_errors=True)
-            target = "<removed>"
-        logger.warning(
-            "corrupt checkpoint %s quarantined as %s (%s); falling back "
-            "to the next-older checkpoint", ckpt_dir, target, reason)
-        from flink_ml_tpu.observability import tracing
-
-        _ckpt_group().counter("quarantined")
-        tracing.tracer.event("checkpoint.quarantine",
-                             checkpoint=os.path.basename(ckpt_dir),
-                             reason=reason)
+        quarantine_checkpoint(ckpt_dir, reason)
 
     def _load_validated(self, ckpt_dir: str, expected_leaves: int
                         ) -> Tuple[List[np.ndarray], int]:
@@ -225,48 +320,7 @@ class CheckpointManager:
 
     def _validate(self, ckpt_dir: str, expected_leaves: int
                   ) -> Tuple[List[np.ndarray], int]:
-        try:
-            with open(os.path.join(ckpt_dir, "manifest.json")) as f:
-                manifest = json.load(f)
-        except (OSError, json.JSONDecodeError) as e:
-            raise CorruptCheckpoint(f"manifest unreadable: {e}") from e
-        num = manifest.get("num_leaves")
-        if not isinstance(num, int):
-            raise CorruptCheckpoint("manifest lacks num_leaves")
-        if num != expected_leaves:
-            # an incompatible snapshot takes the same fallback path as a
-            # failed digest; quarantine renames, never deletes — if EVERY
-            # checkpoint trips this, the template (not the data) changed,
-            # and the dirs can be renamed back by hand
-            raise CorruptCheckpoint(
-                f"checkpoint has {num} leaves, template has "
-                f"{expected_leaves} (a mismatch on every checkpoint "
-                "means the template/config changed, not the data)")
-        records = manifest.get("leaves")
-        try:
-            with np.load(os.path.join(ckpt_dir, "leaves.npz")) as z:
-                host_leaves = [z[f"leaf_{i}"] for i in range(num)]
-        except Exception as e:  # noqa: BLE001 — BadZipFile, KeyError,
-            # OSError, truncated-stream ValueError: all mean "unreadable"
-            raise CorruptCheckpoint(f"leaves unreadable: {e}") from e
-        if records is not None:  # version >= 2: verify integrity records
-            if len(records) != num:
-                raise CorruptCheckpoint("manifest leaf records truncated")
-            for i, (arr, rec) in enumerate(zip(host_leaves, records)):
-                if (rec.get("dtype") is not None
-                        and str(arr.dtype) != rec["dtype"]):
-                    raise CorruptCheckpoint(
-                        f"leaf_{i} dtype {arr.dtype} != manifest "
-                        f"{rec['dtype']}")
-                if (rec.get("shape") is not None
-                        and list(arr.shape) != list(rec["shape"])):
-                    raise CorruptCheckpoint(
-                        f"leaf_{i} shape {list(arr.shape)} != manifest "
-                        f"{rec['shape']}")
-                want = rec.get("sha256")
-                if want is not None and _leaf_digest(arr) != want:
-                    raise CorruptCheckpoint(f"leaf_{i} sha256 mismatch")
-        return host_leaves, manifest["epoch"]
+        return load_validated(ckpt_dir, expected_leaves)
 
     def restore(self, template_carry: Any) -> Optional[Tuple[Any, int]]:
         """Newest checkpoint that passes integrity validation, restored

@@ -83,6 +83,7 @@ __all__ = [
     "SAMPLE_ENV",
     "observe_serving",
     "observe_serving_error",
+    "observe_serving_rejected",
     "serving_inflight",
     "summarize_values",
     "trace_sample_rate",
@@ -501,6 +502,17 @@ def observe_serving_error(servable: str, exception: str,
     group.counter("errorsByClass",
                   labels={"servable": servable, "exception": exception})
     group.histogram("errorMs", labels=labels).observe(latency_ms)
+
+
+def observe_serving_rejected(servable: str, reason: str) -> None:
+    """Record one request shed by admission control (deadline expired
+    in queue, queue full, shape outside the bucket table — serving/
+    batcher.py) as the windowed ``rejected{servable=,reason=}`` counter.
+    Kept apart from ``errors``: shed load is the server *protecting* its
+    SLO, and a loadgen verdict must be able to tell the two apart."""
+    metrics.group(ML_GROUP, "serving").windowed_counter(
+        "rejected", horizon_s=SERVING_HORIZON_S, slices=SERVING_SLICES,
+        labels={"servable": servable, "reason": reason}).inc()
 
 
 def summarize_values(servable: str, name: str, values) -> None:

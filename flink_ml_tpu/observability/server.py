@@ -11,7 +11,12 @@ instrumented seam that runs (api/stage.py fit/transform, the servable
 - ``/metrics`` — the process registry in Prometheus text exposition
   (observability/exporters.py), cumulative histograms included, so any
   scraper computes its own windows;
-- ``/healthz`` — liveness JSON (status, pid, uptime);
+- ``/healthz`` — liveness + readiness JSON (status, pid, uptime): 200
+  while every registered readiness gate is ready, 503 with per-gate
+  reasons otherwise (serving warmup registers one, serving/warmup.py);
+- ``/serving`` — the serving runtime's live status (queue depth, bucket
+  table, active model version) when a runtime registered a provider
+  (serving/batcher.py), ``{"serving": null}`` otherwise;
 - ``/slo`` — live SLO verdicts (observability/slo.py) over the
   registry's *windowed* metrics; violations emit their events/counters
   on every evaluation, so scraping doubles as the burn-rate alerter;
@@ -43,7 +48,9 @@ from flink_ml_tpu.common.metrics import metrics
 from flink_ml_tpu.observability import tracing
 
 __all__ = ["METRICS_PORT_ENV", "METRICS_HOST_ENV", "TelemetryServer",
-           "maybe_start", "stop", "reseed_child"]
+           "maybe_start", "stop", "reseed_child", "set_gate",
+           "clear_gate", "readiness", "set_serving_status",
+           "get_serving_status", "clear_serving_status"]
 
 #: env var holding the port to serve on; unset → no endpoint, ``0`` →
 #: an ephemeral port (tests, the serve smoke)
@@ -51,7 +58,7 @@ METRICS_PORT_ENV = "FLINK_ML_TPU_METRICS_PORT"
 #: bind address (default loopback — a sidecar scraper; widen explicitly)
 METRICS_HOST_ENV = "FLINK_ML_TPU_METRICS_HOST"
 
-ROUTES = ("/metrics", "/healthz", "/slo", "/spans/recent")
+ROUTES = ("/metrics", "/healthz", "/slo", "/serving", "/spans/recent")
 
 _PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
 _JSON_CTYPE = "application/json"
@@ -63,6 +70,66 @@ _FAILED = object()   # latched off: bad port / bind failure / forked child
 _server = None       # None | TelemetryServer | _FAILED
 _owner_pid = os.getpid()
 _t0 = time.monotonic()
+
+# -- readiness gates (liveness vs readiness split) ----------------------------
+# ``/healthz`` stays the liveness probe (the process answers); readiness
+# is gated: a registered gate that is not yet ready flips /healthz to
+# 503 with a JSON reason — how serving warmup (serving/warmup.py) keeps
+# a load balancer from routing traffic at a cold compile cache. With no
+# gates registered (every plain fit/serve process) /healthz is 200, as
+# before.
+_gates: dict = {}
+_gates_lock = threading.Lock()
+
+# ``/serving`` status provider: the serving runtime (serving/batcher.py)
+# registers a zero-arg callable returning its live status dict (queue
+# depth, bucket table, active model version); None → route answers with
+# ``{"serving": null}``.
+_serving_status = None
+
+
+def set_gate(name: str, ready: bool, reason: str = "") -> None:
+    """Register/update a readiness gate. ``/healthz`` reports 503 until
+    every registered gate is ready."""
+    with _gates_lock:
+        _gates[name] = (bool(ready), str(reason))
+
+
+def clear_gate(name: str) -> None:
+    with _gates_lock:
+        _gates.pop(name, None)
+
+
+def readiness() -> tuple:
+    """(ready, {gate: reason}) — the unready gates and their reasons."""
+    with _gates_lock:
+        blocked = {n: reason for n, (ok, reason) in _gates.items()
+                   if not ok}
+    return (not blocked, blocked)
+
+
+def set_serving_status(provider) -> None:
+    """Register the ``/serving`` route's status provider (a zero-arg
+    callable returning a JSON-serializable dict), or None to unregister."""
+    global _serving_status
+    _serving_status = provider
+
+
+def get_serving_status():
+    """The currently registered ``/serving`` provider (or None) — a
+    runtime snapshots it at start so its stop can restore it."""
+    return _serving_status
+
+
+def clear_serving_status(provider=None, restore=None) -> None:
+    """Unregister the ``/serving`` provider — with ``provider`` given,
+    only if it is still the registered one (a runtime stopping must not
+    clobber a later runtime's registration), re-installing ``restore``
+    (the provider that was registered when ``provider`` took over, so a
+    short-lived runtime hands the route back)."""
+    global _serving_status
+    if provider is None or _serving_status == provider:
+        _serving_status = restore
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -92,10 +159,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, prometheus_text(metrics.snapshot()),
                            _PROM_CTYPE)
             elif path == "/healthz":
-                self._send(200, json.dumps(
-                    {"status": "ok", "pid": os.getpid(),
-                     "uptime_s": round(time.monotonic() - _t0, 3),
-                     "tracing": tracing.tracer.enabled}), _JSON_CTYPE)
+                ready, blocked = readiness()
+                body = {"status": "ok" if ready else "unready",
+                        "pid": os.getpid(),
+                        "uptime_s": round(time.monotonic() - _t0, 3),
+                        "tracing": tracing.tracer.enabled}
+                if not ready:
+                    # 503: the readiness half of the probe — alive but
+                    # not yet fit to take traffic (e.g. serving warmup
+                    # still compiling bucket shapes)
+                    body["reasons"] = blocked
+                self._send(200 if ready else 503, json.dumps(body),
+                           _JSON_CTYPE)
             elif path == "/slo":
                 from flink_ml_tpu.observability import slo
 
@@ -106,6 +181,11 @@ class _Handler(BaseHTTPRequestHandler):
                      "violated": [v["slo"] for v in verdicts
                                   if not v["ok"]]},
                     default=str), _JSON_CTYPE)
+            elif path == "/serving":
+                provider = _serving_status
+                status = provider() if provider is not None else None
+                self._send(200, json.dumps({"serving": status},
+                                           default=str), _JSON_CTYPE)
             elif path == "/spans/recent":
                 # deque.append is thread-safe but ITERATION is not:
                 # serving threads ring spans concurrently, and a
@@ -205,13 +285,18 @@ def maybe_start(port: Optional[int] = None) -> Optional[TelemetryServer]:
 
 def stop() -> None:
     """Shut the endpoint down and disarm the span ring (tests; also
-    un-latches a failed start so a new port can be tried)."""
-    global _server
+    un-latches a failed start so a new port can be tried). Readiness
+    gates and the /serving provider reset too — they belong to the
+    runtime that registered them, which is gone."""
+    global _server, _serving_status
     with _lock:
         srv, _server = _server, None
     if isinstance(srv, TelemetryServer):
         srv.stop()
     tracing.tracer.keep_recent = False
+    with _gates_lock:
+        _gates.clear()
+    _serving_status = None
 
 
 def reseed_child() -> None:

@@ -15,6 +15,7 @@ user guide.
 from flink_ml_tpu.resilience.policy import (  # noqa: F401
     RETRYABLE,
     TERMINAL,
+    CandidateRejected,
     InjectedFault,
     NonFiniteState,
     RestartsExhausted,
@@ -28,6 +29,7 @@ from flink_ml_tpu.resilience.supervisor import run_supervised  # noqa: F401
 __all__ = [
     "RETRYABLE",
     "TERMINAL",
+    "CandidateRejected",
     "InjectedFault",
     "NonFiniteState",
     "RestartsExhausted",

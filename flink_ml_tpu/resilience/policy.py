@@ -93,6 +93,26 @@ class NonFiniteState(TerminalFailure):
             f"{algo} diverged to a non-finite state{where}{tail}")
 
 
+class CandidateRejected(TerminalFailure):
+    """A candidate model failed the hot-swap health check (serving/
+    registry.py): corrupt checkpoint data, non-finite parameters, or a
+    probe transform that errored/produced non-finite predictions.
+
+    Terminal: the candidate's data is what it is — re-validating the
+    same snapshot reproduces the same rejection, so the registry rolls
+    back to the serving version instead of retrying (the exit-3 class,
+    same reasoning as :class:`NonFiniteState`). The next *published*
+    version is a fresh candidate and is evaluated normally."""
+
+    def __init__(self, model: str, version, reason: str, detail: str = ""):
+        self.model = model
+        self.version = version
+        self.reason = reason
+        tail = f": {detail}" if detail else ""
+        super().__init__(
+            f"candidate {model}@v{version} rejected ({reason}){tail}")
+
+
 #: failures that indicate a bug or invalid input — retrying replays the
 #: same deterministic computation into the same wall (the sweep's exit-3
 #: class). NotImplementedError is a RuntimeError subclass, so it must be

@@ -15,8 +15,10 @@ from flink_ml_tpu.servable.api import (  # noqa: F401
     DataFrame,
     DataTypes,
     ModelServable,
+    RejectedRequest,
     Row,
     TransformerServable,
+    serving_name,
 )
 from flink_ml_tpu.servable.builder import (  # noqa: F401
     PipelineModelServable,

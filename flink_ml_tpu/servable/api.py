@@ -16,6 +16,34 @@ from typing import Any, List, Optional, Sequence
 import numpy as np
 
 
+class RejectedRequest(Exception):
+    """A serving request was shed by admission control (serving/
+    batcher.py): its deadline expired before dispatch, the queue was
+    full, or its shape doesn't fit the bucket table. Carries the
+    servable name and a machine-readable ``reason`` so the
+    ``rejected{servable=,reason=}`` windowed counter (observability/
+    health.py) can distinguish shed load from real errors — a loadgen
+    SLO verdict must not count deliberate load-shedding against the
+    error budget."""
+
+    def __init__(self, servable: str, reason: str, detail: str = ""):
+        self.servable = servable
+        self.reason = reason
+        tail = f": {detail}" if detail else ""
+        super().__init__(
+            f"request rejected by {servable} ({reason}){tail}")
+
+
+def serving_name(servable) -> str:
+    """The name a servable's telemetry is labeled with: the deployed
+    ``serving_name`` attribute when the model registry (serving/
+    registry.py) set one (``<model>@v<N>``), else the class name — so
+    span attrs, latency histograms and SLO verdicts distinguish model
+    versions, not just servable classes."""
+    return (getattr(servable, "serving_name", None)
+            or type(servable).__name__)
+
+
 class BasicType(enum.Enum):
     """Ref: servable/types/BasicType.java."""
     BOOLEAN = "boolean"
@@ -167,7 +195,7 @@ def _served(method):
 
     @functools.wraps(method)
     def wrapper(self, df: DataFrame) -> DataFrame:
-        servable = type(self).__name__
+        servable = serving_name(self)
         log = logging.getLogger(__name__)
         span_cm, entered = None, False
         try:
@@ -197,9 +225,15 @@ def _served(method):
             try:
                 from flink_ml_tpu.observability import health
 
-                health.observe_serving_error(servable,
-                                             type(e).__name__,
-                                             elapsed_ms)
+                if isinstance(e, RejectedRequest):
+                    # shed load is not an error: admission failures get
+                    # their own windowed counter so SLO error budgets
+                    # only pay for real failures
+                    health.observe_serving_rejected(servable, e.reason)
+                else:
+                    health.observe_serving_error(servable,
+                                                 type(e).__name__,
+                                                 elapsed_ms)
             except Exception:  # noqa: BLE001 — see docstring
                 log.warning("serving error recording failed",
                             exc_info=True)

@@ -1,0 +1,63 @@
+"""Production serving runtime over the engine-free servables.
+
+The servable tier (flink_ml_tpu/servable/) answers ONE caller's
+``transform``; this package turns it into a server (docs/serving.md):
+
+- :mod:`batcher` — async micro-batching: admission-controlled queueing
+  with deadlines, padding/bucketing to a fixed batch-shape table (so
+  steady-state serving never recompiles), one device dispatch per tick;
+- :mod:`warmup` — AOT-compile every bucket shape at start and gate
+  ``/healthz`` readiness on completion;
+- :mod:`registry` — versioned model hot-swap from checkpointed model
+  data: manifest-validated, health-probed, atomic, rolled back on any
+  failure — the online-learning (FTRL) → serving handoff;
+- :mod:`loadgen` — closed/open-loop load generation with exact latency
+  percentiles, the one request-driving path for benchmarks, smokes and
+  tests.
+
+Ref parity: the reference stops at the synchronous servable interface
+(TransformerServable.transform); the runtime around it — Flink's job
+graph there — is this package here.
+"""
+
+from flink_ml_tpu.serving.batcher import (  # noqa: F401
+    BUCKETS_ENV,
+    DEADLINE_ENV,
+    DEFAULT_BUCKET_ROWS,
+    QUEUE_ENV,
+    WINDOW_ENV,
+    BatcherConfig,
+    MicroBatcher,
+)
+from flink_ml_tpu.serving.loadgen import (  # noqa: F401
+    LoadGenConfig,
+    percentiles,
+    run_loadgen,
+)
+from flink_ml_tpu.serving.registry import (  # noqa: F401
+    ModelRegistry,
+    publish_model,
+)
+from flink_ml_tpu.serving.warmup import (  # noqa: F401
+    WARMUP_GATE,
+    compile_count,
+    warm,
+)
+
+__all__ = [
+    "BUCKETS_ENV",
+    "DEADLINE_ENV",
+    "DEFAULT_BUCKET_ROWS",
+    "QUEUE_ENV",
+    "WINDOW_ENV",
+    "BatcherConfig",
+    "MicroBatcher",
+    "LoadGenConfig",
+    "percentiles",
+    "run_loadgen",
+    "ModelRegistry",
+    "publish_model",
+    "WARMUP_GATE",
+    "compile_count",
+    "warm",
+]

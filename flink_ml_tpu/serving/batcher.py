@@ -1,0 +1,457 @@
+"""Async micro-batching dispatcher: many callers, one device dispatch
+per tick.
+
+The synchronous servable path (servable/api.py) is one caller, one
+``transform``, one dispatch — fine for a notebook, hopeless for traffic.
+This module puts a queue in front of any
+:class:`~flink_ml_tpu.servable.api.TransformerServable`:
+
+- **submit** enqueues a request (a DataFrame) with a deadline and
+  returns a future; admission control rejects immediately
+  (:class:`~flink_ml_tpu.servable.api.RejectedRequest`) when the queue
+  is full or the request cannot fit any batch bucket — shed load, never
+  unbounded latency;
+- a **dispatcher tick** drains whole requests once the oldest has
+  waited ``window_ms`` or the largest bucket fills, drops requests whose
+  deadline expired in queue, **pads** the concatenated rows up to the
+  smallest bucket that fits (``buckets``, a small fixed table of batch
+  shapes) and issues ONE ``transform`` on the batch — so steady-state
+  serving presents XLA with a closed set of batch shapes and never
+  recompiles (the contract serving/warmup.py pre-compiles and
+  tests assert via ``ml.compile`` counters);
+- results split back per request, futures resolve, and in-flight
+  requests pin the servable they were dispatched with — a model
+  hot-swap (serving/registry.py) between ticks never yanks a batch
+  mid-flight.
+
+Telemetry rides the PR 7 live endpoint: ``queueDepth`` /
+``batchFill`` / ``paddingWaste`` gauges, per-request ``queueMs`` /
+``batchMs`` windowed histograms and fill/waste distributions in
+``ml.serving``, a ``serving.batch`` span per tick, and a ``/serving``
+route (observability/server.py) exposing queue depth, the bucket table
+and the active model version. See docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+from flink_ml_tpu.common.metrics import ML_GROUP, RATIO_BUCKETS, metrics
+from flink_ml_tpu.observability import tracing
+from flink_ml_tpu.observability.health import (
+    COUNT_BUCKETS,
+    SERVING_HORIZON_S,
+    SERVING_SLICES,
+    observe_serving_rejected,
+)
+from flink_ml_tpu.servable.api import (
+    DataFrame,
+    RejectedRequest,
+    TransformerServable,
+    serving_name,
+)
+
+__all__ = ["DEFAULT_BUCKET_ROWS", "BUCKETS_ENV", "WINDOW_ENV",
+           "DEADLINE_ENV", "QUEUE_ENV", "BatcherConfig", "MicroBatcher"]
+
+#: default batch-shape table (rows) — covers singleton pings through
+#: bulk scoring with <= 2x padding waste per bucket step
+DEFAULT_BUCKET_ROWS = (1, 8, 32, 128)
+
+#: deployment env vars (docs/serving.md): comma-separated bucket row
+#: counts ("none" disables bucketing), batch window ms, default request
+#: deadline ms ("none" disables), admission queue bound in rows
+BUCKETS_ENV = "FLINK_ML_TPU_SERVE_BUCKETS"
+WINDOW_ENV = "FLINK_ML_TPU_SERVE_WINDOW_MS"
+DEADLINE_ENV = "FLINK_ML_TPU_SERVE_DEADLINE_MS"
+QUEUE_ENV = "FLINK_ML_TPU_SERVE_MAX_QUEUE_ROWS"
+
+
+@dataclasses.dataclass(frozen=True)
+class BatcherConfig:
+    """Micro-batcher tuning knobs (env-independent: the serving scripts
+    map FLINK_ML_TPU_SERVE_* env vars onto this, docs/serving.md).
+
+    ``buckets=None`` disables bucketing/padding — every tick dispatches
+    the exact drained row count. That trades padding waste for a fresh
+    XLA compile per distinct batch size: the recompile-storm
+    configuration the negative tests exercise, not a production mode.
+    """
+
+    #: sorted row-count bucket table; None disables bucketing
+    buckets: Optional[Tuple[int, ...]] = DEFAULT_BUCKET_ROWS
+    #: max time (ms) the oldest queued request waits for batch fill
+    window_ms: float = 5.0
+    #: admission bound: queued rows beyond this are rejected queue-full
+    max_queue_rows: int = 4096
+    #: default per-request deadline (ms) from enqueue to dispatch;
+    #: None = requests never expire in queue
+    deadline_ms: Optional[float] = 1000.0
+    #: cap on rows drained per tick without bucketing (with bucketing
+    #: the largest bucket is the cap)
+    max_batch_rows: int = 1024
+
+    def __post_init__(self):
+        if self.buckets is not None:
+            b = tuple(int(x) for x in self.buckets)
+            if not b or any(x <= 0 for x in b) or list(b) != sorted(set(b)):
+                raise ValueError(
+                    f"buckets must be sorted unique positive row "
+                    f"counts, got {self.buckets!r}")
+            object.__setattr__(self, "buckets", b)
+        if self.window_ms < 0:
+            raise ValueError("window_ms must be >= 0")
+        if self.max_queue_rows <= 0 or self.max_batch_rows <= 0:
+            raise ValueError("queue/batch row bounds must be > 0")
+
+    @classmethod
+    def from_env(cls, **overrides) -> "BatcherConfig":
+        """Config from the FLINK_ML_TPU_SERVE_* env vars (unset fields
+        keep their defaults; keyword ``overrides`` win over env). A
+        malformed value raises ValueError naming the variable — a
+        mistyped deployment knob must fail loudly at startup, not serve
+        with silent defaults."""
+        import os
+
+        def read(env, parse, key):
+            raw = os.environ.get(env)
+            if raw is None or key in overrides:
+                return
+            try:
+                overrides[key] = parse(raw)
+            except ValueError as e:
+                raise ValueError(f"{env}={raw!r}: {e}") from e
+
+        def parse_buckets(raw):
+            if raw.strip().lower() in ("", "none", "off"):
+                return None
+            return tuple(int(b) for b in raw.split(","))
+
+        def parse_optional_ms(raw):
+            if raw.strip().lower() in ("", "none"):
+                return None
+            return float(raw)
+
+        read(BUCKETS_ENV, parse_buckets, "buckets")
+        read(WINDOW_ENV, float, "window_ms")
+        read(DEADLINE_ENV, parse_optional_ms, "deadline_ms")
+        read(QUEUE_ENV, int, "max_queue_rows")
+        return cls(**overrides)
+
+    @property
+    def max_bucket(self) -> int:
+        return (self.buckets[-1] if self.buckets
+                else self.max_batch_rows)
+
+    def bucket_for(self, rows: int) -> int:
+        """Smallest bucket holding ``rows`` (== ``rows`` unbucketed)."""
+        if self.buckets is None:
+            return rows
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return rows  # caller enforces rows <= max_bucket at admission
+
+
+class _Request:
+    __slots__ = ("df", "rows", "n", "future", "t_enqueue", "deadline_s")
+
+    def __init__(self, df: DataFrame, deadline_ms: Optional[float]):
+        self.df = df
+        self.rows = df.collect()
+        self.n = len(self.rows)
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.deadline_s = (None if deadline_ms is None
+                           else self.t_enqueue + deadline_ms / 1000.0)
+
+
+class MicroBatcher:
+    """The dispatcher: one daemon thread draining an admission-controlled
+    queue into padded, bucketed, single-dispatch batches.
+
+    ``target`` is the servable itself, a zero-arg provider callable, or
+    anything with an ``active`` attribute (a
+    :class:`~flink_ml_tpu.serving.registry.ModelRegistry`) — resolved
+    ONCE per tick, so a hot-swap lands between batches, never inside
+    one."""
+
+    def __init__(self, target, config: Optional[BatcherConfig] = None):
+        self.config = config or BatcherConfig()
+        if isinstance(target, TransformerServable):
+            self._provider = lambda: target
+        elif hasattr(target, "active"):
+            self._provider = lambda: target.active
+        elif callable(target):
+            self._provider = target
+        else:
+            raise TypeError(
+                f"target must be a servable, a provider callable, or "
+                f"have .active; got {type(target).__name__}")
+        # append-right / pop-left only: deque keeps the dispatcher's
+        # drain O(1) per request while it holds the condition lock
+        self._queue = collections.deque()
+        self._queued_rows = 0
+        self._cond = threading.Condition()
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+        self._ticks = 0
+        self._served_requests = 0
+        self._prev_status = None
+        self._group = metrics.group(ML_GROUP, "serving")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._thread is not None:
+            return self
+        self._stopping = False
+        self._thread = threading.Thread(target=self._run,
+                                        name="flink-ml-tpu-batcher",
+                                        daemon=True)
+        self._thread.start()
+        # the live /serving route reflects THIS runtime while it runs;
+        # the previous provider (a batcher we run alongside, e.g. a
+        # benchmark sweep next to the main runtime) is restored on stop
+        from flink_ml_tpu.observability import server
+
+        self._prev_status = server.get_serving_status()
+        server.set_serving_status(self.status)
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the dispatcher; with ``drain`` (default) queued requests
+        are dispatched first, otherwise they are rejected ``shutdown``."""
+        thread = self._thread
+        if thread is None:
+            return
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                for req in self._queue:
+                    self._reject(req, "shutdown")
+                self._queue.clear()
+                self._queued_rows = 0
+            self._cond.notify_all()
+        thread.join(timeout=30.0)
+        self._thread = None
+        from flink_ml_tpu.observability import server
+
+        # only clear OUR registration (a later-started batcher may have
+        # taken the /serving route over), handing back to whoever held
+        # it when we started
+        server.clear_serving_status(self.status, self._prev_status)
+        self._prev_status = None
+
+    def __enter__(self) -> "MicroBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, df: DataFrame, deadline_ms=...) -> Future:
+        """Enqueue one request; returns a future resolving to the
+        transformed DataFrame. Rejections (queue full, too large for
+        every bucket, shutdown, deadline expired in queue) surface as
+        :class:`~flink_ml_tpu.servable.api.RejectedRequest` raised by
+        ``future.result()`` — and are counted windowed per reason."""
+        if deadline_ms is ...:
+            deadline_ms = self.config.deadline_ms
+        req = _Request(df, deadline_ms)
+        cfg = self.config
+        with self._cond:
+            if self._stopping or self._thread is None:
+                self._reject(req, "shutdown")
+                return req.future
+            if req.n == 0:
+                # nothing to batch — and the pad logic needs at least
+                # one real row to duplicate
+                self._reject(req, "empty")
+                return req.future
+            if cfg.buckets is not None and req.n > cfg.max_bucket:
+                self._reject(req, "too-large")
+                return req.future
+            if self._queued_rows + req.n > cfg.max_queue_rows:
+                self._reject(req, "queue-full")
+                return req.future
+            self._queue.append(req)
+            self._queued_rows += req.n
+            self._group.gauge("queueDepth", self._queued_rows)
+            self._cond.notify_all()
+        return req.future
+
+    def _reject(self, req: _Request, reason: str) -> None:
+        name = self._label()
+        observe_serving_rejected(name, reason)
+        tracing.tracer.event("serving.rejected", servable=name,
+                             reason=reason, rows=req.n)
+        req.future.set_exception(RejectedRequest(name, reason))
+
+    def _label(self) -> str:
+        try:
+            servable = self._provider()
+        except Exception:  # noqa: BLE001 — labeling must never raise
+            servable = None
+        return (serving_name(servable) if servable is not None
+                else "unbound")
+
+    # -- dispatch loop -------------------------------------------------------
+    def _run(self) -> None:
+        cfg = self.config
+        window_s = cfg.window_ms / 1000.0
+        while True:
+            batch: List[_Request] = []
+            with self._cond:
+                while not self._queue and not self._stopping:
+                    self._cond.wait()
+                if not self._queue and self._stopping:
+                    return
+                # fill-or-window: dispatch early only when the LARGEST
+                # bucket's worth of rows is queued (any smaller fill
+                # threshold would defeat batching — one row "fills"
+                # bucket 1), else when the oldest request's window
+                # lapses; window_ms is therefore the latency bound a
+                # partially-filled batch pays
+                while (self._queue
+                       and self._queued_rows < cfg.max_bucket
+                       and not self._stopping):
+                    remaining = (self._queue[0].t_enqueue + window_s
+                                 - time.perf_counter())
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                if not self._queue:
+                    continue
+                total = 0
+                while (self._queue
+                       and total + self._queue[0].n <= cfg.max_bucket):
+                    req = self._queue.popleft()
+                    total += req.n
+                    batch.append(req)
+                if not batch:
+                    # head request alone exceeds the cap (unbucketed
+                    # mode — bucketed admission already rejected it)
+                    req = self._queue.popleft()
+                    total = req.n
+                    self._reject(req, "too-large")
+                self._queued_rows -= total
+                self._group.gauge("queueDepth", self._queued_rows)
+            if batch:
+                try:
+                    self._dispatch(batch)
+                except Exception as e:  # noqa: BLE001 — a dispatch bug
+                    # must fail ITS batch, never kill the loop
+                    for req in batch:
+                        if not req.future.done():
+                            req.future.set_exception(e)
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        cfg = self.config
+        now = time.perf_counter()
+        live: List[_Request] = []
+        for req in batch:
+            if req.deadline_s is not None and now > req.deadline_s:
+                self._reject(req, "deadline")
+            else:
+                live.append(req)
+        if not live:
+            return
+        servable = self._provider()
+        if servable is None:
+            for req in live:
+                self._reject(req, "no-model")
+            return
+        name = serving_name(servable)
+        labels = {"servable": name}
+        rows: List = []
+        schema = live[0].df.column_names
+        kept: List[_Request] = []
+        for req in live:
+            if req.df.column_names != schema:
+                self._reject(req, "schema")
+                continue
+            kept.append(req)
+            rows.extend(req.rows)
+        if not kept:
+            return
+        n_real = len(rows)
+        bucket = cfg.bucket_for(n_real)
+        # pad by duplicating the last row: same shapes, discarded output
+        pad = bucket - n_real
+        for _ in range(pad):
+            rows.append(type(rows[-1])(list(rows[-1].values)))
+        batch_df = DataFrame(list(schema),
+                             list(kept[0].df.data_types), rows)
+        fill = n_real / bucket if bucket else 1.0
+        waste = pad / bucket if bucket else 0.0
+        for req in kept:
+            self._group.windowed_histogram(
+                "queueMs", horizon_s=SERVING_HORIZON_S,
+                slices=SERVING_SLICES, labels=labels).observe(
+                    (now - req.t_enqueue) * 1000.0)
+        t0 = time.perf_counter()
+        with tracing.tracer.span("serving.batch", servable=name,
+                                 bucket=bucket, rows=n_real,
+                                 requests=len(kept)):
+            try:
+                out = servable.transform(batch_df)
+            except Exception as e:  # noqa: BLE001 — the batch fails,
+                # per-request; the _served seam already counted it once
+                for req in kept:
+                    req.future.set_exception(e)
+                return
+        batch_ms = (time.perf_counter() - t0) * 1000.0
+        self._record_tick(labels, bucket, n_real, pad, fill, waste,
+                          batch_ms, len(kept))
+        out_rows = out.collect()
+        names, types = out.column_names, out.data_types
+        offset = 0
+        for req in kept:
+            req.future.set_result(DataFrame(
+                names, types, out_rows[offset:offset + req.n]))
+            offset += req.n
+
+    def _record_tick(self, labels, bucket, n_real, pad, fill, waste,
+                     batch_ms, n_requests) -> None:
+        grp = self._group
+        self._ticks += 1
+        self._served_requests += n_requests
+        grp.counter("batches", labels={**labels, "bucket": str(bucket)})
+        if pad:
+            grp.counter("padRows", pad, labels=labels)
+        grp.gauge("batchFill", round(fill, 4), labels=labels)
+        grp.gauge("paddingWaste", round(waste, 4), labels=labels)
+        grp.histogram("batchFillFrac", buckets=RATIO_BUCKETS,
+                      labels=labels).observe(fill)
+        grp.histogram("paddingWasteFrac", buckets=RATIO_BUCKETS,
+                      labels=labels).observe(waste)
+        grp.histogram("batchRows", buckets=COUNT_BUCKETS,
+                      labels=labels).observe(float(n_real))
+        grp.windowed_histogram("batchMs", horizon_s=SERVING_HORIZON_S,
+                               slices=SERVING_SLICES,
+                               labels=labels).observe(batch_ms)
+
+    # -- live status (the /serving route) ------------------------------------
+    def status(self) -> dict:
+        """Live runtime status for the ``/serving`` endpoint route."""
+        with self._cond:
+            depth_rows = self._queued_rows
+            depth_requests = len(self._queue)
+        cfg = self.config
+        return {
+            "servable": self._label(),
+            "queue": {"rows": depth_rows, "requests": depth_requests,
+                      "max_rows": cfg.max_queue_rows},
+            "buckets": (list(cfg.buckets) if cfg.buckets is not None
+                        else None),
+            "window_ms": cfg.window_ms,
+            "deadline_ms": cfg.deadline_ms,
+            "ticks": self._ticks,
+            "served_requests": self._served_requests,
+            "running": self._thread is not None,
+        }

@@ -1,0 +1,252 @@
+"""Versioned model registry: atomic hot-swap from checkpointed model
+data, with integrity + health vetting and rollback.
+
+The reference's signature capability is unbounded iteration — models
+that keep training while serving (OnlineLogisticRegression's
+model-version broadcast). This module is the serving half of that
+handoff, in the "Just-in-Time Aggregation" shape (arXiv:2208.09740):
+the trainer publishes model snapshots asynchronously, the server folds
+each one in with no global barrier — requests never stop.
+
+- **publish** (:func:`publish_model`, trainer side): model arrays land
+  as iteration/checkpoint.py checkpoints — v2 manifests with per-leaf
+  sha256 digests, fsync-before-atomic-rename — under a watch directory,
+  one ``ckpt-<version>`` per model version.
+- **watch** (:meth:`ModelRegistry.poll`, or the background watcher
+  thread): the newest unseen version is validated against its manifest
+  (:func:`~flink_ml_tpu.iteration.checkpoint.load_validated` — a
+  bit-flipped snapshot is quarantined ``*.corrupt`` and never loaded),
+  its leaves checked finite, loaded into a candidate servable, and
+  **probed**: one synthetic transform whose PR 5
+  prediction-distribution gauges (``ml.serving *FiniteFraction``) must
+  read 1.0 — a NaN-producing candidate is rejected before it ever sees
+  a request.
+- **swap**: on pass, the candidate (labeled ``<model>@v<N>`` via
+  ``serving_name``, so spans/histograms/SLOs split by version) becomes
+  :attr:`ModelRegistry.active` in one atomic assignment. The
+  micro-batcher resolves ``active`` once per tick, so in-flight batches
+  complete on the version they were dispatched with. On ANY failure the
+  registry **rolls back** by construction — the serving version was
+  never replaced — records ``swapRejected{model=,reason=}`` +
+  a ``serving.swap.rejected`` event, and remembers the version so a bad
+  candidate is not re-probed every poll
+  (:class:`~flink_ml_tpu.resilience.policy.CandidateRejected` is
+  terminal: the same snapshot re-validates to the same verdict).
+
+See docs/serving.md for the hot-swap state machine.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+from flink_ml_tpu.iteration.checkpoint import (
+    CheckpointManager,
+    CorruptCheckpoint,
+    list_checkpoint_names,
+    load_validated,
+    quarantine_checkpoint,
+)
+from flink_ml_tpu.observability import tracing
+from flink_ml_tpu.resilience.policy import CandidateRejected
+from flink_ml_tpu.servable.api import serving_name
+
+__all__ = ["publish_model", "ModelRegistry"]
+
+
+def publish_model(watch_dir: str, leaves, version: int,
+                  keep: int = 8) -> str:
+    """Trainer-side publish: write model ``leaves`` (a list/pytree of
+    arrays) as checkpoint version ``version`` under ``watch_dir`` —
+    v2 manifest, fsynced, atomically renamed — and return the published
+    path. The serving registry's watcher picks it up on its next poll."""
+    manager = CheckpointManager(watch_dir, keep=keep)
+    return manager.save(leaves, int(version))
+
+
+class ModelRegistry:
+    """Watches a publish directory and hot-swaps validated, healthy
+    model versions into :attr:`active`.
+
+    ``loader(leaves, version)`` builds a servable from validated host
+    arrays; ``probe`` (optional, a zero-arg factory of a small request
+    DataFrame) gates every candidate behind one real transform plus the
+    prediction-distribution finite check. ``health_check`` (optional,
+    ``servable -> bool``) adds a custom gate — return falsy or raise to
+    reject."""
+
+    def __init__(self, watch_dir: str,
+                 loader: Callable[[List[np.ndarray], int], object],
+                 model: str = "model",
+                 probe: Optional[Callable[[], object]] = None,
+                 health_check: Optional[Callable[[object], bool]] = None,
+                 poll_interval_s: float = 1.0):
+        self.watch_dir = watch_dir
+        self.model = model
+        self._loader = loader
+        self._probe = probe
+        self._health_check = health_check
+        self.poll_interval_s = float(poll_interval_s)
+        self._lock = threading.Lock()
+        self._active = None
+        self._version: Optional[int] = None
+        self._rejected: set = set()
+        self._watcher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._group = metrics.group(ML_GROUP, "serving")
+
+    # -- the serving side ----------------------------------------------------
+    @property
+    def active(self):
+        """The serving servable (None before the first successful
+        swap). One atomic read — safe from any thread."""
+        return self._active
+
+    @property
+    def version(self) -> Optional[int]:
+        return self._version
+
+    # -- candidate discovery -------------------------------------------------
+    def _published_versions(self) -> List[int]:
+        return [int(name[len("ckpt-"):])
+                for name in list_checkpoint_names(self.watch_dir)]
+
+    def poll(self) -> bool:
+        """One watcher step: consider published versions newer than the
+        serving one, newest first; adopt the first that validates and
+        passes health checks. Returns True when a swap happened. Never
+        raises on a bad candidate — rejection is recorded, the serving
+        version keeps serving (rollback by construction)."""
+        current = self._version
+        fresh = [v for v in self._published_versions()
+                 if (current is None or v > current)
+                 and v not in self._rejected]
+        for version in reversed(fresh):
+            try:
+                self._adopt(version)
+                return True
+            except CandidateRejected as e:
+                reason, detail = e.reason, str(e)
+            except Exception as e:  # noqa: BLE001 — the never-raises
+                # contract: ANY failure between load and swap (a loader
+                # returning a __slots__ object that rejects the
+                # serving_name assignment, a gauge scan tripping on
+                # junk) is a rejected candidate, recorded and
+                # remembered — never a crashed watcher or a re-probe
+                # loop
+                reason = "internal-error"
+                detail = f"{type(e).__name__}: {e}"
+            self._rejected.add(version)
+            self._group.counter(
+                "swapRejected",
+                labels={"model": self.model, "reason": reason})
+            tracing.tracer.event("serving.swap.rejected",
+                                 model=self.model, version=version,
+                                 reason=reason, detail=detail)
+        return False
+
+    def _adopt(self, version: int) -> None:
+        ckpt_dir = os.path.join(self.watch_dir, f"ckpt-{version:08d}")
+        try:
+            leaves, epoch = load_validated(ckpt_dir)
+        except CorruptCheckpoint as e:
+            # rename-to-*.corrupt keeps the evidence AND stops the
+            # watcher from revalidating the same torn snapshot forever
+            quarantine_checkpoint(ckpt_dir, str(e))
+            raise CandidateRejected(self.model, version, "corrupt",
+                                    str(e)) from e
+        for i, leaf in enumerate(leaves):
+            arr = np.asarray(leaf)
+            if (np.issubdtype(arr.dtype, np.floating)
+                    and not np.isfinite(arr).all()):
+                raise CandidateRejected(
+                    self.model, version, "non-finite",
+                    f"leaf_{i} has non-finite values")
+        try:
+            candidate = self._loader(leaves, epoch)
+        except Exception as e:  # noqa: BLE001 — a loader crash is a
+            # rejected candidate, never a crashed server
+            raise CandidateRejected(self.model, version, "load-error",
+                                    f"{type(e).__name__}: {e}") from e
+        candidate.serving_name = f"{self.model}@v{version}"
+        self._probe_candidate(candidate, version)
+        with self._lock:
+            previous = self._version
+            self._active = candidate
+            self._version = version
+        self._group.gauge("modelVersion", version,
+                          labels={"model": self.model})
+        self._group.counter("swaps", labels={"model": self.model})
+        tracing.tracer.event("serving.swap", model=self.model,
+                             version=version,
+                             previous=previous if previous is not None
+                             else "none")
+
+    def _probe_candidate(self, candidate, version: int) -> None:
+        if self._probe is not None:
+            try:
+                candidate.transform(self._probe())
+            except Exception as e:  # noqa: BLE001 — see _adopt
+                raise CandidateRejected(
+                    self.model, version, "probe-error",
+                    f"{type(e).__name__}: {e}") from e
+            # the probe transform just wrote this candidate's
+            # prediction-distribution gauges (observability/health.py,
+            # labeled by its serving_name) — the ready-made
+            # accept/reject signal: anything non-finite rejects
+            snap = self._group.snapshot().get("gauges", {})
+            label = f'servable="{serving_name(candidate)}"'
+            for key, value in snap.items():
+                if "FiniteFraction" in key and label in key \
+                        and float(value) < 1.0:
+                    raise CandidateRejected(
+                        self.model, version, "probe-non-finite",
+                        f"{key} = {value}")
+        if self._health_check is not None:
+            try:
+                verdict = self._health_check(candidate)
+            except Exception as e:  # noqa: BLE001 — see _adopt
+                raise CandidateRejected(
+                    self.model, version, "health-check",
+                    f"{type(e).__name__}: {e}") from e
+            if not verdict:
+                raise CandidateRejected(self.model, version,
+                                        "health-check")
+
+    # -- background watcher --------------------------------------------------
+    def start_watcher(self) -> "ModelRegistry":
+        if self._watcher is not None:
+            return self
+        self._stop.clear()
+        self._watcher = threading.Thread(
+            target=self._watch, name="flink-ml-tpu-model-watcher",
+            daemon=True)
+        self._watcher.start()
+        return self
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll()
+            except Exception:  # noqa: BLE001 — the watcher must outlive
+                # any single bad poll (e.g. a transient listdir error)
+                tracing.tracer.event("serving.watcher.error",
+                                     model=self.model)
+
+    def stop(self) -> None:
+        if self._watcher is None:
+            return
+        self._stop.set()
+        self._watcher.join(timeout=10.0)
+        self._watcher = None
+
+    def __enter__(self) -> "ModelRegistry":
+        return self.start_watcher()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

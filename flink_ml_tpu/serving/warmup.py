@@ -1,0 +1,113 @@
+"""AOT warmup: pre-compile every serving bucket shape before traffic.
+
+The micro-batcher (serving/batcher.py) guarantees steady-state serving
+presents XLA with a closed set of batch shapes; this module pays the
+compile bill for that whole set at server start, so the FIRST request
+into each bucket is already a compile-cache hit instead of a
+multi-hundred-ms stall. Each bucket warms through the servable's own
+jitted predict path — ``aot_warm(rows)`` when the servable exposes one
+(servable/lr.py routes it through
+:func:`~flink_ml_tpu.observability.compilestats.instrumented_jit`, so
+every warm compile is counted ``ml.compile compiles{fn=...}`` and the
+post-warmup steady count is assertable), else one synthetic
+``transform`` per bucket via the caller's ``frame_factory``.
+
+Readiness: :func:`warm` registers the ``serving-warmup`` gate with the
+live endpoint (observability/server.py) before compiling and releases
+it after — ``/healthz`` answers 503 with the gate's reason until every
+bucket is warm, the readiness/liveness split a load balancer needs to
+keep traffic off a cold compile cache. See docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+from flink_ml_tpu.observability import tracing
+from flink_ml_tpu.observability.compilestats import compile_totals_split
+
+__all__ = ["WARMUP_GATE", "compile_count", "warm"]
+
+#: the readiness gate name ``/healthz`` reports while warming
+WARMUP_GATE = "serving-warmup"
+
+
+def compile_count() -> int:
+    """Total per-function compiles recorded so far (the
+    ``ml.compile compileMs{fn=...}`` series) — the before/after probe
+    for the steady-state zero-compile assertion: read once after
+    :func:`warm`, again after a load run, and the delta is the number
+    of compiles real traffic paid."""
+    return int(compile_totals_split()["perfn"]["count"])
+
+
+def warm(target,
+         frame_factory: Optional[Callable[[int], "object"]] = None,
+         buckets: Optional[Sequence[int]] = None,
+         gate: bool = True) -> dict:
+    """Warm every bucket shape; returns a report dict.
+
+    ``target`` is a :class:`~flink_ml_tpu.serving.batcher.MicroBatcher`
+    (buckets and servable are taken from it) or a servable (pass
+    ``buckets`` explicitly). Per bucket the servable's ``aot_warm`` is
+    preferred; ``frame_factory(rows)`` (a synthetic request frame of
+    that many rows) is the generic fallback — pure-host servables warm
+    trivially through it.
+
+    With ``gate`` (default) the ``serving-warmup`` readiness gate is
+    held closed while compiling and released on success; a warmup
+    failure leaves the gate closed with the failure as its reason and
+    re-raises — a server that could not warm must not report ready.
+    """
+    from flink_ml_tpu.observability import server
+    from flink_ml_tpu.serving.batcher import MicroBatcher
+
+    if isinstance(target, MicroBatcher):
+        servable = target._provider()
+        if buckets is None:
+            buckets = target.config.buckets
+    else:
+        servable = target
+    if servable is None:
+        raise ValueError("cannot warm: no active servable "
+                         "(publish a model to the registry first)")
+    bucket_list = [int(b) for b in (buckets or (1,))]
+    if gate:
+        server.set_gate(WARMUP_GATE, False,
+                        f"warming {len(bucket_list)} bucket shape(s)")
+    report = {"buckets": {}, "total_ms": 0.0, "compiles": 0}
+    before = compile_count()
+    t_start = time.perf_counter()
+    try:
+        for rows in bucket_list:
+            t0 = time.perf_counter()
+            if hasattr(servable, "aot_warm"):
+                servable.aot_warm(rows)
+            elif frame_factory is not None:
+                servable.transform(frame_factory(rows))
+            else:
+                raise ValueError(
+                    f"servable {type(servable).__name__} has no "
+                    f"aot_warm and no frame_factory was given")
+            report["buckets"][rows] = round(
+                (time.perf_counter() - t0) * 1000.0, 3)
+    except Exception as e:
+        if gate:
+            server.set_gate(WARMUP_GATE, False,
+                            f"warmup failed: {type(e).__name__}: {e}")
+        raise
+    report["total_ms"] = round((time.perf_counter() - t_start) * 1000.0,
+                               3)
+    report["compiles"] = compile_count() - before
+    grp = metrics.group(ML_GROUP, "serving")
+    grp.gauge("warmupMs", report["total_ms"])
+    grp.gauge("warmupCompiles", report["compiles"])
+    tracing.tracer.event("serving.warmup",
+                         buckets=",".join(str(b) for b in bucket_list),
+                         ms=report["total_ms"],
+                         compiles=report["compiles"])
+    if gate:
+        server.set_gate(WARMUP_GATE, True)
+    return report

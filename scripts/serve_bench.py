@@ -1,0 +1,324 @@
+"""Serving benchmark: micro-batched vs per-request throughput, SLO-gated
+(docs/serving.md).
+
+Flow: train a logistic-regression model with the FTRL online path
+(OnlineLogisticRegression — the train-while-serve producer), publish it
+into a model-registry watch dir (v2 checkpoint manifests), build the
+serving runtime (registry → micro-batcher → AOT warmup), then drive the
+SAME closed-loop request mix (serving/loadgen.py) through
+
+1. the **per-request baseline** — one ``transform`` per request, the
+   synchronous servable path, and
+2. the **micro-batched runtime** — admission queue, bucket padding, one
+   device dispatch per tick,
+
+and record both in a BASELINE-style ``BENCH_serving.json`` beside the
+fit benchmarks: throughput, exact p50/p99, padding/fill, warmup compile
+bill, steady-state compile count (must be 0 — the bucketing contract),
+and a live hot-swap mid-run (the registry watcher adopts a
+freshly-published version while requests are in flight). A small
+window/bucket sweep rides along unless ``--smoke``.
+
+Gates (exit codes follow the repo convention): 0 ok; 1 an acceptance
+gate failed (ratio < --min-ratio, steady compiles > 0, errors, p99 over
+budget, hot-swap missed); 2 broken environment; 4 the
+``flink-ml-tpu-trace slo --check`` artifact gate found a violated SLO.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from flink_ml_tpu.linalg.vectors import DenseVector  # noqa: E402
+from flink_ml_tpu.servable.api import (  # noqa: E402
+    DataFrame,
+    DataTypes,
+    Row,
+)
+from flink_ml_tpu.servable.lr import (  # noqa: E402
+    LogisticRegressionModelData,
+    LogisticRegressionModelServable,
+)
+from flink_ml_tpu.serving import (  # noqa: E402
+    BatcherConfig,
+    LoadGenConfig,
+    MicroBatcher,
+    ModelRegistry,
+    compile_count,
+    publish_model,
+    run_loadgen,
+    warm,
+)
+
+#: request row-count mix — singleton pings dominate, with small bursts
+REQUEST_SIZES = (1, 2, 4)
+
+#: the benchmark's SLO spec (evaluated over the dumped artifacts by
+#: ``flink-ml-tpu-trace slo --check``): p99 per-tick transform latency
+#: and the serving error ratio. Shed load (``rejected``) is NOT an
+#: error — that distinction is the point of the rejected counter.
+SLO_SPEC = {"slos": [
+    {"name": "serving-batch-latency-p99", "kind": "latency",
+     "histogram": "transformMs", "quantile": 0.99,
+     "threshold_ms": 500.0},
+    {"name": "serving-error-rate", "kind": "error-rate",
+     "max_error_ratio": 0.01},
+]}
+
+
+def fail(code: int, message: str):
+    print(f"serve_bench: FAIL — {message}", file=sys.stderr)
+    raise SystemExit(code)
+
+
+def train_ftrl(dim: int, rows: int, batch: int) -> np.ndarray:
+    """FTRL-train an LR model on a synthetic stream; returns the
+    coefficient vector — the online-learning producer whose snapshots
+    the registry serves."""
+    from flink_ml_tpu.common.table import Table, as_dense_vector_column
+    from flink_ml_tpu.models.online import OnlineLogisticRegression
+
+    rng = np.random.default_rng(7)
+    w_true = rng.normal(size=dim)
+    x = rng.normal(size=(rows, dim))
+    y = (x @ w_true > 0).astype(np.float64)
+    table = Table.from_columns(features=x, label=y)
+    init = Table.from_columns(
+        coefficient=as_dense_vector_column(np.zeros((1, dim))),
+        modelVersion=np.asarray([0], np.int64))
+    model = (OnlineLogisticRegression(global_batch_size=batch,
+                                      alpha=0.5, beta=0.5)
+             .set_initial_model_data(init).fit(table))
+    return np.asarray(model.coefficients, np.float64)
+
+
+def make_frame_factory(dim: int):
+    # a fresh Generator per frame: factories run on concurrent loadgen
+    # workers and np.random.Generator is not thread-safe
+    counter = [0]
+
+    def frame(rows: int) -> DataFrame:
+        counter[0] += 1
+        rng = np.random.default_rng(counter[0])
+        return DataFrame(
+            ["features"], [DataTypes.vector()],
+            [Row([DenseVector(rng.normal(size=dim))])
+             for _ in range(rows)])
+
+    return frame
+
+
+def lr_loader(leaves, version):
+    servable = LogisticRegressionModelServable().set_device_predict(True)
+    servable.model_data = LogisticRegressionModelData(
+        np.asarray(leaves[0], np.float64), version)
+    return servable
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="small CI run: fewer requests, no sweep, "
+                             "assert the hot-swap landed mid-run")
+    parser.add_argument("--requests", type=int, default=None,
+                        help="requests per measured run "
+                             "(default 1200, smoke 400)")
+    parser.add_argument("--concurrency", type=int, default=64)
+    parser.add_argument("--repeats", type=int, default=2,
+                        help="measured repeats per path; the best "
+                             "throughput run is recorded (wall-clock "
+                             "jitter on shared runners)")
+    parser.add_argument("--dim", type=int, default=16)
+    parser.add_argument("--window-ms", type=float, default=1.0)
+    parser.add_argument("--buckets", default="8,32,128",
+                        help="comma-separated bucket row counts")
+    parser.add_argument("--min-ratio", type=float, default=3.0,
+                        help="batched/per-request throughput gate")
+    parser.add_argument("--p99-budget-ms", type=float, default=250.0,
+                        help="loadgen end-to-end p99 gate (batched run)")
+    parser.add_argument("--output", default="BENCH_serving.json")
+    parser.add_argument("--trace-dir", default=None,
+                        help="artifact dir (default: a temp dir; CI "
+                             "points this at an uploadable path)")
+    args = parser.parse_args(argv)
+
+    n_requests = args.requests or (400 if args.smoke else 1200)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    root = args.trace_dir or tempfile.mkdtemp(prefix="serve-bench-")
+    trace_dir = os.path.join(root, "trace")
+    os.environ["FLINK_ML_TPU_TRACE_DIR"] = trace_dir
+    os.environ.setdefault("FLINK_ML_TPU_METRICS_PORT", "0")
+
+    from flink_ml_tpu.observability import server, slo, tracing
+    from flink_ml_tpu.observability.exporters import dump_metrics
+
+    import jax
+
+    frame = make_frame_factory(args.dim)
+
+    def request_frame(i: int) -> DataFrame:
+        return frame(REQUEST_SIZES[i % len(REQUEST_SIZES)])
+
+    # -- train (FTRL) and publish v1 -----------------------------------------
+    t0 = time.perf_counter()
+    coef = train_ftrl(args.dim, rows=4000 if args.smoke else 20000,
+                      batch=500)
+    train_ms = (time.perf_counter() - t0) * 1000.0
+    watch_dir = os.path.join(root, "models")
+    publish_model(watch_dir, [coef], 1)
+    registry = ModelRegistry(watch_dir, lr_loader, model="lr",
+                             probe=lambda: frame(buckets[0]),
+                             poll_interval_s=0.05)
+    if not registry.poll() or registry.version != 1:
+        fail(2, "registry did not adopt the published v1 model")
+    print(f"serve_bench: FTRL-trained lr@v1 ({args.dim} dims, "
+          f"{train_ms:.0f} ms) published to {watch_dir}")
+
+    # -- per-request baseline ------------------------------------------------
+    def best_of(submit) -> dict:
+        best = None
+        for _ in range(max(1, args.repeats)):
+            r = run_loadgen(submit, request_frame,
+                            LoadGenConfig(mode="closed",
+                                          requests=n_requests,
+                                          concurrency=args.concurrency))
+            if best is None or r["throughput_rps"] > best["throughput_rps"]:
+                best = r
+        return best
+
+    baseline_servable = registry.active
+    for size in sorted(set(REQUEST_SIZES)):  # warm its shapes too:
+        baseline_servable.transform(frame(size))  # compare steady states
+    per_request = best_of(baseline_servable.transform)
+    print(f"serve_bench: per-request {per_request['throughput_rps']} "
+          f"rps, p99 {per_request['latency_ms']['p99']} ms")
+
+    # -- micro-batched runtime: warmup, readiness, measured run --------------
+    batcher = MicroBatcher(registry, BatcherConfig(
+        buckets=buckets, window_ms=args.window_ms)).start()
+    warm_report = warm(batcher, frame_factory=frame)
+    srv = server.maybe_start()
+    if srv is not None:
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=10) as r:
+            hz = json.loads(r.read())
+        if hz.get("status") != "ok":
+            fail(1, f"/healthz not ready after warmup: {hz}")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/serving", timeout=10) as r:
+            live = json.loads(r.read())
+        if (live.get("serving") or {}).get("servable") != "lr@v1":
+            fail(1, f"/serving route does not show the runtime: {live}")
+
+    registry.start_watcher()
+    steady_base = compile_count()
+    # publish v2 NOW: the watcher adopts it while the measured run is
+    # in flight — the zero-downtime hot-swap under load
+    publish_model(watch_dir, [coef * 1.01], 2)
+    batched = best_of(batcher.submit)
+    steady_compiles = compile_count() - steady_base
+    swapped_version = registry.version
+    registry.stop()
+    print(f"serve_bench: batched {batched['throughput_rps']} rps, "
+          f"p99 {batched['latency_ms']['p99']} ms, "
+          f"steady compiles {steady_compiles}, "
+          f"model now v{swapped_version}")
+
+    # -- optional window/bucket sweep ----------------------------------------
+    sweep = []
+    if not args.smoke:
+        for window_ms in (0.5, 2.0, 5.0):
+            for table in ((8, 32, 128), (32, 128), (128,)):
+                cfg = BatcherConfig(buckets=table, window_ms=window_ms)
+                with MicroBatcher(registry, cfg) as b:
+                    warm(b, frame_factory=frame, gate=False)
+                    r = run_loadgen(
+                        b.submit, request_frame,
+                        LoadGenConfig(mode="closed",
+                                      requests=max(200, n_requests // 4),
+                                      concurrency=args.concurrency))
+                sweep.append({"window_ms": window_ms,
+                              "buckets": list(table),
+                              "throughput_rps": r["throughput_rps"],
+                              "p50_ms": r["latency_ms"]["p50"],
+                              "p99_ms": r["latency_ms"]["p99"]})
+                print(f"serve_bench: sweep window={window_ms} "
+                      f"buckets={table}: {r['throughput_rps']} rps "
+                      f"p99 {r['latency_ms']['p99']} ms")
+    batcher.stop()
+
+    # -- record + gates ------------------------------------------------------
+    ratio = (batched["throughput_rps"]
+             / max(per_request["throughput_rps"], 1e-9))
+    record = {
+        "metric": "lr_serving_closed_loop_throughput",
+        "value": batched["throughput_rps"],
+        "unit": "requests/s",
+        "vs_per_request": round(ratio, 2),
+        "platform": ("cpu-fallback"
+                     if jax.default_backend() == "cpu"
+                     else jax.default_backend()),
+        "device_count": jax.device_count(),
+        "requests": n_requests,
+        "concurrency": args.concurrency,
+        "request_sizes": list(REQUEST_SIZES),
+        "buckets": list(buckets),
+        "window_ms": args.window_ms,
+        "per_request": per_request,
+        "batched": batched,
+        "warmup": warm_report,
+        "steady_compile_count": steady_compiles,
+        "hot_swap": {"published": [1, 2],
+                     "serving_version": swapped_version,
+                     "swapped_mid_run": swapped_version == 2},
+        "ftrl_train_ms": round(train_ms, 1),
+        "sweep": sweep,
+    }
+    with open(args.output, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=2)
+    print(f"serve_bench: wrote {args.output}")
+
+    tracing.tracer.shutdown()
+    dump_metrics(trace_dir)
+    spec_path = os.path.join(root, "serving-slo.json")
+    with open(spec_path, "w", encoding="utf-8") as f:
+        json.dump(SLO_SPEC, f)
+    rc_slo = slo.main([trace_dir, "--spec", spec_path, "--check"])
+    if rc_slo != 0:
+        fail(rc_slo, f"slo --check exited {rc_slo} on {trace_dir}")
+
+    if batched["errors"] or per_request["errors"]:
+        fail(1, f"request errors: batched {batched['errorsByClass']}, "
+                f"per-request {per_request['errorsByClass']}")
+    if steady_compiles != 0:
+        fail(1, f"{steady_compiles} steady-state compile(s) after "
+                "warmup — the bucketing contract is broken")
+    if args.smoke and swapped_version != 2:
+        fail(1, f"hot-swap did not land mid-run (serving v"
+                f"{swapped_version})")
+    if batched["latency_ms"]["p99"] > args.p99_budget_ms:
+        fail(1, f"batched p99 {batched['latency_ms']['p99']} ms over "
+                f"the {args.p99_budget_ms} ms budget")
+    if ratio < args.min_ratio:
+        fail(1, f"batched/per-request ratio {ratio:.2f} below "
+                f"{args.min_ratio}")
+    print(f"serve_bench: OK — {ratio:.2f}x over per-request, p99 "
+          f"{batched['latency_ms']['p99']} ms, 0 steady compiles, "
+          f"hot-swap v{swapped_version}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,15 +3,18 @@
 
 Flow: arm the embedded endpoint (``FLINK_ML_TPU_METRICS_PORT=0`` — an
 ephemeral port read back from the server) and a trace dir, build a
-logistic-regression servable, drive N requests — a slice of them
-malformed so the error path runs — while scraping ``/metrics`` (must be
-valid Prometheus text with the windowed serving families), ``/slo``
-(must be JSON verdicts evaluated over sliding windows), ``/healthz``
-and ``/spans/recent`` (must hold sampled ``serving.request`` spans)
-from the RUNNING process. Then gate the dumped artifacts the way CI
-consumes them: ``flink-ml-tpu-trace slo --check`` must exit 4 against a
-deliberately tight spec and 0 against a satisfied one, and ``--latest``
-must resolve the trace dir from its parent root.
+logistic-regression servable, drive N requests through the serving
+load generator (serving/loadgen.py — the one request-driving code
+path shared with scripts/serve_bench.py) — a second loadgen run issues
+malformed frames so the error path runs — while scraping ``/metrics``
+(must be valid Prometheus text with the windowed serving families),
+``/slo`` (must be JSON verdicts evaluated over sliding windows),
+``/healthz`` and ``/spans/recent`` (must hold sampled
+``serving.request`` spans) from the RUNNING process. Then gate the
+dumped artifacts the way CI consumes them: ``flink-ml-tpu-trace slo
+--check`` must exit 4 against a deliberately tight spec and 0 against a
+satisfied one, and ``--latest`` must resolve the trace dir from its
+parent root.
 
 Exit codes: 0 all good; 1 an assertion failed; 2 environment broken
 (endpoint would not arm).
@@ -79,41 +82,64 @@ def main() -> int:
     servable = LogisticRegressionModelServable().set_model_data(
         LogisticRegressionModelData(
             np.array([0.5, -0.25, 0.1])).encode())
-    rng = np.random.default_rng(0)
+    seed = [0]
 
     def frame() -> DataFrame:
+        # fresh Generator per frame: built on concurrent loadgen workers
+        seed[0] += 1
+        rng = np.random.default_rng(seed[0])
         return DataFrame(
             ["features"], [DataTypes.vector()],
             [Row([DenseVector(rng.normal(size=3))])
              for _ in range(ROWS)])
 
-    # the first transform lazily arms the endpoint; scrape WHILE serving
-    port = None
-    for i in range(N_OK):
-        servable.transform(frame())
-        if port is None:
-            srv = server.maybe_start()
-            if srv is None:
-                fail(2, "telemetry endpoint did not arm "
-                        "(FLINK_ML_TPU_METRICS_PORT=0)")
-            port = srv.port
+    # the first transform lazily arms the endpoint; the remaining
+    # requests drive through the serving loadgen, scraping WHILE it
+    # serves via the per-completion tick hook
+    servable.transform(frame())
+    srv = server.maybe_start()
+    if srv is None:
+        fail(2, "telemetry endpoint did not arm "
+                "(FLINK_ML_TPU_METRICS_PORT=0)")
+    port = srv.port
+
+    # ticks run on loadgen worker threads, where a raised SystemExit
+    # would be silently swallowed — collect, assert after the run
+    scrape_failures = []
+
+    def scrape_tick(i: int) -> None:
         if i % 10 == 5:
             text = fetch(port, "/metrics").decode("utf-8")
             if "flink_ml_tpu_ml_serving_transformMs_bucket" not in text:
-                fail(1, "/metrics is missing the serving latency "
-                        "histogram mid-run")
-    print(f"serve_smoke: endpoint on 127.0.0.1:{port}, "
-          f"{N_OK} requests served")
+                scrape_failures.append(
+                    f"/metrics missing the serving latency histogram "
+                    f"at request {i}")
 
-    for _ in range(N_ERR):
-        bad = DataFrame(["wrong"], [DataTypes.vector()],
-                        [Row([DenseVector([1.0, 2.0, 3.0])])])
-        try:
-            servable.transform(bad)
-        except ValueError:
-            pass  # the expected serving failure, counted by the seam
-        else:
-            fail(1, "malformed request unexpectedly succeeded")
+    from flink_ml_tpu.serving import LoadGenConfig, run_loadgen
+
+    res = run_loadgen(servable.transform, lambda i: frame(),
+                      LoadGenConfig(mode="closed", requests=N_OK - 1,
+                                    concurrency=4),
+                      tick=scrape_tick)
+    if scrape_failures:
+        fail(1, scrape_failures[0])
+    if res["ok"] != N_OK - 1 or res["errors"] or res["rejected"]:
+        fail(1, f"loadgen run not clean: {res}")
+    print(f"serve_smoke: endpoint on 127.0.0.1:{port}, {N_OK} requests "
+          f"served at {res['throughput_rps']} rps "
+          f"(p99 {res['latency_ms']['p99']} ms)")
+
+    def bad_frame(i: int) -> DataFrame:
+        return DataFrame(["wrong"], [DataTypes.vector()],
+                         [Row([DenseVector([1.0, 2.0, 3.0])])])
+
+    res_bad = run_loadgen(servable.transform, bad_frame,
+                          LoadGenConfig(mode="closed", requests=N_ERR,
+                                        concurrency=2))
+    if res_bad["errors"] != N_ERR \
+            or res_bad["errorsByClass"] != {"ValueError": N_ERR}:
+        fail(1, f"malformed requests were not all counted as "
+                f"ValueError: {res_bad}")
 
     text = fetch(port, "/metrics").decode("utf-8")
     for needle in (
@@ -136,6 +162,12 @@ def main() -> int:
     hz = json.loads(fetch(port, "/healthz"))
     if hz.get("status") != "ok" or hz.get("pid") != os.getpid():
         fail(1, f"/healthz looks wrong: {hz}")
+
+    # no serving runtime in this smoke: the route must say so, not 404
+    # (the populated form is exercised by scripts/serve_bench.py)
+    sv = json.loads(fetch(port, "/serving"))
+    if sv != {"serving": None}:
+        fail(1, f"/serving without a runtime should be null: {sv}")
 
     spans = json.loads(fetch(port, "/spans/recent"))["spans"]
     if not any(s.get("name") == "serving.request" for s in spans):

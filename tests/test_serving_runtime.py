@@ -1,0 +1,694 @@
+"""Serving runtime (ISSUE 8): micro-batching dispatcher, AOT warmup +
+readiness, versioned hot-swap registry, load generator.
+
+Acceptance bar: after warmup a 500-request mixed-size loadgen run pays
+ZERO steady-state compiles (the bucketing contract, asserted via
+compilestats), and disabling bucketing produces the recompile storm the
+bucket table exists to prevent; a corrupt (bit-flipped) checkpoint or a
+NaN-producing candidate NEVER serves a request (rollback), and an
+in-flight request during a hot-swap completes on the old version.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+from flink_ml_tpu.linalg.vectors import DenseVector
+from flink_ml_tpu.observability import server
+from flink_ml_tpu.observability.compilestats import compile_stats
+from flink_ml_tpu.resilience.policy import (
+    TERMINAL,
+    CandidateRejected,
+    RetryPolicy,
+)
+from flink_ml_tpu.servable.api import (
+    DataFrame,
+    DataTypes,
+    RejectedRequest,
+    Row,
+    TransformerServable,
+    serving_name,
+)
+from flink_ml_tpu.servable.lr import (
+    LogisticRegressionModelData,
+    LogisticRegressionModelServable,
+)
+from flink_ml_tpu.serving import (
+    BatcherConfig,
+    LoadGenConfig,
+    MicroBatcher,
+    ModelRegistry,
+    WARMUP_GATE,
+    compile_count,
+    percentiles,
+    publish_model,
+    run_loadgen,
+    warm,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_serving(monkeypatch):
+    """Endpoint/gate/provider state is process-wide; reset per test."""
+    monkeypatch.delenv(server.METRICS_PORT_ENV, raising=False)
+    server.stop()
+    yield
+    server.stop()
+
+
+def feature_frame(rows: int, dim: int = 4, seed: int = 0) -> DataFrame:
+    rng = np.random.default_rng(seed)
+    return DataFrame(["features"], [DataTypes.vector()],
+                     [Row([DenseVector(rng.normal(size=dim))])
+                      for _ in range(rows)])
+
+
+class SumServable(TransformerServable):
+    """Deterministic host servable: pred = sum(features) — exact
+    per-row correctness is assertable through batching/padding."""
+
+    features_col = "features"
+    prediction_col = "pred"
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        vals = [float(np.sum(r.get(0).to_array())) for r in df.collect()]
+        df.add_column("pred", DataTypes.DOUBLE, vals)
+        return df
+
+
+def lr_servable(dim: int, version: int = 1, device: bool = True,
+                coef=None) -> LogisticRegressionModelServable:
+    servable = LogisticRegressionModelServable()
+    if device:
+        servable.set_device_predict(True)
+    servable.model_data = LogisticRegressionModelData(
+        np.arange(1.0, dim + 1) if coef is None else coef, version)
+    return servable
+
+
+# -- config / admission -------------------------------------------------------
+
+def test_batcher_config_validation():
+    with pytest.raises(ValueError):
+        BatcherConfig(buckets=(8, 4))       # unsorted
+    with pytest.raises(ValueError):
+        BatcherConfig(buckets=(0, 4))       # non-positive
+    with pytest.raises(ValueError):
+        BatcherConfig(window_ms=-1)
+    cfg = BatcherConfig(buckets=(4, 16))
+    assert cfg.bucket_for(1) == 4
+    assert cfg.bucket_for(5) == 16
+    assert cfg.max_bucket == 16
+    unbucketed = BatcherConfig(buckets=None)
+    assert unbucketed.bucket_for(7) == 7
+
+
+def test_batch_results_split_exactly_and_padding_discarded():
+    sv = SumServable()
+    sv.serving_name = "sum@split"
+    with MicroBatcher(sv, BatcherConfig(buckets=(8,),
+                                        window_ms=100.0)) as b:
+        frames = [feature_frame(n, seed=n) for n in (1, 3, 2)]
+        want = [[float(np.sum(r.get(0).to_array()))
+                 for r in f.collect()] for f in frames]
+        futures = [b.submit(f) for f in frames]
+        outs = [f.result(timeout=10) for f in futures]
+    for out, frame, expected in zip(outs, frames, want):
+        assert out.num_rows() == frame.num_rows()  # padding discarded
+        assert [r.get(out.get_index("pred"))
+                for r in out.collect()] == expected
+    # 1+3+2 = 6 rows pad to one 8-bucket: a single tick, 2 pad rows
+    grp = metrics.group(ML_GROUP, "serving")
+    assert grp.get_counter("batches", labels={
+        "servable": "sum@split", "bucket": "8"}) == 1
+    assert grp.get_counter("padRows",
+                           labels={"servable": "sum@split"}) == 2
+
+
+def test_queue_full_and_too_large_rejections():
+    release = threading.Event()
+
+    class SlowServable(SumServable):
+        def transform(self, df):
+            release.wait(timeout=10)
+            return SumServable.transform.__wrapped__(self, df)
+
+    sv = SlowServable()
+    sv.serving_name = "sum@full"
+    cfg = BatcherConfig(buckets=(2, 16), window_ms=0.0,
+                        max_queue_rows=4)
+    with MicroBatcher(sv, cfg) as b:
+        with pytest.raises(RejectedRequest) as exc:
+            b.submit(feature_frame(17)).result(timeout=5)
+        assert exc.value.reason == "too-large"
+        first = b.submit(feature_frame(2))   # dispatches, then blocks
+        time.sleep(0.1)
+        queued = [b.submit(feature_frame(2)),
+                  b.submit(feature_frame(2))]
+        overflow = b.submit(feature_frame(2))
+        with pytest.raises(RejectedRequest) as exc:
+            overflow.result(timeout=5)
+        assert exc.value.reason == "queue-full"
+        release.set()
+        for fut in [first] + queued:
+            assert fut.result(timeout=10).num_rows() == 2
+    grp = metrics.group(ML_GROUP, "serving")
+    assert grp.get_counter("rejected", labels={
+        "servable": "sum@full", "reason": "queue-full"}) == 1
+    assert grp.get_counter("rejected", labels={
+        "servable": "sum@full", "reason": "too-large"}) == 1
+
+
+def test_deadline_expired_in_queue_rejected():
+    gate = threading.Event()
+
+    class BlockingServable(SumServable):
+        def transform(self, df):
+            gate.wait(timeout=10)
+            return SumServable.transform.__wrapped__(self, df)
+
+    sv = BlockingServable()
+    sv.serving_name = "sum@deadline"
+    with MicroBatcher(sv, BatcherConfig(buckets=(2,),
+                                        window_ms=0.0)) as b:
+        blocker = b.submit(feature_frame(2))   # occupies the dispatcher
+        time.sleep(0.05)
+        doomed = b.submit(feature_frame(1), deadline_ms=1.0)
+        time.sleep(0.05)
+        gate.set()
+        with pytest.raises(RejectedRequest) as exc:
+            doomed.result(timeout=10)
+        assert exc.value.reason == "deadline"
+        assert blocker.result(timeout=10).num_rows() == 2
+    assert metrics.group(ML_GROUP, "serving").get_counter(
+        "rejected", labels={"servable": "sum@deadline",
+                            "reason": "deadline"}) == 1
+
+
+def test_schema_mismatch_rejected_others_served():
+    sv = SumServable()
+    sv.serving_name = "sum@schema"
+    with MicroBatcher(sv, BatcherConfig(buckets=(8,),
+                                        window_ms=30.0)) as b:
+        good = b.submit(feature_frame(2))
+        bad_df = DataFrame(["other"], [DataTypes.vector()],
+                           [Row([DenseVector([1.0, 2.0, 3.0, 4.0])])])
+        bad = b.submit(bad_df)
+        assert good.result(timeout=10).num_rows() == 2
+        with pytest.raises(RejectedRequest) as exc:
+            bad.result(timeout=10)
+        assert exc.value.reason == "schema"
+
+
+def test_stop_without_drain_rejects_queued_and_post_stop_submit():
+    sv = SumServable()
+    b = MicroBatcher(sv, BatcherConfig(buckets=(64,),
+                                       window_ms=10000.0)).start()
+    fut = b.submit(feature_frame(1))
+    b.stop(drain=False)
+    with pytest.raises(RejectedRequest) as exc:
+        fut.result(timeout=5)
+    assert exc.value.reason == "shutdown"
+    with pytest.raises(RejectedRequest):
+        b.submit(feature_frame(1)).result(timeout=5)
+
+
+def test_transform_failure_fails_batch_not_loop():
+    class FailingServable(SumServable):
+        def transform(self, df):
+            raise RuntimeError("boom")
+
+    sv = FailingServable()
+    with MicroBatcher(sv, BatcherConfig(buckets=(4,),
+                                        window_ms=1.0)) as b:
+        with pytest.raises(RuntimeError, match="boom"):
+            b.submit(feature_frame(2)).result(timeout=10)
+        # the dispatcher survived the failing batch
+        with pytest.raises(RuntimeError, match="boom"):
+            b.submit(feature_frame(1)).result(timeout=10)
+
+
+# -- serving_name threading / rejected accounting in the api seam -------------
+
+def test_serving_name_threads_into_metrics_labels():
+    sv = SumServable()
+    sv.serving_name = "sum@v7"
+    assert serving_name(sv) == "sum@v7"
+    sv.transform(feature_frame(3))
+    snap = metrics.group(ML_GROUP, "serving").snapshot()
+    assert any('servable="sum@v7"' in k
+               for k in snap["histograms"])
+
+
+def test_served_wrapper_counts_rejection_not_error():
+    class SheddingServable(TransformerServable):
+        def transform(self, df):
+            raise RejectedRequest("shed@v1", "queue-full")
+
+    before_err = metrics.group(ML_GROUP, "serving").get_counter(
+        "errors", labels={"servable": "SheddingServable"})
+    with pytest.raises(RejectedRequest):
+        SheddingServable().transform(feature_frame(1))
+    grp = metrics.group(ML_GROUP, "serving")
+    assert grp.get_counter("rejected", labels={
+        "servable": "SheddingServable",
+        "reason": "queue-full"}) == 1
+    assert grp.get_counter("errors", labels={
+        "servable": "SheddingServable"}) == before_err
+
+
+# -- warmup + readiness -------------------------------------------------------
+
+def test_warmup_compiles_every_bucket_and_steady_state_is_free():
+    compile_stats.reset()
+    sv = lr_servable(dim=9)
+    sv.serving_name = "lr@warm"
+    cfg = BatcherConfig(buckets=(4, 16), window_ms=1.0)
+    with MicroBatcher(sv, cfg) as b:
+        report = warm(b, frame_factory=lambda n: feature_frame(n, dim=9))
+        assert set(report["buckets"]) == {4, 16}
+        assert report["compiles"] == 2
+        steady = compile_count()
+        for n in (1, 3, 4, 2, 16, 9):
+            assert b.submit(feature_frame(n, dim=9)).result(
+                timeout=10).num_rows() == n
+        assert compile_count() - steady == 0
+    ready, blocked = server.readiness()
+    assert ready and not blocked
+
+
+def test_warmup_failure_keeps_readiness_gate_closed():
+    class BrokenWarm(SumServable):
+        def aot_warm(self, rows):
+            raise RuntimeError("no backend")
+
+    with pytest.raises(RuntimeError, match="no backend"):
+        warm(BrokenWarm(), buckets=(4,))
+    ready, blocked = server.readiness()
+    assert not ready
+    assert "warmup failed" in blocked[WARMUP_GATE]
+    server.set_gate(WARMUP_GATE, True)
+    assert server.readiness()[0]
+
+
+def test_healthz_503_until_warm_and_serving_route(monkeypatch):
+    monkeypatch.setenv(server.METRICS_PORT_ENV, "0")
+    srv = server.maybe_start()
+    assert srv is not None
+
+    def get(route):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{route}",
+                    timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    status, body = get("/healthz")
+    assert status == 200 and body["status"] == "ok"
+    server.set_gate(WARMUP_GATE, False, "warming 3 bucket shape(s)")
+    status, body = get("/healthz")
+    assert status == 503 and body["status"] == "unready"
+    assert body["reasons"][WARMUP_GATE] == "warming 3 bucket shape(s)"
+    server.set_gate(WARMUP_GATE, True)
+    assert get("/healthz")[0] == 200
+
+    assert get("/serving") == (200, {"serving": None})
+    sv = SumServable()
+    sv.serving_name = "sum@live"
+    with MicroBatcher(sv, BatcherConfig(buckets=(4, 8),
+                                        window_ms=1.0)) as b:
+        b.submit(feature_frame(2)).result(timeout=10)
+        status, body = get("/serving")
+        assert status == 200
+        live = body["serving"]
+        assert live["servable"] == "sum@live"
+        assert live["buckets"] == [4, 8]
+        assert live["queue"]["rows"] == 0
+        assert live["ticks"] >= 1 and live["running"]
+    assert get("/serving") == (200, {"serving": None})
+
+
+# -- the shape-stability acceptance pair --------------------------------------
+
+def test_500_request_mixed_size_run_has_zero_steady_compiles():
+    """The bucketing contract: after warmup, steady-state serving never
+    recompiles — 500 mixed-size requests, compile delta exactly 0."""
+    compile_stats.reset()
+    sv = lr_servable(dim=11)
+    sv.serving_name = "lr@steady"
+    cfg = BatcherConfig(buckets=(8, 32), window_ms=1.0)
+    sizes = (1, 2, 3, 5, 8, 13, 21, 32)
+    with MicroBatcher(sv, cfg) as b:
+        warm(b, frame_factory=lambda n: feature_frame(n, dim=11))
+        steady = compile_count()
+        res = run_loadgen(
+            b.submit,
+            lambda i: feature_frame(sizes[i % len(sizes)], dim=11,
+                                    seed=i),
+            LoadGenConfig(mode="closed", requests=500, concurrency=16))
+    assert res["ok"] == 500 and res["errors"] == 0
+    assert compile_count() - steady == 0, \
+        "steady-state serving recompiled despite bucketing"
+
+
+def test_unbucketed_serving_recompiles_and_storms(monkeypatch):
+    """The negative contract: without the bucket table every distinct
+    batch size is a fresh XLA compile, and the recompile-storm detector
+    fires — why bucketing is not optional in production."""
+    compile_stats.reset()
+    monkeypatch.setenv("FLINK_ML_TPU_COMPILE_STORM_N", "5")
+    sv = lr_servable(dim=13)
+    sv.serving_name = "lr@storm"
+    cfg = BatcherConfig(buckets=None, window_ms=0.0)
+    with MicroBatcher(sv, cfg) as b:
+        steady = compile_count()
+        for n in range(1, 10):  # 9 distinct shapes, sequentially
+            b.submit(feature_frame(n, dim=13)).result(timeout=10)
+        compiles = compile_count() - steady
+    assert compiles >= 9
+    assert metrics.group(ML_GROUP, "compile").get_counter(
+        "storms", labels={"fn": "lr.predict"}) >= 1
+
+
+# -- model registry: hot-swap safety ------------------------------------------
+
+def make_registry(tmp_path, dim=6, **kwargs):
+    def loader(leaves, version):
+        return lr_servable(dim, version, coef=np.asarray(leaves[0]))
+
+    kwargs.setdefault("probe", lambda: feature_frame(4, dim=dim))
+    return ModelRegistry(str(tmp_path / "models"), loader, model="lr",
+                         **kwargs)
+
+
+def test_registry_adopts_published_versions_in_order(tmp_path):
+    reg = make_registry(tmp_path)
+    assert reg.active is None and not reg.poll()
+    publish_model(reg.watch_dir, [np.arange(1.0, 7.0)], 1)
+    assert reg.poll() and reg.version == 1
+    assert reg.active.serving_name == "lr@v1"
+    assert not reg.poll()  # idempotent: nothing newer
+    publish_model(reg.watch_dir, [np.arange(2.0, 8.0)], 2)
+    publish_model(reg.watch_dir, [np.arange(3.0, 9.0)], 3)
+    assert reg.poll() and reg.version == 3  # newest wins
+    assert metrics.group(ML_GROUP, "serving").get_gauge(
+        "modelVersion", labels={"model": "lr"}) == 3
+
+
+def test_bit_flipped_checkpoint_quarantined_never_served(tmp_path):
+    reg = make_registry(tmp_path)
+    publish_model(reg.watch_dir, [np.arange(1.0, 7.0)], 1)
+    assert reg.poll()
+    v1 = reg.active
+    path = publish_model(reg.watch_dir, [np.arange(9.0, 15.0)], 2)
+    leaves = os.path.join(path, "leaves.npz")
+    blob = bytearray(open(leaves, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(leaves, "wb").write(bytes(blob))
+    assert not reg.poll()
+    assert reg.version == 1 and reg.active is v1  # rollback: untouched
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")  # evidence kept
+    assert metrics.group(ML_GROUP, "serving").get_counter(
+        "swapRejected", labels={"model": "lr",
+                                "reason": "corrupt"}) >= 1
+
+
+def test_nan_candidate_rejected_and_not_reprobed(tmp_path):
+    reg = make_registry(tmp_path)
+    publish_model(reg.watch_dir, [np.arange(1.0, 7.0)], 1)
+    assert reg.poll()
+    publish_model(reg.watch_dir, [np.full(6, np.nan)], 2)
+    assert not reg.poll()
+    assert reg.version == 1
+    grp = metrics.group(ML_GROUP, "serving")
+    rejected = grp.get_counter("swapRejected", labels={
+        "model": "lr", "reason": "non-finite"})
+    assert rejected == 1
+    assert not reg.poll()  # remembered: no re-probe loop
+    assert grp.get_counter("swapRejected", labels={
+        "model": "lr", "reason": "non-finite"}) == rejected
+    # a later GOOD version recovers
+    publish_model(reg.watch_dir, [np.arange(2.0, 8.0)], 3)
+    assert reg.poll() and reg.version == 3
+
+
+def test_nan_producing_candidate_rejected_by_probe_gauges(tmp_path):
+    """Finite leaves, NaN output: the PR 5 prediction-distribution
+    gauges written by the probe transform are the reject signal."""
+
+    class NanServable(TransformerServable):
+        prediction_col = "prediction"
+
+        def transform(self, df):
+            df.add_column("prediction", DataTypes.DOUBLE,
+                          [float("nan")] * df.num_rows())
+            return df
+
+    def loader(leaves, version):
+        return (lr_servable(6, version, coef=np.asarray(leaves[0]))
+                if version == 1 else NanServable())
+
+    reg = ModelRegistry(str(tmp_path / "models"), loader, model="lr",
+                        probe=lambda: feature_frame(4, dim=6))
+    publish_model(reg.watch_dir, [np.arange(1.0, 7.0)], 1)
+    assert reg.poll()
+    publish_model(reg.watch_dir, [np.arange(1.0, 7.0)], 2)
+    assert not reg.poll()
+    assert reg.version == 1
+    assert metrics.group(ML_GROUP, "serving").get_counter(
+        "swapRejected", labels={"model": "lr",
+                                "reason": "probe-non-finite"}) >= 1
+
+
+def test_custom_health_check_gates_swap(tmp_path):
+    verdicts = iter([False, True])
+    reg = make_registry(tmp_path,
+                        health_check=lambda sv: next(verdicts))
+    publish_model(reg.watch_dir, [np.arange(1.0, 7.0)], 1)
+    assert not reg.poll()  # first verdict: rejected
+    publish_model(reg.watch_dir, [np.arange(2.0, 8.0)], 2)
+    assert reg.poll() and reg.version == 2
+
+
+def test_inflight_request_completes_on_old_version_during_swap(tmp_path):
+    entered = threading.Event()
+    release = threading.Event()
+
+    class MarkerServable(TransformerServable):
+        def __init__(self, version):
+            self.version = version
+
+        def transform(self, df):
+            if self.version == 1:
+                entered.set()
+                release.wait(timeout=10)
+            df.add_column("modelVersion", DataTypes.INT,
+                          [self.version] * df.num_rows())
+            return df
+
+    reg = ModelRegistry(str(tmp_path / "models"),
+                        lambda leaves, v: MarkerServable(v), model="m")
+    publish_model(reg.watch_dir, [np.ones(2)], 1)
+    assert reg.poll()
+    with MicroBatcher(reg, BatcherConfig(buckets=(4,),
+                                         window_ms=0.0)) as b:
+        inflight = b.submit(feature_frame(1))
+        assert entered.wait(timeout=10)  # v1 transform is mid-flight
+        publish_model(reg.watch_dir, [np.ones(2)], 2)
+        assert reg.poll() and reg.version == 2  # swap DURING dispatch
+        release.set()
+        out = inflight.result(timeout=10)
+        assert out.get("modelVersion").values == [1]  # old version
+        after = b.submit(feature_frame(1)).result(timeout=10)
+        assert after.get("modelVersion").values == [2]  # new version
+
+
+def test_registry_watcher_thread_swaps_in_background(tmp_path):
+    reg = make_registry(tmp_path, poll_interval_s=0.02)
+    publish_model(reg.watch_dir, [np.arange(1.0, 7.0)], 1)
+    with reg:
+        deadline = time.monotonic() + 10
+        while reg.version != 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert reg.version == 1
+        publish_model(reg.watch_dir, [np.arange(2.0, 8.0)], 2)
+        while reg.version != 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert reg.version == 2
+
+
+def test_candidate_rejected_is_terminal():
+    exc = CandidateRejected("lr", 4, "non-finite")
+    assert RetryPolicy().classify(exc) == TERMINAL
+    assert "lr@v4" in str(exc) and exc.reason == "non-finite"
+
+
+# -- loadgen ------------------------------------------------------------------
+
+def test_percentiles_exact_and_empty():
+    assert percentiles([])["p99"] is None
+    p = percentiles([float(i) for i in range(1, 101)])
+    assert p["p50"] == 50.0 and p["p99"] == 99.0 and p["max"] == 100.0
+
+
+def test_loadgen_classifies_ok_rejected_error():
+    calls = [0]
+    lock = threading.Lock()
+
+    def submit(frame):
+        with lock:
+            calls[0] += 1
+            i = calls[0]
+        if i % 3 == 0:
+            raise RejectedRequest("sv", "queue-full")
+        if i % 3 == 1:
+            raise ValueError("bad input")
+        return frame
+
+    res = run_loadgen(submit, lambda i: feature_frame(1),
+                      LoadGenConfig(mode="closed", requests=9,
+                                    concurrency=3))
+    assert res["ok"] == 3 and res["rejected"] == 3 and res["errors"] == 3
+    assert res["rejectedByReason"] == {"queue-full": 3}
+    assert res["errorsByClass"] == {"ValueError": 3}
+    assert res["latency_ms"]["p99"] is not None
+
+
+def test_loadgen_open_loop_paces_and_completes():
+    ticks = []
+    res = run_loadgen(lambda f: f, lambda i: feature_frame(1),
+                      LoadGenConfig(mode="open", requests=40, rps=400.0),
+                      tick=lambda n: ticks.append(n))
+    assert res["ok"] == 40 and res["skipped"] == 0
+    assert res["wall_s"] >= 40 / 400.0 * 0.8  # schedule actually paced
+    assert len(ticks) == 40
+
+
+def test_zero_row_request_rejected_empty():
+    sv = SumServable()
+    with MicroBatcher(sv, BatcherConfig(window_ms=1.0)) as b:
+        empty = DataFrame(["features"], [DataTypes.vector()], [])
+        with pytest.raises(RejectedRequest) as exc:
+            b.submit(empty).result(timeout=5)
+        assert exc.value.reason == "empty"
+
+
+def test_serving_provider_survives_overlapping_batchers():
+    a, b = SumServable(), SumServable()
+    a.serving_name, b.serving_name = "sum@a", "sum@b"
+    batcher_a = MicroBatcher(a, BatcherConfig(window_ms=1.0)).start()
+    # the benchmark-sweep shape: a short-lived batcher runs BESIDE the
+    # main one, then hands the /serving route back on stop
+    batcher_b = MicroBatcher(b, BatcherConfig(window_ms=1.0)).start()
+    assert server.get_serving_status()()["servable"] == "sum@b"
+    batcher_b.stop()
+    provider = server.get_serving_status()
+    assert provider is not None
+    assert provider()["servable"] == "sum@a"  # handed back, not null
+    # and a stop out of registration order never clobbers a newer one
+    batcher_c = MicroBatcher(b, BatcherConfig(window_ms=1.0)).start()
+    batcher_a.stop()
+    assert server.get_serving_status()()["servable"] == "sum@b"
+    batcher_c.stop()
+
+
+def test_corrupt_manifest_wrong_shape_rejected_not_crashed(tmp_path):
+    """A manifest that is valid JSON but the wrong shape (missing
+    'epoch') must reject as corrupt through the registry, never escape
+    as KeyError past poll()'s never-raises contract."""
+    from flink_ml_tpu.iteration.checkpoint import (
+        CorruptCheckpoint,
+        load_validated,
+    )
+
+    reg = make_registry(tmp_path)
+    publish_model(reg.watch_dir, [np.arange(1.0, 7.0)], 1)
+    assert reg.poll()
+    path = publish_model(reg.watch_dir, [np.arange(2.0, 8.0)], 2)
+    manifest = os.path.join(path, "manifest.json")
+    doc = json.load(open(manifest))
+    del doc["epoch"]
+    json.dump(doc, open(manifest, "w"))
+    with pytest.raises(CorruptCheckpoint):
+        load_validated(path)
+    assert not reg.poll()
+    assert reg.version == 1
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_registry_never_raises_on_broken_loader_and_remembers(tmp_path):
+    """poll()'s never-raises contract covers failures BETWEEN load and
+    swap too: a loader returning an object that rejects the
+    serving_name assignment is rejected internal-error, remembered, and
+    the watcher does not re-probe it forever."""
+
+    class Slotted:
+        __slots__ = ("version",)
+
+        def __init__(self, version):
+            self.version = version
+
+    reg = ModelRegistry(str(tmp_path / "models"),
+                        lambda leaves, v: Slotted(v), model="m")
+    publish_model(reg.watch_dir, [np.ones(2)], 1)
+    assert not reg.poll()  # rejected, not raised
+    assert reg.version is None
+    grp = metrics.group(ML_GROUP, "serving")
+    count = grp.get_counter("swapRejected", labels={
+        "model": "m", "reason": "internal-error"})
+    assert count >= 1
+    assert not reg.poll()  # remembered — no re-probe loop
+    assert grp.get_counter("swapRejected", labels={
+        "model": "m", "reason": "internal-error"}) == count
+
+
+def test_loadgen_tick_exception_propagates_to_caller():
+    def tick(n):
+        if n == 3:
+            raise SystemExit(1)
+
+    with pytest.raises(SystemExit):
+        run_loadgen(lambda f: f, lambda i: feature_frame(1),
+                    LoadGenConfig(mode="closed", requests=6,
+                                  concurrency=2), tick=tick)
+
+
+def test_batcher_config_from_env(monkeypatch):
+    from flink_ml_tpu.serving import (
+        BUCKETS_ENV,
+        DEADLINE_ENV,
+        WINDOW_ENV,
+    )
+
+    monkeypatch.setenv(BUCKETS_ENV, "4,16,64")
+    monkeypatch.setenv(WINDOW_ENV, "2.5")
+    monkeypatch.setenv(DEADLINE_ENV, "none")
+    cfg = BatcherConfig.from_env()
+    assert cfg.buckets == (4, 16, 64)
+    assert cfg.window_ms == 2.5 and cfg.deadline_ms is None
+    # overrides win over env
+    assert BatcherConfig.from_env(window_ms=9.0).window_ms == 9.0
+    monkeypatch.setenv(BUCKETS_ENV, "none")
+    assert BatcherConfig.from_env().buckets is None
+    monkeypatch.setenv(BUCKETS_ENV, "eight")
+    with pytest.raises(ValueError, match=BUCKETS_ENV):
+        BatcherConfig.from_env()
+
+
+def test_loadgen_config_validation():
+    with pytest.raises(ValueError):
+        LoadGenConfig(mode="burst")
+    with pytest.raises(ValueError):
+        LoadGenConfig(mode="open", rps=0)
+    with pytest.raises(ValueError):
+        LoadGenConfig(requests=0)
