@@ -207,6 +207,11 @@ def _worker(role: str) -> int:
                         # mesh provenance: 1-device fallback vs real mesh
                         "deviceCount": best.get("deviceCount"),
                         "meshShape": best.get("meshShape"),
+                        # replicated vs cross-replica sharded update
+                        # (parallel/update_sharding.py)
+                        "updateSharding": best.get("updateSharding"),
+                        "optStateBytesPerReplica": best.get(
+                            "optStateBytesPerReplica"),
                     }
                     if "executionPath" in best:
                         out[name]["executionPath"] = best["executionPath"]
@@ -236,6 +241,12 @@ def _worker(role: str) -> int:
         # number actually measured
         "device_count": best.get("deviceCount"),
         "mesh_shape": best.get("meshShape"),
+        # whether the fit ran the cross-replica sharded update and the
+        # per-replica update-state bytes it recorded — a throughput
+        # number with 1/N optimizer memory is a different machine state
+        # than a replicated one (parallel/update_sharding.py)
+        "update_sharding": best.get("updateSharding"),
+        "opt_state_bytes_per_replica": best.get("optStateBytesPerReplica"),
         # compile/steady split: the warmup's compile bill (excluded from
         # the measured number, as the JVM baseline excludes JIT warmup)
         # and the measured run's own compile count, which should be 0 —

@@ -6,6 +6,7 @@ from flink_ml_tpu.analysis.rules import (  # noqa: F401
     hostsync,
     metrics_in_jit,
     native_contract,
+    raw_collective,
     recompile,
     rng,
     tracing,

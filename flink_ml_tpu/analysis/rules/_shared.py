@@ -9,8 +9,37 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 from flink_ml_tpu.analysis.core import FileContext, call_name, dotted_name
 
 #: callables that trace their operand (matched on the final component, so
-#: jax.jit / jit / jax.experimental.shard_map.shard_map all count)
-JIT_NAMES = {"jit", "pjit", "pmap", "vmap", "shard_map"}
+#: jax.jit / jit / jax.experimental.shard_map.shard_map all count).
+#: map_shards is the repo's own SPMD seam (parallel/mapreduce.py): a body
+#: wrapped by it is traced exactly like a shard_map body, so the traced-
+#: code rules (JL101/JL107/...) must see through it too.
+JIT_NAMES = {"jit", "pjit", "pmap", "vmap", "shard_map", "map_shards"}
+
+#: composition methods whose FUNCTION-VALUED positional args are all
+#: traced (MapReduceProgram.build(map_fn, update_fn, ...) — both bodies
+#: run inside the composed SPMD program); matched on the final
+#: component, but ONLY in files that import the mapreduce layer —
+#: "build" is far too generic a method name to match globally (an
+#: unrelated `router.build(on_host_event)` must not mark host code as
+#: traced)
+COMPOSE_NAMES = {"build"}
+
+
+def _imports_mapreduce(ctx: FileContext) -> bool:
+    """True when the file imports the map-reduce layer (module path
+    containing ``mapreduce``, or ``MapReduceProgram`` by name) — the
+    gate for COMPOSE_NAMES recognition."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            if any("mapreduce" in alias.name for alias in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and "mapreduce" in node.module:
+                return True
+            if any(alias.name in ("mapreduce", "MapReduceProgram")
+                   for alias in node.names):
+                return True
+    return False
 
 
 def _is_jit_callee(node: ast.AST) -> bool:
@@ -78,14 +107,35 @@ def jitted_functions(ctx: FileContext
                 if statics is not None:
                     yield node, statics[0], statics[1]
     seen = set()
+    compose_active = _imports_mapreduce(ctx)
     for node in ast.walk(ctx.tree):
-        if isinstance(node, ast.Call) and _is_jit_callee(node.func) \
-                and node.args and isinstance(node.args[0], ast.Name):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_jit_callee(node.func) and node.args \
+                and isinstance(node.args[0], ast.Name):
             argnums, argnames = _literal_statics(node.keywords)
             for fn in defs_by_name.get(node.args[0].id, ()):
                 if id(fn) not in seen:
                     seen.add(id(fn))
                     yield fn, argnums, argnames
+            continue
+        # MapReduceProgram.build(map_fn, update_fn, ...): EVERY
+        # function-valued positional arg becomes part of the composed
+        # traced program — without this the fit bodies migrated from
+        # direct shard_map wraps onto the builder would silently lose
+        # JL101/JL107 coverage
+        if not compose_active:
+            continue
+        callee = dotted_name(node.func)
+        if callee is not None and \
+                callee.rsplit(".", 1)[-1] in COMPOSE_NAMES:
+            for arg in node.args:
+                if not isinstance(arg, ast.Name):
+                    continue
+                for fn in defs_by_name.get(arg.id, ()):
+                    if id(fn) not in seen:
+                        seen.add(id(fn))
+                        yield fn, set(), set()
 
 
 def traced_params(fn: ast.FunctionDef, static_argnums: Set[int],

@@ -1,0 +1,127 @@
+"""Rule JL108 ``raw-collective``: raw XLA collectives / shard_map outside
+the parallel layer.
+
+Fit programs must build through the NAMED seams —
+``flink_ml_tpu/parallel/mapreduce.py`` primitives (``reduce_sum``,
+``reduce_scatter``, ``all_gather``, ``broadcast``, ``shard_index``) and
+``map_shards`` — not raw ``jax.lax.psum``-family collectives or a direct
+``shard_map`` wrap. The seams are where three guarantees live, and a raw
+call silently forfeits all of them:
+
+- version portability (``jax.shard_map`` vs
+  ``jax.experimental.shard_map`` vs ``check_rep``/``check_vma`` — the
+  skew that froze 90 tier-1 tests for five PRs);
+- trace-time ``ml.collective`` accounting + mesh telemetry
+  (docs/observability.md "Distributed telemetry") — a raw psum is
+  invisible to ``mltrace shards`` and the payload budget;
+- the cross-replica sharded update (update_sharding.py) composes from
+  the named primitives; a raw collective bypasses its 1/N state
+  accounting.
+
+Files under ``flink_ml_tpu/parallel/`` are exempt — they ARE the seams.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import PurePath
+from typing import Dict, Iterator
+
+from flink_ml_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    call_name,
+    register,
+)
+
+#: jax.lax members whose raw use is the hazard (the named seam for each
+#: lives in parallel/collective.py / parallel/mapreduce.py)
+_RAW_LAX = {"psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+            "all_to_all", "ppermute", "pshuffle", "axis_index"}
+
+#: seam suggested per raw call, surfaced in the message
+_SEAM_OF = {
+    "psum": "mapreduce.reduce_sum", "pmean": "mapreduce.reduce_mean",
+    "pmax": "mapreduce.reduce_max", "pmin": "a mapreduce reducer",
+    "psum_scatter": "mapreduce.reduce_scatter",
+    "all_gather": "mapreduce.all_gather",
+    "all_to_all": "parallel.sequence's seams",
+    "ppermute": "parallel.sequence's seams",
+    "pshuffle": "parallel.sequence's seams",
+    "axis_index": "mapreduce.shard_index",
+}
+
+
+def _exempt_path(path: str) -> bool:
+    """True for the seam implementation itself: any file under a
+    ``parallel`` package directory (flink_ml_tpu/parallel/...)."""
+    return "parallel" in PurePath(path).parts
+
+
+def _import_origins(tree: ast.AST) -> Dict[str, str]:
+    """Alias → fully-dotted origin for every import in the file, so a
+    bare ``psum`` from ``from jax.lax import psum`` (or an ``as`` alias)
+    resolves to ``jax.lax.psum``."""
+    origins: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                origins[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name if alias.asname else alias.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                origins[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+    return origins
+
+
+def _resolve(name: str, origins: Dict[str, str]) -> str:
+    """``lax.psum`` → ``jax.lax.psum`` given ``from jax import lax``."""
+    head, _, rest = name.partition(".")
+    origin = origins.get(head, head)
+    return f"{origin}.{rest}" if rest else origin
+
+
+@register
+class RawCollectiveRule(Rule):
+    name = "raw-collective"
+    code = "JL108"
+    rationale = (
+        "raw jax.lax collectives / direct shard_map outside "
+        "flink_ml_tpu/parallel/ bypass the named seams — version "
+        "portability, ml.collective accounting and the sharded-update "
+        "composition all live there; build through parallel/mapreduce.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if _exempt_path(ctx.path):
+            return
+        origins = _import_origins(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            # resolve through the import table FIRST so aliases
+            # (`from jax.lax import psum as p`) are still raw psums
+            resolved = _resolve(name, origins)
+            last = resolved.rsplit(".", 1)[-1]
+            if last == "shard_map":
+                # ANY direct shard_map wrap — the jax APIs and the
+                # version-portable parallel/shardmap seam alike: fit
+                # programs go through mapreduce.map_shards, which adds
+                # the jit/donation/telemetry layer on top
+                yield self.finding(
+                    ctx, node,
+                    "direct `shard_map(...)` outside flink_ml_tpu/"
+                    "parallel/ — build the SPMD program through "
+                    "`parallel/mapreduce.map_shards` (the named seam "
+                    "with mesh telemetry, portability and donation)")
+                continue
+            if last in _RAW_LAX and resolved.startswith("jax.lax."):
+                yield self.finding(
+                    ctx, node,
+                    f"raw `jax.lax.{last}(...)` outside flink_ml_tpu/"
+                    f"parallel/ — use `{_SEAM_OF[last]}` so the op is "
+                    "version-portable and counted in ml.collective")

@@ -103,6 +103,12 @@ def run_benchmark(name: str, spec: dict) -> dict:
 
 
 def _run_benchmark(name: str, spec: dict) -> dict:
+    try:  # a row must carry only ITS OWN run's update-state provenance
+        from flink_ml_tpu.parallel import update_sharding
+
+        update_sharding.reset_last()
+    except Exception:  # noqa: BLE001 — provenance only
+        pass
     stage = resolve_stage(spec["stage"]["className"])()
     stage.params_from_json(spec["stage"].get("paramMap", {}), strict=True)
 
@@ -177,17 +183,23 @@ def _run_benchmark(name: str, spec: dict) -> dict:
 
 def _mesh_provenance() -> dict:
     """``deviceCount`` + ``meshShape`` of the default mesh the benchmark
-    actually ran on (``"data=8"`` style) — benchmark rows must say
-    whether their number is a 1-device cpu fallback or a real mesh.
-    Never fails a finished measurement: if the mesh is somehow
-    unavailable the keys are simply absent."""
+    actually ran on (``"data=8"`` style), plus ``updateSharding``
+    (whether the cross-replica sharded update was armed —
+    parallel/update_sharding.py) and ``optStateBytesPerReplica`` (the
+    per-replica update-state bytes the fit recorded; shrinks ~1/N when
+    sharding is on) — benchmark rows must say whether their number is a
+    1-device cpu fallback or a real mesh, and whether optimizer state
+    was replicated or sharded. Never fails a finished measurement: if
+    the mesh is somehow unavailable the keys are simply absent."""
     try:
+        from flink_ml_tpu.parallel import update_sharding
         from flink_ml_tpu.parallel.mesh import default_mesh
 
         mesh = default_mesh()
         return {"deviceCount": int(mesh.devices.size),
                 "meshShape": ",".join(f"{a}={int(mesh.shape[a])}"
-                                      for a in mesh.axis_names)}
+                                      for a in mesh.axis_names),
+                **update_sharding.provenance()}
     except Exception:  # noqa: BLE001 — provenance only
         return {}
 

@@ -34,13 +34,15 @@ from jax.sharding import PartitionSpec as P
 from flink_ml_tpu.api.stage import Estimator, Model
 from flink_ml_tpu.common.table import Table, as_dense_vector_column
 from flink_ml_tpu.linalg.distance import DistanceMeasure
-from flink_ml_tpu.parallel.collective import (
-    all_reduce_sum,
-    ensure_on_mesh,
-    local_valid_mask,
+from flink_ml_tpu.parallel import mapreduce as mr
+from flink_ml_tpu.parallel import update_sharding as _upd
+from flink_ml_tpu.parallel.collective import ensure_on_mesh
+from flink_ml_tpu.parallel.mesh import (
+    data_axes,
+    data_pspec,
+    data_shard_count,
+    default_mesh,
 )
-from flink_ml_tpu.parallel.mesh import data_axes, data_pspec, default_mesh
-from flink_ml_tpu.parallel.shardmap import shard_map
 from flink_ml_tpu.params.param import IntParam, ParamValidators, StringParam
 from flink_ml_tpu.params.shared import (
     HasDistanceMeasure,
@@ -76,21 +78,30 @@ def _build_assign_program(measure_name: str):
     return assign
 
 
-def _lloyd_round_math(measure, axes, partials_fn=None):
+def _lloyd_round_math(measure, axes, partials_fn=None,
+                      sharded: bool = False):
     """The per-shard math of ONE Lloyd round — shared verbatim by the
     all-device programs and the host-driven round program so every mode
     stays numerically identical by construction. Must be called inside
-    shard_map over the mesh's data axes (flat or dcn-hybrid).
+    a ``mapreduce.map_shards`` body over the mesh's data axes (flat or
+    dcn-hybrid).
 
     ``partials_fn(xl, vl, centroids) -> (k, d+1)`` overrides how the
     local [weighted sums | counts] partials are computed (the fused
-    pallas kernel); the cross-shard psum and the empty-cluster-preserving
-    renormalization stay shared either way. Caveat scoping the identity
-    claim: the kernel's csq − 2·x·cᵀ assignment can differ from
-    ``measure.pairwise`` in float rounding for near-tie points, so a
-    kernel-partialed fit matches the XLA programs up to tie-breaks (the
-    same asymmetry the predict path accepts for ``assign_nearest``) —
-    modes sharing ``partials_fn=None`` remain bit-identical."""
+    pallas kernel); the cross-shard reduction and the empty-cluster-
+    preserving renormalization stay shared either way. Caveat scoping
+    the identity claim: the kernel's csq − 2·x·cᵀ assignment can differ
+    from ``measure.pairwise`` in float rounding for near-tie points, so
+    a kernel-partialed fit matches the XLA programs up to tie-breaks
+    (the same asymmetry the predict path accepts for ``assign_nearest``)
+    — modes sharing ``partials_fn=None`` remain bit-identical.
+
+    With ``sharded`` (update_sharding.py) the centroid update is
+    cross-replica sharded: the (k, d+1) partials reduce-scatter over
+    centroid rows (padded to the shard multiple — padded rows count 0
+    and are trimmed), each replica renormalizes only its own rows, and
+    the fresh centroids all-gather. Per-replica update FLOPs scale
+    1/N; the carry stays (k, d), so every caller is unchanged."""
 
     def local_partials(xl, vl, centroids):
         k = centroids.shape[0]
@@ -100,14 +111,30 @@ def _lloyd_round_math(measure, axes, partials_fn=None):
         return jnp.concatenate(
             [one_hot.T @ xl, jnp.sum(one_hot, axis=0)[:, None]], axis=1)
 
-    def round_step(xl, vl, centroids):
-        packed = (partials_fn or local_partials)(xl, vl, centroids)
-        packed = all_reduce_sum(packed, axes)
-        sums, counts = packed[:, :-1], packed[:, -1]
-        new_centroids = jnp.where(
+    def renormalize(sums, counts, centroids):
+        # ref CentroidsUpdateAccumulator; empty clusters keep position
+        return jnp.where(
             counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1),
             centroids)
-        return new_centroids, counts
+
+    def round_step(xl, vl, centroids):
+        packed = (partials_fn or local_partials)(xl, vl, centroids)
+        if sharded:
+            k = centroids.shape[0]
+            kp = _upd.padded_len(k, mr.shard_count(axes))
+
+            def apply_fn(p_slice, c_slice, _state):
+                sums, counts = p_slice[:, :-1], p_slice[:, -1]
+                new_c = renormalize(sums, counts, c_slice)
+                return (new_c, counts[:, None]), None
+
+            (new_c, counts_col), _ = _upd.sharded_apply(
+                axes, _upd.pad_leading(packed, kp),
+                _upd.pad_leading(centroids, kp), None, apply_fn)
+            return new_c[:k], counts_col[:k, 0]
+        packed = mr.reduce_sum(packed, axes)
+        sums, counts = packed[:, :-1], packed[:, -1]
+        return renormalize(sums, counts, centroids), counts
 
     return round_step
 
@@ -115,7 +142,7 @@ def _lloyd_round_math(measure, axes, partials_fn=None):
 @functools.lru_cache(maxsize=32)
 def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
                          unroll: bool = False, use_kernel: bool = False,
-                         health: bool = False):
+                         health: bool = False, sharded: bool = False):
     """One compiled Lloyd's program per (mesh, measure, maxIter); k and
     shapes are trace-time static, handled by jit's shape cache. With
     ``unroll`` the static round count compiles as a straight-line Python
@@ -139,11 +166,12 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
         from flink_ml_tpu.ops.pallas_kernels import lloyd_partial_sums
         partials_fn = lloyd_partial_sums
     round_step = _lloyd_round_math(
-        DistanceMeasure.get_instance(measure_name), axes, partials_fn)
+        DistanceMeasure.get_instance(measure_name), axes, partials_fn,
+        sharded=sharded)
 
     def per_shard(xl, n_valid, c0):
         k = c0.shape[0]
-        vl = local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
+        vl = mr.local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
         if use_kernel:
             from flink_ml_tpu.ops.pallas_kernels import TILE_N
             pad = (-xl.shape[0]) % TILE_N
@@ -181,10 +209,15 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
         packed = jnp.concatenate([centroids, counts[:, None]], axis=1)
         return (packed, shifts) if health else packed
 
-    return jax.jit(shard_map(
-        per_shard, mesh=mesh,
+    # no donation here: the program's one packed output is (k, d+1) —
+    # no input buffer matches it, so a donated c0 would just warn.
+    # The donated sharded-update carries live in the SGD/FTRL programs,
+    # whose state flows through with identical shapes.
+    return mr.map_shards(
+        per_shard, mesh,
         in_specs=(P(spec0, None), P(), P()),
-        out_specs=((P(), P()) if health else P()), check_vma=False))
+        out_specs=((P(), P()) if health else P()),
+        name="kmeans.lloyd" if sharded else None)
 
 
 #: fits with at most this many rounds compile fully unrolled — Lloyd's has
@@ -197,22 +230,24 @@ _UNROLL_MAX_ROUNDS = int(os.environ.get(
 
 
 @functools.lru_cache(maxsize=32)
-def _build_lloyd_round_program(mesh, measure_name: str):
-    """ONE Lloyd round — the building block of the checkpointable host loop;
-    wraps the same _lloyd_round_math as the all-device program."""
+def _build_lloyd_round_program(mesh, measure_name: str,
+                               sharded: bool = False):
+    """ONE Lloyd round — the building block of the checkpointable host
+    loop; wraps the same _lloyd_round_math as the all-device program
+    (iterate_bounded jits the round, hence ``jit=False``)."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     round_step = _lloyd_round_math(
-        DistanceMeasure.get_instance(measure_name), axes)
+        DistanceMeasure.get_instance(measure_name), axes, sharded=sharded)
 
     def per_shard(xl, n_valid, centroids):
-        vl = local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
+        vl = mr.local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
         return round_step(xl, vl, centroids)
 
-    return shard_map(
-        per_shard, mesh=mesh,
+    return mr.map_shards(
+        per_shard, mesh,
         in_specs=(P(spec0, None), P(), P()),
-        out_specs=(P(), P()), check_vma=False)
+        out_specs=(P(), P()), jit=False)
 
 
 # set on the first pallas lowering failure so later transforms skip straight
@@ -338,6 +373,9 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                                                       needs_host_loop)
         from flink_ml_tpu.observability import health as _health
         health_on = _health.armed()
+        # cross-replica sharded centroid update (update_sharding.py):
+        # per-replica update FLOPs scale 1/N; carry shape unchanged
+        sharded = _upd.enabled()
         shifts = None
         if not needs_host_loop(self._iteration_config,
                                self._iteration_listeners):
@@ -354,7 +392,7 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                 fit = _build_lloyd_program(
                     mesh, self.distance_measure, self.max_iter,
                     unroll=unroll, use_kernel=use_kernel,
-                    health=health_on)
+                    health=health_on, sharded=sharded)
                 out = fit(xs, n_valid, jnp.asarray(init))
                 packed, shifts = out if health_on else (out, None)
                 return np.asarray(packed), shifts
@@ -390,7 +428,8 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
         else:
 
             round_fn = _build_lloyd_round_program(mesh,
-                                                  self.distance_measure)
+                                                  self.distance_measure,
+                                                  sharded=sharded)
 
             def body(carry, epoch):
                 centroids, _ = carry
@@ -424,6 +463,15 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                 _health.guard_final_state(
                     "KMeans", np.asarray(centroids, np.float64))
 
+        # per-replica update-state accounting (benchmark provenance),
+        # from the fit's REAL state buffers — the fetched packed output
+        # on the compiled path, the replicated device carry on the
+        # host-rounds path — honestly full-size: the centroid carry
+        # all-gathers back to replicated every round even when the
+        # sharded update ran (only persistent sharded state like FTRL's
+        # z/n shrinks 1/N)
+        _upd.record_state_bytes("KMeans", (centroids, counts),
+                                data_shard_count(mesh), sharded)
         model = KMeansModel(centroids=np.asarray(centroids, np.float64),
                             weights=np.asarray(counts, np.float64))
         return self.copy_params_to(model)

@@ -34,7 +34,6 @@ from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
-from flink_ml_tpu.parallel.shardmap import shard_map
 from flink_ml_tpu.api.stage import Estimator, Model
 from flink_ml_tpu.common.table import Table, as_dense_vector_column
 from flink_ml_tpu.iteration.streaming import (
@@ -98,13 +97,22 @@ def _ftrl_apply(xp, g, coeffs, z, n, alpha, beta, l1, l2):
 
 @functools.lru_cache(maxsize=32)
 def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float,
-                  health: bool = False):
-    """ONE FTRL global-batch update as a compiled SPMD program: batch
-    sharded over the mesh's data axes, (w, z, n) replicated, the gradient
-    reduction one psum — the dense-branch math of CalculateLocalGradient:
-    364-388 + UpdateModel:295-319 with the TPU doing the batch matmul
-    instead of a host numpy loop (the round-2 'online fits leave the
-    device idle' gap).
+                  health: bool = False, sharded: bool = False):
+    """ONE FTRL global-batch update as a compiled map-reduce program
+    (parallel/mapreduce.py): batch *partitioned* over the mesh's data
+    axes, the per-shard gradient partials the *map*, one *reduce*, the
+    FTRL-proximal rule the *update* — the dense-branch math of
+    CalculateLocalGradient:364-388 + UpdateModel:295-319 with the TPU
+    doing the batch matmul instead of a host numpy loop (the round-2
+    'online fits leave the device idle' gap).
+
+    With ``sharded`` (update_sharding.py) the update is cross-replica
+    sharded: the gradient *reduce-scatters* so each replica owns a
+    ``1/N`` slice of the coefficients AND of the z/n accumulators —
+    which stay sharded across batches (``1/N`` optimizer memory per
+    replica) — then the fresh coefficients all-gather for the next
+    forward pass. The (z, n) carries are donated through
+    ``instrumented_jit``, so the accumulator update happens in place.
 
     With ``health`` (observability/health.py) the program additionally
     returns the batch's mean logloss — the per-batch convergence/health
@@ -112,96 +120,143 @@ def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float,
     has (DrJAX-style first-class output; a NaN anywhere in the state
     poisons it, so it doubles as the non-finite sentinel). The host
     drains these scalars in stacked transfers, never per batch."""
-    import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    from flink_ml_tpu.parallel.collective import (all_reduce_sum,
-                                                  local_valid_mask)
-    from flink_ml_tpu.parallel.mesh import data_axes, data_pspec
+    from flink_ml_tpu.parallel import mapreduce as mr
+    from flink_ml_tpu.parallel import update_sharding as _upd
 
-    axes = data_axes(mesh)
-    spec0 = data_pspec(mesh)
+    # name (→ instrumented_jit) only for the sharded build: the
+    # replicated per-batch hot loop keeps plain jit's C++ dispatch cache
+    prog = mr.MapReduceProgram(mesh,
+                               name="ftrl.dense" if sharded else None)
+    axes, spec0 = prog.axes, prog.spec0
 
-    def per_shard(xl, yl, n_valid, coeffs, z, n):
-        vl = local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
-        dots = xl @ coeffs
+    def map_fn(xl, yl, n_valid, coeffs, z, n):
+        d = xl.shape[1]  # true dim; coeffs may be padded (sharded)
+        vl = mr.local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
+        dots = xl @ coeffs[:d]
         p = 1.0 / (1.0 + jnp.exp(-dots))
-        grad = all_reduce_sum(((p - yl) * vl) @ xl, axes)
-        # dense-path reference semantics: weight sum = batch row count at
-        # every coordinate
-        g = grad / jnp.maximum(n_valid.astype(grad.dtype), 1.0)
-        out = _ftrl_apply(jnp, g, coeffs, z, n, alpha, beta, l1, l2)
+        partials = {"grad": _upd.pad_leading(((p - yl) * vl) @ xl,
+                                             coeffs.shape[0])}
         if health:
             # stable binary logloss from the margins: log(1+e^d) - y·d
             xent = jnp.logaddexp(0.0, dots) - yl * dots
-            loss = all_reduce_sum(jnp.sum(vl * xent), axes) \
-                / jnp.maximum(n_valid, 1.0)
+            partials["loss"] = jnp.sum(vl * xent)
+        return partials
+
+    def update_fn(red, xl, yl, n_valid, coeffs, z, n):
+        # dense-path reference semantics: weight sum = batch row count
+        # at every coordinate. In sharded mode `red["grad"]` is this
+        # replica's scattered slice and (z, n) are its carried slices —
+        # the same expression updates 1/N of the state per replica.
+        g = red["grad"] / jnp.maximum(n_valid.astype(red["grad"].dtype),
+                                      1.0)
+        if sharded:
+            w2, z2, n2 = _ftrl_apply(jnp, g, _upd.owned_slice(coeffs, axes),
+                                     z, n, alpha, beta, l1, l2)
+            out = (mr.all_gather(w2, axes), z2, n2)
+        else:
+            out = _ftrl_apply(jnp, g, coeffs, z, n, alpha, beta, l1, l2)
+        if health:
+            loss = red["loss"] / jnp.maximum(n_valid, 1.0)
             return out + (loss,)
         return out
 
-    return jax.jit(shard_map(
-        per_shard, mesh=mesh,
-        in_specs=(P(spec0, None), P(spec0), P(), P(), P(), P()),
-        out_specs=(P(), P(), P()) + ((P(),) if health else ()),
-        check_vma=False))
+    zspec = P(spec0) if sharded else P()
+    reduce = {"grad": mr.reduce_scatter if sharded else mr.reduce_sum}
+    if health:
+        reduce["loss"] = mr.reduce_sum
+    return prog.build(
+        map_fn, update_fn,
+        in_specs=(P(spec0, None), P(spec0), P(), P(), zspec, zspec),
+        out_specs=(P(), zspec, zspec) + ((P(),) if health else ()),
+        reduce=reduce,
+        donate_argnums=(4, 5) if sharded else None)
 
 
 @functools.lru_cache(maxsize=32)
 def _ftrl_sparse_program(mesh, alpha: float, beta: float, l1: float,
-                         l2: float, health: bool = False):
-    """ONE sparse-batch FTRL update as a compiled SPMD program — the
-    device twin of the host CSR branch (ref CalculateLocalGradient:
+                         l2: float, health: bool = False,
+                         sharded: bool = False):
+    """ONE sparse-batch FTRL update as a compiled map-reduce program —
+    the device twin of the host CSR branch (ref CalculateLocalGradient:
     364-388: gradient and weight sums accumulate ONLY at a sample's
     non-zero coordinates, unlike the dense program's batch-count
     denominator).
 
     The CSR batch arrives as per-shard padded quads (values, column ids,
-    local row ids, validity) sharded over the mesh's data axes plus
-    per-shard (y, w) row blocks; the forward matvec and the
-    per-coordinate sums are segment-sums over the shard's nnz, psum'd
-    across shards; the FTRL elementwise update runs replicated. Padded
+    local row ids, validity) *partitioned* over the mesh's data axes
+    plus per-shard (y, w) row blocks; the *map* is the forward matvec
+    and the per-coordinate segment-sums over the shard's nnz; the
+    *reduce* crosses shards (reduce-scattered per-coordinate in
+    ``sharded`` mode — the z/n accumulator slices stay sharded like the
+    dense program's); the FTRL elementwise rule is the *update*. Padded
     nnz slots carry validity 0 so they contribute nothing; padded rows
-    own no nnz so their p never enters a sum."""
+    own no nnz so their p never enters a sum.
+
+    NO buffer donation here, deliberately: a first-batch device-sparse
+    failure falls back to the host CSR engine (fit()), and that
+    fallback contract requires the state the program was called with to
+    still be alive — a donated carry would already be consumed."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    from flink_ml_tpu.parallel.collective import all_reduce_sum
-    from flink_ml_tpu.parallel.mesh import data_axes, data_pspec
+    from flink_ml_tpu.parallel import mapreduce as mr
+    from flink_ml_tpu.parallel import update_sharding as _upd
 
-    axes = data_axes(mesh)
-    spec0 = data_pspec(mesh)
+    prog = mr.MapReduceProgram(mesh)
+    axes, spec0 = prog.axes, prog.spec0
 
-    def per_shard(vals, col, row, valid, yb, wb, coeffs, z, n):
+    def map_fn(vals, col, row, valid, yb, wb, coeffs, z, n):
         vals, col, row, valid = vals[0], col[0], row[0], valid[0]
         yb, wb = yb[0], wb[0]
         rows_s = yb.shape[0]
-        d = coeffs.shape[0]
+        d_pad = coeffs.shape[0]
         dots = jax.ops.segment_sum(vals * coeffs[col] * valid, row,
                                    num_segments=rows_s)
         p = 1.0 / (1.0 + jnp.exp(-dots))
-        grad = all_reduce_sum(jax.ops.segment_sum(
-            vals * (p - yb)[row] * valid, col, num_segments=d), axes)
-        wsum = all_reduce_sum(jax.ops.segment_sum(
-            wb[row] * valid, col, num_segments=d), axes)
-        g = jnp.where(wsum != 0, grad / jnp.where(wsum != 0, wsum, 1.0),
-                      0.0)
-        out = _ftrl_apply(jnp, g, coeffs, z, n, alpha, beta, l1, l2)
+        partials = {
+            "grad": jax.ops.segment_sum(vals * (p - yb)[row] * valid,
+                                        col, num_segments=d_pad),
+            "wsum": jax.ops.segment_sum(wb[row] * valid, col,
+                                        num_segments=d_pad),
+        }
         if health:
             # per-batch mean logloss, weighted by the sample weights
             # (padded rows carry weight 0, so they contribute nothing)
             xent = jnp.logaddexp(0.0, dots) - yb * dots
-            loss = all_reduce_sum(jnp.sum(wb * xent), axes) \
-                / jnp.maximum(all_reduce_sum(jnp.sum(wb), axes), 1e-30)
+            partials["lossNum"] = jnp.sum(wb * xent)
+            partials["lossDen"] = jnp.sum(wb)
+        return partials
+
+    def update_fn(red, vals, col, row, valid, yb, wb, coeffs, z, n):
+        grad, wsum = red["grad"], red["wsum"]
+        g = jnp.where(wsum != 0, grad / jnp.where(wsum != 0, wsum, 1.0),
+                      0.0)
+        if sharded:
+            w2, z2, n2 = _ftrl_apply(jnp, g, _upd.owned_slice(coeffs, axes),
+                                     z, n, alpha, beta, l1, l2)
+            out = (mr.all_gather(w2, axes), z2, n2)
+        else:
+            out = _ftrl_apply(jnp, g, coeffs, z, n, alpha, beta, l1, l2)
+        if health:
+            loss = red["lossNum"] / jnp.maximum(red["lossDen"], 1e-30)
             return out + (loss,)
         return out
 
-    return jax.jit(shard_map(
-        per_shard, mesh=mesh,
-        in_specs=(P(spec0, None),) * 6 + (P(), P(), P()),
-        out_specs=(P(), P(), P()) + ((P(),) if health else ()),
-        check_vma=False))
+    zspec = P(spec0) if sharded else P()
+    coord_reduce = mr.reduce_scatter if sharded else mr.reduce_sum
+    reduce = {"grad": coord_reduce, "wsum": coord_reduce}
+    if health:
+        reduce["lossNum"] = mr.reduce_sum
+        reduce["lossDen"] = mr.reduce_sum
+    return prog.build(
+        map_fn, update_fn,
+        in_specs=(P(spec0, None),) * 6 + (P(), zspec, zspec),
+        out_specs=(P(), zspec, zspec) + ((P(),) if health else ()),
+        reduce=reduce)
 
 
 def _pack_csr_shards(x, y, w, n_shards: int):
@@ -430,6 +485,7 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
         col = self._initial_model_data.column("coefficient")
         coeffs = np.array(col[0].to_array() if col.dtype == object
                           else col[0], np.float64)
+        d = coeffs.shape[0]  # true dim; device state may pad (sharded)
         version = (int(self._initial_model_data.column("modelVersion")[0])
                    if "modelVersion" in self._initial_model_data else 0)
 
@@ -454,12 +510,19 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
         # needs it: a sparse batch, a due checkpoint/listener, or fit end.
         # float32→float64→float32 round-trips are exact, so host and
         # device residency produce identical numbers.
+        # With the cross-replica sharded update armed
+        # (parallel/update_sharding.py) the device triple is
+        # (w replicated+padded, z sharded, n sharded): each replica
+        # carries only its 1/N accumulator slice between batches. The
+        # host view stays the trimmed (d,) float64 arrays either way, so
+        # checkpoints are byte-compatible across modes and a sharded fit
+        # can resume a replicated one's snapshot (and vice versa).
         state_dev = None  # (coeffs, z, n) float32 device triple, or None
 
         def to_host():
             nonlocal coeffs, z, n, state_dev
             if state_dev is not None:
-                coeffs, z, n = (np.asarray(a, np.float64)
+                coeffs, z, n = (np.asarray(a, np.float64)[:d]
                                 for a in state_dev)
                 state_dev = None
 
@@ -476,7 +539,9 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                     jnp.stack([history[i][1] for i in dev_pending]),
                     np.float64)
                 for j, i in enumerate(dev_pending):
-                    history[i] = (history[i][0], stacked[j])
+                    # [:d] trims the sharded-update padding (no-op when
+                    # the device state is unpadded)
+                    history[i] = (history[i][0], stacked[j][:d])
                 dev_pending.clear()
 
         def pack():
@@ -497,8 +562,15 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
 
         from flink_ml_tpu.linalg import sparse
         from flink_ml_tpu.observability import health as _mlhealth
+        from flink_ml_tpu.parallel import update_sharding as _upd
         from flink_ml_tpu.parallel.collective import ensure_on_mesh
-        from flink_ml_tpu.parallel.mesh import data_axes, default_mesh
+        from flink_ml_tpu.parallel.mesh import (data_axes,
+                                                data_shard_count,
+                                                default_mesh)
+
+        # cross-replica sharded optimizer state (update_sharding.py):
+        # z/n accumulators live sharded on device, 1/N per replica
+        sharded = _upd.enabled()
 
         # per-batch model-health telemetry (observability/health.py):
         # device batches return their mean logloss as a program output;
@@ -541,21 +613,47 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
             """(coeffs, z, n) as the float32 device triple WITHOUT
             committing it to state_dev — callers assign state_dev only
             after their device step succeeds, so a failed attempt leaves
-            the float64 host state untruncated for the host engine."""
+            the float64 host state untruncated for the host engine.
+            Sharded mode pads to the shard multiple and places w
+            replicated, z/n dim-0-sharded (1/N slice per replica)."""
+            import jax
             import jax.numpy as jnp
 
             if state_dev is not None:
                 return state_dev
+            if sharded:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                dp = _upd.padded_len(d, data_shard_count(mesh))
+                pad = dp - d
+                w = jax.device_put(
+                    np.pad(coeffs, (0, pad)).astype(np.float32),
+                    NamedSharding(mesh, P()))
+                zs, ns = _upd.place_opt_state(
+                    mesh, (np.pad(z, (0, pad)).astype(np.float32),
+                           np.pad(n, (0, pad)).astype(np.float32)))
+                return (w, zs, ns)
             return (jnp.asarray(coeffs, jnp.float32),
                     jnp.asarray(z, jnp.float32),
                     jnp.asarray(n, jnp.float32))
+
+        state_recorded = False
 
         def commit_device_state(new_state):
             """Shared device-batch bookkeeping (dense + sparse paths):
             adopt the new state, version it, snapshot coefficients into
             the history (drained in stacked D2H past the cap), checkpoint."""
-            nonlocal state_dev, version
+            nonlocal state_dev, version, state_recorded
             state_dev = new_state
+            if not state_recorded:
+                # per-replica optimizer-state accounting (benchmark
+                # provenance + the BENCH_mapreduce 1/N gate), MEASURED
+                # from the committed z/n device buffers — a regression
+                # that silently replicates the 'sharded' slices shows
+                # up as real bytes here, not as arithmetic
+                state_recorded = True
+                _upd.record_state_bytes(
+                    algo, new_state[1:], data_shard_count(mesh), sharded)
             version += 1
             dev_pending.append(len(history))
             history.append((version, state_dev[0]))
@@ -578,7 +676,7 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                     mesh = default_mesh()
                     axes = data_axes(mesh)
                 program = _ftrl_program(mesh, alpha, beta, l1, l2,
-                                        health=health_on)
+                                        health=health_on, sharded=sharded)
                 xb, n_rows = ensure_on_mesh(mesh, x, axes, jnp.float32)
                 ycol = batch.column(self.label_col)  # device col stays put
                 if isinstance(ycol, np.ndarray):
@@ -621,7 +719,8 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                         axes = data_axes(mesh)
                     program = _ftrl_sparse_program(mesh, alpha, beta,
                                                    l1, l2,
-                                                   health=health_on)
+                                                   health=health_on,
+                                                   sharded=sharded)
                     packed = _pack_csr_shards(x, y, w_col,
                                               data_shard_count(mesh))
                     sh = NamedSharding(mesh, P(data_pspec(mesh), None))
@@ -689,14 +788,17 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                 if not math.isfinite(loss_series[-1]):
                     check_losses()
             row_nnz = np.diff(x.indptr)
-            d = x.shape[1]
+            # NOT `d`: the fit-wide `d` is the model dim owning the
+            # sharded-padding/trim contract (to_host/[:d]); rebinding it
+            # to a batch's CSR width would silently corrupt that
+            n_cols = x.shape[1]
             grad = np.bincount(
                 x.indices,
                 weights=x.data * np.repeat(p - y, row_nnz),
-                minlength=d)
+                minlength=n_cols)
             weight_sum = np.bincount(
                 x.indices, weights=np.repeat(w_col, row_nnz),
-                minlength=d)
+                minlength=n_cols)
             g = np.where(weight_sum != 0, grad / np.where(weight_sum != 0,
                                                           weight_sum, 1), 0)
             coeffs, z, n = _ftrl_apply(np, g, coeffs, z, n, alpha, beta,

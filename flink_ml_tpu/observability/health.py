@@ -200,16 +200,18 @@ def convergence_row(loss, prev_params, new_params, model_axis=None):
     scalar: a NaN/Inf anywhere in the parameters poisons the squared
     sums, so the row's ``isfinite`` covers loss and every parameter
     element without a separate per-leaf pass). Pure jnp; call inside
-    the jitted step. With ``model_axis`` (tensor-parallel shard_map)
-    the squared sums psum over that axis so the norms are global."""
-    import jax
+    the jitted step. With ``model_axis`` (tensor-parallel map body)
+    the squared sums all-reduce over that axis — through the named
+    collective seam (JL108) — so the norms are global."""
     import jax.numpy as jnp
+
+    from flink_ml_tpu.parallel.collective import all_reduce_sum
 
     upd_sq = jnp.sum(jnp.square(new_params - prev_params))
     prm_sq = jnp.sum(jnp.square(new_params))
     if model_axis is not None:
-        upd_sq = jax.lax.psum(upd_sq, model_axis)
-        prm_sq = jax.lax.psum(prm_sq, model_axis)
+        upd_sq = all_reduce_sum(upd_sq, model_axis)
+        prm_sq = all_reduce_sum(prm_sq, model_axis)
     row = jnp.stack([jnp.asarray(loss, jnp.float32),
                      jnp.sqrt(upd_sq).astype(jnp.float32),
                      jnp.sqrt(prm_sq).astype(jnp.float32)])

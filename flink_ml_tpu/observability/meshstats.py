@@ -307,12 +307,11 @@ def _nonfinite_program(mesh, ndim: int):
     """Per-shard non-finite element counts of a dim-0-sharded array as
     ONE ``(n_shards,)`` output — the count folds inside the shard_map
     body (JL107-clean), the host fetches one tiny vector."""
-    import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from flink_ml_tpu.parallel import mapreduce as mr
     from flink_ml_tpu.parallel.mesh import data_pspec
-    from flink_ml_tpu.parallel.shardmap import shard_map
 
     spec0 = data_pspec(mesh)
 
@@ -320,10 +319,10 @@ def _nonfinite_program(mesh, ndim: int):
         bad = jnp.sum(jnp.logical_not(jnp.isfinite(xl)))
         return bad.astype(jnp.int32)[None]
 
-    return jax.jit(shard_map(
-        per_shard, mesh=mesh,
+    return mr.map_shards(
+        per_shard, mesh,
         in_specs=P(spec0, *([None] * (ndim - 1))),
-        out_specs=P(spec0), check_vma=False))
+        out_specs=P(spec0))
 
 
 def record_input_health(algo: str, mesh, array) -> Optional[List[int]]:

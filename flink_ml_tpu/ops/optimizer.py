@@ -51,12 +51,12 @@ from flink_ml_tpu.parallel.mesh import (
     default_mesh,
     model_axis_of,
 )
+from flink_ml_tpu.parallel import mapreduce as mr
+from flink_ml_tpu.parallel import update_sharding as _upd
 from flink_ml_tpu.parallel.collective import (
-    all_reduce_sum,
     ensure_on_mesh,
     ones_on_mesh,
 )
-from flink_ml_tpu.parallel.shardmap import shard_map
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,40 +70,67 @@ class SGDParams:
     elastic_net: float = 0.0
 
 
-def _sgd_update_math(loss_func, prm: SGDParams, axes, model_axis=None):
+def _sgd_update_math(loss_func, prm: SGDParams, axes, model_axis=None,
+                     sharded: bool = False):
     """The post-slice math of one round — loss/gradient on the minibatch,
-    the fused [grad, weight, loss] psum (the reference's feedbackArray
-    layout, SGD.java:190), the model update + regularization
-    (SGD.java:231-243) — shared by the while-loop, unrolled and host-driven
-    programs so a change here propagates to every fit path.
+    the fused [grad, weight, loss] reduction (the reference's
+    feedbackArray layout, SGD.java:190), the model update +
+    regularization (SGD.java:231-243) — shared by the while-loop,
+    unrolled and host-driven programs so a change here propagates to
+    every fit path.
 
     Returns ``(update, apply_packed)``: ``update(coeffs, xb, yb, wb) ->
     (new_coeffs, mean_loss)`` for the slice-based rounds, and
     ``apply_packed(coeffs, packed_local) -> (new_coeffs, mean_loss)`` for
     rounds whose local [grad | weight | loss] partials come from the
-    fused pallas kernel — the cross-shard psum and the model update are
-    this one shared tail either way. Must be called inside shard_map
-    over the mesh's data ``axes``."""
+    fused pallas kernel — the cross-shard reduction and the model update
+    are this one shared tail either way. Must be called inside a
+    ``mapreduce.map_shards`` body over the mesh's data ``axes``.
+
+    With ``sharded`` (update_sharding.py, DP meshes only) the tail is
+    the cross-replica sharded update: the gradient reduce-scatters so
+    each replica updates only its own ``1/N`` coefficient slice
+    (regularization included — it is elementwise), then the fresh
+    coefficients all-gather; the scalar [weight | loss] tail still
+    all-reduces. The coefficient carry must be padded to the shard
+    multiple (``optimize`` does). Results match the replicated tail up
+    to float reassociation in the reduction order."""
 
     def apply_packed(coeffs, packed_local):
-        packed = all_reduce_sum(packed_local, axes)
-        grad, total_w, total_loss = packed[:-2], packed[-2], packed[-1]
+        if sharded:
+            tail = mr.reduce_sum(packed_local[-2:], axes)
+            total_w, total_loss = tail[0], tail[1]
+            grad_pad = _upd.pad_leading(packed_local[:-2], coeffs.shape[0])
 
-        # ref updateModel (SGD.java:231-243); skip when no weight
-        updated = coeffs - (prm.learning_rate
-                            / jnp.maximum(total_w, 1e-30)) * grad
-        updated, _ = regularize(updated, prm.reg, prm.elastic_net,
-                                prm.learning_rate)
+            def apply_fn(g_slice, c_slice, _state):
+                upd = c_slice - (prm.learning_rate
+                                 / jnp.maximum(total_w, 1e-30)) * g_slice
+                upd, _ = regularize(upd, prm.reg, prm.elastic_net,
+                                    prm.learning_rate)
+                return upd, None
+
+            updated, _ = _upd.sharded_apply(axes, grad_pad, coeffs, None,
+                                            apply_fn)
+        else:
+            packed = mr.reduce_sum(packed_local, axes)
+            grad, total_w, total_loss = packed[:-2], packed[-2], packed[-1]
+
+            # ref updateModel (SGD.java:231-243); skip when no weight
+            updated = coeffs - (prm.learning_rate
+                                / jnp.maximum(total_w, 1e-30)) * grad
+            updated, _ = regularize(updated, prm.reg, prm.elastic_net,
+                                    prm.learning_rate)
         coeffs_out = jnp.where(total_w > 0, updated, coeffs)
         mean_loss = total_loss / jnp.maximum(total_w, 1e-30)
         return coeffs_out, mean_loss
 
     def update(coeffs, xb, yb, wb):
         if model_axis is None:
-            loss_sum, grad_sum = loss_func.loss_and_gradient(coeffs, xb, yb,
-                                                             wb)
+            d = xb.shape[1]  # == coeffs length unless sharded padding
+            loss_sum, grad_sum = loss_func.loss_and_gradient(coeffs[:d],
+                                                             xb, yb, wb)
         else:
-            dots = all_reduce_sum(xb @ coeffs, model_axis)
+            dots = mr.reduce_sum(xb @ coeffs, model_axis)
             loss_sum, multipliers = loss_func.terms(dots, yb, wb)
             grad_sum = xb.T @ multipliers  # local feature shard
         packed = jnp.concatenate([
@@ -115,7 +142,7 @@ def _sgd_update_math(loss_func, prm: SGDParams, axes, model_axis=None):
 
 
 def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
-                    model_axis=None):
+                    model_axis=None, sharded: bool = False):
     """The per-shard math of ONE training round — shared verbatim by the
     all-device while_loop program and the host-driven round program so the
     two modes stay numerically identical by construction.
@@ -134,12 +161,13 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
     shard, and the loss/weight reduction crosses the data axes only."""
     gb = prm.global_batch_size
     lb_base, lb_rem = gb // p, gb % p
-    update, _ = _sgd_update_math(loss_func, prm, axes, model_axis)
+    update, _ = _sgd_update_math(loss_func, prm, axes, model_axis,
+                                 sharded=sharded)
 
     def round_step(xl, yl, wl, coeffs, offset):
         local_n = xl.shape[0]  # static at trace time
         lb_max = min(lb_base + (1 if lb_rem else 0), local_n)
-        task_id = jax.lax.axis_index(axes)
+        task_id = mr.shard_index(axes)
         # ref SGD.java:206-213 — low task ids take the remainder
         lb = jnp.minimum(lb_base + (task_id < lb_rem).astype(jnp.int32),
                          local_n)
@@ -170,7 +198,8 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
 
 @functools.lru_cache(maxsize=128)
 def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams,
-                               health: bool = False):
+                               health: bool = False,
+                               sharded: bool = False):
     """A K-round slice of the training loop as ONE compiled SPMD program:
     ``segment(xs, ys, ws, coeffs, offsets, epoch0, limit, hist, fin) ->
     (coeffs, offsets, mean_loss, epoch, stop, hist, fin)``.  The epoch
@@ -198,7 +227,8 @@ def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams,
     p = data_shard_count(mesh)
     model_axis = model_axis_of(mesh)
     wspec = P(model_axis) if model_axis else P()
-    round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis)
+    round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis,
+                                 sharded=sharded)
 
     def run(xl, yl, wl, coeffs, offsets, epoch0, limit, hist, fin):
         def cond(state):
@@ -235,12 +265,17 @@ def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams,
 
         extra_in, extra_out = (), ()
 
-    return jax.jit(shard_map(
-        per_shard, mesh=mesh,
+    # sharded-update programs donate the (coeffs, offsets) carry through
+    # instrumented_jit: the update happens in place in the donated
+    # buffers (the first rung of the raw-speed ladder) and the compile
+    # is counted per-function
+    return mr.map_shards(
+        per_shard, mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
                   P(spec0), P(), P()) + extra_in,
         out_specs=(wspec, P(spec0), P(), P(), P()) + extra_out,
-        check_vma=False))
+        donate_argnums=(3, 4) if sharded else None,
+        name="sgd.segment" if sharded else None)
 
 
 #: plain fits with at most this many rounds compile fully unrolled with
@@ -274,7 +309,8 @@ def _static_batch_schedule(local_n: int, lb: int, max_iter: int):
 @functools.lru_cache(maxsize=128)
 def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
                                 use_kernel: bool = False,
-                                health: bool = False):
+                                health: bool = False,
+                                sharded: bool = False):
     """The plain (uncheckpointed, fresh-offset) fit as ONE fully-unrolled
     SPMD program: ``fit(xs, ys, ws, coeffs, offsets) -> (coeffs, offsets,
     mean_loss, epoch, stop)`` — the same carry as the segment program.
@@ -303,7 +339,7 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
     lb_base = prm.global_batch_size // p
     assert prm.global_batch_size % p == 0
     update, apply_packed = _sgd_update_math(loss_cls(), prm, axes,
-                                            model_axis)
+                                            model_axis, sharded=sharded)
 
     def per_shard(xl, yl, wl, coeffs, offsets):
         local_n = xl.shape[0]
@@ -322,8 +358,12 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
         for start, clip in sched:
             if tile:
                 from flink_ml_tpu.ops.pallas_kernels import sgd_batch_terms
-                packed = sgd_batch_terms(xl, yl, wl, coeffs, start, clip,
-                                         lb, tile, loss_cls.NAME)
+                # the kernel sees the TRUE feature dim — coeffs may be
+                # padded for the sharded update; apply_packed re-pads
+                # the local [grad | w | loss] partials it returns
+                packed = sgd_batch_terms(xl, yl, wl,
+                                         coeffs[:xl.shape[1]], start,
+                                         clip, lb, tile, loss_cls.NAME)
                 updated, new_loss = apply_packed(coeffs, packed)
             else:
                 xb = jax.lax.slice_in_dim(xl, start, start + lb, axis=0)
@@ -357,38 +397,42 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
                     jnp.stack(rows), fin)
         return coeffs, offset[None], mean_loss, epoch, stop
 
-    return jax.jit(shard_map(
-        per_shard, mesh=mesh,
+    return mr.map_shards(
+        per_shard, mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
                   P(spec0)),
         out_specs=(wspec, P(spec0), P(), P(), P())
         + ((P(), P()) if health else ()),
-        check_vma=False))
+        donate_argnums=(3, 4) if sharded else None,
+        name="sgd.unrolled" if sharded else None)
 
 
 @functools.lru_cache(maxsize=128)
-def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams):
-    """ONE training round as a shard_mapped callable — the building block of
-    the checkpointable host loop. Wraps the same _sgd_round_math as the
-    all-device program, so device and host modes are numerically identical
-    by construction."""
+def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams,
+                             sharded: bool = False):
+    """ONE training round as a mapped (un-jitted) callable — the
+    building block of the checkpointable host loop (iterate_bounded jits
+    the round itself). Wraps the same _sgd_round_math as the all-device
+    program, so device and host modes are numerically identical by
+    construction."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     p = data_shard_count(mesh)
     model_axis = model_axis_of(mesh)
     wspec = P(model_axis) if model_axis else P()
-    round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis)
+    round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis,
+                                 sharded=sharded)
 
     def per_shard(xl, yl, wl, coeffs, offsets):
         coeffs, new_offset, mean_loss = round_step(xl, yl, wl, coeffs,
                                                    offsets[0])
         return coeffs, new_offset[None], mean_loss
 
-    return shard_map(
-        per_shard, mesh=mesh,
+    return mr.map_shards(
+        per_shard, mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
                   P(spec0)),
-        out_specs=(wspec, P(spec0), P()), check_vma=False)
+        out_specs=(wspec, P(spec0), P()), jit=False)
 
 
 @functools.lru_cache(maxsize=128)
@@ -557,6 +601,16 @@ class SGD:
         axes = data_axes(mesh)
         init_coeffs = np.asarray(init_coeffs)
         tp = model_axis_of(mesh) is not None
+        # cross-replica sharded update (update_sharding.py; DP meshes
+        # only — a TP mesh already splits the feature dim): pad the
+        # coefficient carry to the shard multiple so the gradient
+        # reduce-scatter and the per-replica slices line up (padded
+        # coords stay exactly zero: zero grad → soft-threshold(0) = 0)
+        sharded = _upd.enabled() and not tp
+        if sharded:
+            pad = (-d) % data_shard_count(mesh)
+            if pad:
+                init_coeffs = np.pad(init_coeffs, (0, pad))
         from jax.sharding import NamedSharding
         if tp:
             # tensor parallelism: feature dim padded to the model-axis size
@@ -597,23 +651,36 @@ class SGD:
             ws = ones_on_mesh(mesh, n, axes, jnp.float32)
         else:
             ws, _ = ensure_on_mesh(mesh, weights, axes, jnp.float32)
-        w0 = jax.device_put(jnp.asarray(init_coeffs, dtype), w_sharding)
-
         from flink_ml_tpu.iteration.iteration import (
             device_checkpoint_segment, needs_host_loop, run_segmented)
         p = data_shard_count(mesh)
         spec0 = data_pspec(mesh)
+
         # carry leaves must live on the full mesh (replicated or
         # model-sharded coeffs, per-task offsets) — both for the
-        # shard_mapped round/segment and so that checkpoint restore
-        # re-places leaves onto the right shardings.
-        init = (
-            w0,
-            jax.device_put(jnp.zeros((p,), jnp.int32),
-                           NamedSharding(mesh, P(spec0))),
-            jax.device_put(jnp.asarray(jnp.inf, dtype),
-                           NamedSharding(mesh, P())),
-        )
+        # mapped round/segment and so that checkpoint restore
+        # re-places leaves onto the right shardings. A closure, not a
+        # tuple: the sharded programs DONATE the carry, so the pallas
+        # fallback retry must rebuild it rather than re-pass consumed
+        # buffers.
+        def make_init():
+            return (
+                jax.device_put(jnp.asarray(init_coeffs, dtype),
+                               w_sharding),
+                jax.device_put(jnp.zeros((p,), jnp.int32),
+                               NamedSharding(mesh, P(spec0))),
+                jax.device_put(jnp.asarray(jnp.inf, dtype),
+                               NamedSharding(mesh, P())),
+            )
+
+        init = make_init()
+        w0 = init[0]
+        # per-replica update-state accounting (benchmark provenance):
+        # measured from the carry's real buffers — SGD's coefficients
+        # all-gather back to replicated every round, so this honestly
+        # reports full size even under the sharded update (only
+        # persistent sharded state like FTRL's z/n shrinks 1/N)
+        _upd.record_state_bytes(algo, (w0,), p, sharded)
 
         seg_k = device_checkpoint_segment(config, listeners)
         if seg_k or not needs_host_loop(config, listeners):
@@ -635,7 +702,8 @@ class SGD:
                 try:
                     prog = _build_sgd_unrolled_program(
                         type(loss_func), mesh, self.params,
-                        use_kernel=use_kernel, health=health_on)
+                        use_kernel=use_kernel, health=health_on,
+                        sharded=sharded)
                     # materialize INSIDE the try: async dispatch surfaces
                     # kernel-execution failures only here
                     res = prog(xs, ys, ws, init[0], init[1])
@@ -658,7 +726,11 @@ class SGD:
                     _pallas_sgd_broken = True
                     prog = _build_sgd_unrolled_program(
                         type(loss_func), mesh, self.params,
-                        use_kernel=False, health=health_on)
+                        use_kernel=False, health=health_on,
+                        sharded=sharded)
+                    # the failed attempt may have consumed the donated
+                    # carry (sharded programs donate it) — rebuild
+                    init = make_init()
                     res = prog(xs, ys, ws, init[0], init[1])
                     coeffs, _, mean_loss, epoch, _ = res[:5]
                     hist, fin = (res[5:] if health_on else (None, True))
@@ -669,7 +741,8 @@ class SGD:
                 return out, float(mean_loss)
             seg_prog = _build_sgd_segment_program(type(loss_func), mesh,
                                                   self.params,
-                                                  health=health_on)
+                                                  health=health_on,
+                                                  sharded=sharded)
             # health carry lives OUTSIDE the checkpointed carry so the
             # snapshot format is identical with telemetry on or off; a
             # restore simply resumes the series at its epoch (earlier
@@ -730,7 +803,7 @@ class SGD:
         from flink_ml_tpu.iteration.iteration import iterate_bounded
 
         round_fn = _build_sgd_round_program(type(loss_func), mesh,
-                                            self.params)
+                                            self.params, sharded=sharded)
 
         def body(carry, epoch):
             coeffs, offsets, _ = carry

@@ -26,7 +26,9 @@ from flink_ml_tpu.parallel.collective import (  # noqa: F401
     all_reduce_mean,
     all_reduce_sum,
     broadcast_from,
+    reduce_scatter,
     shard_batch,
+    shard_index,
     replicate,
     termination_vote,
 )
@@ -34,3 +36,8 @@ from flink_ml_tpu.parallel.shardmap import (  # noqa: F401
     axis_size,
     shard_map,
 )
+from flink_ml_tpu.parallel.mapreduce import (  # noqa: F401
+    MapReduceProgram,
+    map_shards,
+)
+from flink_ml_tpu.parallel import update_sharding  # noqa: F401

@@ -115,6 +115,34 @@ def all_gather(x, axis_name: str = DATA_AXIS, axis: int = 0, tiled: bool = True)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
+def reduce_scatter(x, axis_name=DATA_AXIS):
+    """Sum across the mesh axis, each shard keeping only its own
+    ``1/N`` slice of dim 0 — the first half of the cross-replica sharded
+    weight update (arXiv:2004.13336): per-replica update FLOPs and
+    optimizer-state traffic scale down with the mesh instead of every
+    replica reducing (and then updating) the full vector. Dim 0 must be
+    a multiple of the total shard count (pad with zeros — a zero
+    gradient is inert through every update rule in this framework); the
+    slice order matches :func:`shard_index`, so ``all_gather`` of the
+    per-shard slices reconstructs the full reduction.
+
+    ``axis_name`` may be a tuple of axes (hybrid dcn×data meshes); XLA
+    then scatters over the flattened axis order, keeping the heavy leg
+    on ICI like the hierarchical all-reduce.
+    """
+    _note_traced("psum_scatter", x, axis_name)
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0,
+                                tiled=True)
+
+
+def shard_index(axis_name=DATA_AXIS):
+    """This shard's position along the (possibly tuple of) data axes —
+    the named seam over ``jax.lax.axis_index`` (jaxlint JL108 keeps raw
+    index queries out of fit programs). Matches the slice order of
+    :func:`reduce_scatter`/:func:`all_gather`."""
+    return jax.lax.axis_index(axis_name)
+
+
 def broadcast_from(x, src: int = 0, axis_name: str = DATA_AXIS):
     """Broadcast shard ``src``'s value to all shards (ref: .broadcast() edges).
 

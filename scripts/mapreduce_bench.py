@@ -1,0 +1,370 @@
+#!/usr/bin/env python
+"""Map-reduce layer benchmark: device-count sweep of the migrated fits.
+
+Sweeps the three migrated fit families (SGD, KMeans lloyd, FTRL dense)
+over simulated device counts (``XLA_FLAGS=--xla_force_host_platform_
+device_count=N``) with the cross-replica sharded update off and on
+(``FLINK_ML_TPU_UPDATE_SHARDING``), and writes ``BENCH_mapreduce.json``
+with per-cell step time, per-replica update/optimizer-state bytes and
+``ml.collective`` program-structure payload accounting.
+
+Self-gating (the acceptance bars of the map-reduce layer):
+
+1. **1/N optimizer state** — FTRL's per-replica z/n accumulator bytes at
+   N=8 sharded must be <= 0.2x the N=1 size (the whole point of
+   arXiv:2004.13336's sharded weight update).
+2. **Parity** — at every device count, the sharded and replicated fits
+   must agree on their results (coefficients / centroids) within float
+   tolerance.
+3. **No donation waste** — the sharded cells must run without a single
+   "donated buffers were not usable" warning (the donated carries are
+   really updated in place).
+4. **Single-device hot path (self-diff)** — two traced single-device
+   replicated runs must pass ``mltrace diff --budget`` against each
+   other. Honest scope: both runs are post-change, so this gates
+   run-to-run stability and the STRUCTURAL properties diff checks
+   (compile counts — a layer change that starts recompiling the N=1
+   path fails here), not pre-vs-post wall time. The pre-vs-post
+   comparison was run once at PR time against a pre-change checkout
+   (same workload, ``mltrace diff old new --budget``, pass — recorded
+   in CHANGES.md); CI keeps the reproducible self-diff.
+5. **Multi-device telemetry** — a traced N=8 run must satisfy
+   ``mltrace shards --check`` (mesh.json + per-shard series present).
+
+Structure mirrors bench.py: the PARENT NEVER IMPORTS JAX — each sweep
+cell is a subprocess with its own XLA_FLAGS/JAX_PLATFORMS env, so device
+counts are really per-process and a wedged backend cannot take the
+sweep down.
+
+Exit codes: 0 ok / 1 gate failed / 2 environment broken / 4 trace-diff
+regression (mltrace diff's own code, propagated).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # run from a checkout without installing
+MLTRACE = os.path.join(REPO, "scripts", "mltrace.py")
+
+#: full-sweep device counts; --smoke keeps the 1/N gate's endpoints
+DEVICE_COUNTS = (1, 2, 4, 8)
+SMOKE_COUNTS = (1, 8)
+
+
+# ---------------------------------------------------------------------------
+# child: one (device_count, sharded) sweep cell
+# ---------------------------------------------------------------------------
+
+def _collective_totals():
+    """(traced op count, payload bytes) from the live registry — the
+    compiled programs' collective structure (trace-time accounting)."""
+    from flink_ml_tpu.common.metrics import metrics
+
+    snap = metrics.snapshot().get("ml.collective", {})
+    ops = sum(int(v) for k, v in snap.get("counters", {}).items()
+              if k.startswith("tracedOps"))
+    nbytes = sum(float(h.get("sum", 0.0))
+                 for k, h in snap.get("histograms", {}).items()
+                 if k.startswith("payloadBytes"))
+    return ops, nbytes
+
+
+def run_cell(smoke: bool) -> dict:
+    import warnings
+
+    import numpy as np
+
+    donation_warnings = []
+
+    def note(message, category, *a, **k):
+        if "donat" in str(message).lower():
+            donation_warnings.append(str(message))
+
+    warnings.simplefilter("always")
+    _orig = warnings.showwarning
+    warnings.showwarning = lambda m, c, *a, **k: note(m, c)
+
+    import jax
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.iteration.streaming import StreamTable
+    from flink_ml_tpu.models.clustering import KMeans
+    from flink_ml_tpu.models.online import OnlineLogisticRegression
+    from flink_ml_tpu.ops.losses import BinaryLogisticLoss
+    from flink_ml_tpu.ops.optimizer import SGD, SGDParams
+    from flink_ml_tpu.parallel import update_sharding as upd
+
+    n_dev = len(jax.devices())
+    rng = np.random.default_rng(7)
+    n, d = (4000, 32) if smoke else (20000, 64)
+    iters = 6 if smoke else 12
+    out: dict = {"deviceCount": n_dev,
+                 "updateSharding": upd.enabled(), "workloads": {}}
+
+    def timed(fit):
+        fit()                     # warmup: compile excluded, like bench.py
+        t0 = time.perf_counter()
+        result = fit()
+        return (time.perf_counter() - t0) * 1000.0, result
+
+    def summarize(arr):
+        arr = np.asarray(arr, np.float64).ravel()
+        return {"norm": float(np.linalg.norm(arr)),
+                "head": [float(v) for v in arr[:8]]}
+
+    # -- SGD ---------------------------------------------------------------
+    x = rng.normal(size=(n, d))
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    prm = SGDParams(learning_rate=0.05, global_batch_size=1024,
+                    max_iter=iters, tol=0.0, reg=0.01, elastic_net=0.3)
+    fit_ms, (coeffs, _) = timed(lambda: SGD(prm).optimize(
+        BinaryLogisticLoss(), np.zeros(d), x, y))
+    out["workloads"]["sgd"] = {
+        "fitMs": round(fit_ms, 3), "stepMs": round(fit_ms / iters, 3),
+        "optStateBytesPerReplica": upd.last_state_bytes(),
+        "result": summarize(coeffs)}
+
+    # -- KMeans lloyd ------------------------------------------------------
+    t = Table.from_columns(
+        features=rng.normal(size=(n, d // 2)).astype(np.float32))
+    fit_ms, model = timed(
+        lambda: KMeans(k=16, seed=3, max_iter=iters).fit(t))
+    out["workloads"]["kmeans"] = {
+        "fitMs": round(fit_ms, 3), "stepMs": round(fit_ms / iters, 3),
+        "optStateBytesPerReplica": upd.last_state_bytes("KMeans"),
+        "result": summarize(np.sort(model.centroids.ravel()))}
+    assert upd.last_state_bytes("KMeans") is not None
+
+    # -- FTRL dense (the real sharded-optimizer-state workload) -----------
+    batches = 10 if smoke else 40
+    bs = 256
+    xf = rng.normal(size=(batches * bs, d)).astype(np.float32)
+    yf = (xf @ rng.normal(size=d) > 0).astype(float)
+    tf = Table.from_columns(features=xf, label=yf)
+    init = Table.from_columns(coefficient=np.zeros((1, d)),
+                              modelVersion=np.asarray([0]))
+
+    def ftrl_fit():
+        est = OnlineLogisticRegression(global_batch_size=bs, reg=0.01,
+                                       elastic_net=0.3)
+        est.set_initial_model_data(init)
+        return est.fit(StreamTable.from_table(tf, bs))
+
+    fit_ms, model = timed(ftrl_fit)
+    out["workloads"]["ftrl"] = {
+        "fitMs": round(fit_ms, 3), "stepMs": round(fit_ms / batches, 3),
+        "optStateBytesPerReplica": upd.last_state_bytes(
+            "OnlineLogisticRegression"),
+        "result": summarize(model.coefficients)}
+
+    ops, nbytes = _collective_totals()
+    out["collectiveOps"] = ops
+    out["collectivePayloadBytes"] = int(nbytes)
+    out["donationWarnings"] = len(donation_warnings)
+    out["donationWarningSamples"] = donation_warnings[:3]
+    warnings.showwarning = _orig
+    return out
+
+
+def run_traced() -> dict:
+    """A traced run of the three fits for the diff / shards gates — not
+    timed, so it ALWAYS uses the small smoke workload regardless of
+    sweep mode (the gates are structural: span names, compile counts,
+    collective sites, per-shard series); tracing is armed by
+    FLINK_ML_TPU_TRACE_DIR in the env."""
+    cell = run_cell(smoke=True)
+    from flink_ml_tpu.observability import tracing
+
+    tracing.maybe_dump_root_metrics()
+    return {"deviceCount": cell["deviceCount"], "traced": True}
+
+
+# ---------------------------------------------------------------------------
+# parent: sweep + gates
+# ---------------------------------------------------------------------------
+
+def _spawn(n_dev: int, sharded: bool, smoke: bool,
+           trace_dir=None, timeout=900) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_dev}")
+    env["FLINK_ML_TPU_UPDATE_SHARDING"] = "1" if sharded else "0"
+    argv = [sys.executable, os.path.abspath(__file__), "--cell"]
+    if smoke:
+        argv.append("--smoke")
+    if trace_dir:
+        env["FLINK_ML_TPU_TRACE_DIR"] = trace_dir
+        argv.append("--traced")
+    proc = subprocess.run(argv, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"cell devices={n_dev} sharded={sharded} failed "
+            f"(rc={proc.returncode}):\n{proc.stdout}\n{proc.stderr}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _close(a: dict, b: dict, rtol: float) -> bool:
+    import math
+
+    if not math.isclose(a["norm"], b["norm"], rel_tol=rtol,
+                        abs_tol=1e-6):
+        return False
+    return all(math.isclose(x, y, rel_tol=rtol, abs_tol=1e-5)
+               for x, y in zip(a["head"], b["head"]))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="mapreduce_bench")
+    parser.add_argument("--smoke", action="store_true",
+                        help="small workloads, device counts 1 and 8")
+    parser.add_argument("--cell", action="store_true",
+                        help="(internal) run one sweep cell and print JSON")
+    parser.add_argument("--traced", action="store_true",
+                        help="(internal) run the traced variant")
+    parser.add_argument("--output", default=os.path.join(
+        REPO, "BENCH_mapreduce.json"))
+    parser.add_argument("--budget", type=float, default=300.0,
+                        help="mltrace diff span budget %% for the N=1 gate")
+    parser.add_argument("--min-ms", type=float, default=250.0,
+                        help="mltrace diff self-time floor (wall jitter)")
+    args = parser.parse_args(argv)
+
+    if args.cell:
+        result = run_traced() if args.traced else run_cell(args.smoke)
+        print(json.dumps(result), flush=True)
+        return 0
+
+    counts = SMOKE_COUNTS if args.smoke else DEVICE_COUNTS
+    out_dir = os.path.dirname(os.path.abspath(args.output)) or REPO
+    # traces under ONE subdirectory so a repo-root --output doesn't
+    # scatter trace dirs next to the artifact
+    trace_root = os.path.join(out_dir, "mapreduce-bench-traces")
+    os.makedirs(trace_root, exist_ok=True)
+
+    record = {"smoke": bool(args.smoke), "deviceCounts": list(counts),
+              "cells": [], "gates": {}}
+    try:
+        for n_dev in counts:
+            for sharded in (False, True):
+                print(f"[cell] devices={n_dev} sharded={int(sharded)}",
+                      file=sys.stderr, flush=True)
+                record["cells"].append(_spawn(n_dev, sharded, args.smoke))
+    except (RuntimeError, subprocess.TimeoutExpired) as e:
+        print(f"environment broken: {e}", file=sys.stderr)
+        return 2
+
+    def cell(n_dev, sharded):
+        return next(c for c in record["cells"]
+                    if c["deviceCount"] == n_dev
+                    and c["updateSharding"] is sharded)
+
+    failures = []
+
+    # gate 1: per-replica optimizer-state bytes shrink ~1/N (FTRL z/n,
+    # MEASURED from the committed device buffers — update_sharding
+    # records None if the fit never took a device path, which is itself
+    # a gate failure, not a TypeError)
+    hi, lo = max(counts), min(counts)
+    b1 = cell(lo, True)["workloads"]["ftrl"]["optStateBytesPerReplica"]
+    bn = cell(hi, True)["workloads"]["ftrl"]["optStateBytesPerReplica"]
+    ratio = (round(bn / max(b1, 1), 4)
+             if b1 is not None and bn is not None else None)
+    record["gates"]["optStateShrink"] = {
+        "bytesAt1": b1, f"bytesAt{hi}": bn, "ratio": ratio, "bound": 0.2}
+    if ratio is None:
+        failures.append(
+            "ftrl recorded no optimizer-state bytes (device batch path "
+            "not taken?) — the 1/N gate cannot be evaluated")
+    elif ratio > 0.2:
+        failures.append(
+            f"optimizer-state bytes/replica at N={hi} is {ratio:.2f}x "
+            f"N={lo} (must be <= 0.2x)")
+
+    # gate 2: sharded-vs-replicated parity per cell
+    parity = {}
+    for n_dev in counts:
+        for wl in ("sgd", "kmeans", "ftrl"):
+            ok = _close(cell(n_dev, False)["workloads"][wl]["result"],
+                        cell(n_dev, True)["workloads"][wl]["result"],
+                        rtol=1e-3)
+            parity[f"{wl}@{n_dev}"] = ok
+            if not ok:
+                failures.append(
+                    f"{wl} sharded/replicated results diverge at "
+                    f"devices={n_dev}")
+    record["gates"]["parity"] = parity
+
+    # gate 3: donation clean (sharded cells must not warn)
+    warn = sum(c["donationWarnings"] for c in record["cells"]
+               if c["updateSharding"])
+    record["gates"]["donationWarnings"] = warn
+    if warn:
+        failures.append(f"{warn} donation warnings in sharded cells")
+
+    # gate 4: single-device hot-path SELF-diff (two traced N=1
+    # replicated runs diffed against each other): gates run-to-run
+    # stability + compile-count structure — see the module docstring
+    # for the honest scope vs the one-shot pre-vs-post comparison
+    diff_rc = 0
+    try:
+        dir_a = os.path.join(trace_root, "n1-a")
+        dir_b = os.path.join(trace_root, "n1-b")
+        _spawn(1, False, True, trace_dir=dir_a)
+        _spawn(1, False, True, trace_dir=dir_b)
+        diff = subprocess.run(
+            [sys.executable, MLTRACE, "diff", dir_a, dir_b,
+             "--budget", str(args.budget), "--min-ms", str(args.min_ms)],
+            cwd=REPO, capture_output=True, text=True, timeout=300)
+        diff_rc = diff.returncode
+        record["gates"]["singleDeviceSelfDiff"] = {
+            "exit": diff_rc, "budgetPct": args.budget,
+            "minMs": args.min_ms}
+        if diff_rc != 0:
+            print(diff.stdout + diff.stderr, file=sys.stderr)
+    except (RuntimeError, subprocess.TimeoutExpired) as e:
+        print(f"environment broken (diff gate): {e}", file=sys.stderr)
+        return 2
+
+    # gate 5: multi-device telemetry (shards --check over a traced N=8)
+    shards_rc = 0
+    if max(counts) >= 8:
+        try:
+            dir_m = os.path.join(trace_root, "mesh8")
+            _spawn(8, True, True, trace_dir=dir_m)
+            shards = subprocess.run(
+                [sys.executable, MLTRACE, "shards", dir_m, "--check"],
+                cwd=REPO, capture_output=True, text=True, timeout=300)
+            shards_rc = shards.returncode
+            record["gates"]["shardsCheck"] = {"exit": shards_rc}
+            if shards_rc != 0:
+                failures.append(
+                    "mltrace shards --check rejected the traced N=8 run")
+                print(shards.stdout + shards.stderr, file=sys.stderr)
+        except (RuntimeError, subprocess.TimeoutExpired) as e:
+            print(f"environment broken (shards gate): {e}",
+                  file=sys.stderr)
+            return 2
+
+    record["gates"]["ok"] = not failures and diff_rc == 0
+    record["failures"] = failures
+    with open(args.output, "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps({
+        "output": args.output, "ok": record["gates"]["ok"],
+        "optStateRatio": record["gates"]["optStateShrink"]["ratio"],
+        "failures": failures}, indent=2))
+
+    if diff_rc != 0:
+        return 4
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""NEGATIVE fixture: a fit program built entirely through the named
+map-reduce seams (parallel/mapreduce.py) — raw-collective must stay
+silent, including on the seam functions whose NAMES match raw ops
+(``all_gather`` imported from the collective layer is the seam, not the
+hazard)."""
+
+from jax.sharding import PartitionSpec as P
+
+from flink_ml_tpu.parallel import mapreduce as mr
+from flink_ml_tpu.parallel.collective import all_gather
+from flink_ml_tpu.parallel.update_sharding import sharded_apply
+
+
+def build_program(mesh):
+    def per_shard(xl, coeffs):
+        grad = xl.T @ (xl @ coeffs)
+        total = mr.reduce_sum(grad, "data")
+        g_slice = mr.reduce_scatter(total, "data")
+        task = mr.shard_index("data")
+        return all_gather(g_slice, "data"), task
+
+    return mr.map_shards(per_shard, mesh,
+                         in_specs=(P("data"), P()), out_specs=(P(), P()))
+
+
+def sharded_update_step(axes, grads, params, state, apply_fn):
+    return sharded_apply(axes, grads, params, state, apply_fn)

@@ -1,0 +1,20 @@
+"""POSITIVE fixture: raw jax.lax collectives in a fit-program body
+outside flink_ml_tpu/parallel/ — every variant must fire raw-collective."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.lax import all_gather as gather_alias
+from jax.lax import psum
+
+
+def per_shard(xl, coeffs):
+    grad = xl.T @ (xl @ coeffs)
+    total = jax.lax.psum(grad, "data")            # dotted form
+    mean = lax.pmean(total, "data")               # from jax import lax
+    bare = psum(mean, "data")                     # from jax.lax import psum
+    sliced = jax.lax.psum_scatter(bare, "data", scatter_dimension=0,
+                                  tiled=True)
+    gathered = gather_alias(sliced, "data", axis=0, tiled=True)
+    task = jax.lax.axis_index("data")
+    return gathered, jnp.asarray(task)

@@ -1,0 +1,20 @@
+"""POSITIVE fixture: direct shard_map wraps outside flink_ml_tpu/parallel/
+— both the jax spellings and the portable seam must fire raw-collective
+(fit programs go through parallel/mapreduce.map_shards)."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from flink_ml_tpu.parallel.shardmap import shard_map
+
+
+def body(xl):
+    return xl * 2.0
+
+
+def build_program(mesh):
+    via_seam = shard_map(body, mesh=mesh, in_specs=P("data"),
+                         out_specs=P("data"))
+    via_jax = jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                            out_specs=P("data"))
+    return jax.jit(via_seam), via_jax

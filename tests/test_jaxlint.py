@@ -36,6 +36,7 @@ RULE_OF_PREFIX = {
     "native_contract": "native-contract",
     "alias_mutation": "alias-mutation",
     "metric_in_jit": "metric-in-jit",
+    "raw_collective": "raw-collective",
 }
 
 
@@ -249,3 +250,88 @@ def test_analyze_paths_walks_directories():
     findings = analyze_paths([FIXTURES])
     rules_seen = {f.rule for f in findings}
     assert set(RULE_OF_PREFIX.values()) <= rules_seen
+
+
+def test_raw_collective_exempts_the_parallel_layer():
+    """The seams themselves (any file under a parallel/ package dir) are
+    exempt; the identical source anywhere else fires."""
+    src = ("import jax\n"
+           "def per_shard(x):\n"
+           "    return jax.lax.psum(x, 'data')\n")
+    hits = [f for f in analyze_source(src, "flink_ml_tpu/models/foo.py")
+            if f.rule == "raw-collective"]
+    assert hits and "reduce_sum" in hits[0].message
+    assert not [f for f in analyze_source(
+        src, "flink_ml_tpu/parallel/collective.py")
+        if f.rule == "raw-collective"]
+
+
+def test_raw_collective_resolves_import_aliases():
+    """`from jax.lax import psum as p` is still a raw psum; an
+    unresolvable bare name is NOT flagged (conservative)."""
+    aliased = ("from jax.lax import psum as p\n"
+               "def f(x):\n    return p(x, 'data')\n")
+    assert [f for f in analyze_source(aliased, "m.py")
+            if f.rule == "raw-collective"]
+    unknown = "def f(x):\n    return psum(x, 'data')\n"
+    assert not [f for f in analyze_source(unknown, "m.py")
+                if f.rule == "raw-collective"]
+
+
+def test_raw_collective_seam_names_are_not_false_positives():
+    """The collective/mapreduce seams share names with the raw ops
+    (`all_gather`) — importing and calling THEM must stay silent."""
+    src = ("from flink_ml_tpu.parallel.collective import all_gather\n"
+           "from flink_ml_tpu.parallel import mapreduce as mr\n"
+           "def f(x):\n"
+           "    return mr.reduce_scatter(all_gather(x, 'data'), 'data')\n")
+    assert not [f for f in analyze_source(src, "m.py")
+                if f.rule == "raw-collective"]
+
+
+def test_map_shards_wrap_marks_body_as_traced():
+    """A body wrapped by mapreduce.map_shards is traced code: the
+    traced-code rules (here: tracer-leak) must see through the seam."""
+    src = ("from flink_ml_tpu.parallel import mapreduce as mr\n"
+           "def per_shard(x):\n"
+           "    if float(x.sum()) > 0:\n"
+           "        return x\n"
+           "    return -x\n"
+           "prog = mr.map_shards(per_shard, None, in_specs=None,\n"
+           "                     out_specs=None)\n")
+    assert [f for f in analyze_source(src, "m.py")
+            if f.rule == "tracer-leak"]
+
+
+def test_program_builder_compose_marks_both_bodies_as_traced():
+    """MapReduceProgram.build(map_fn, update_fn, ...) composes BOTH
+    functions into the traced program — the traced-code rules must see
+    each of them (the coverage the FTRL programs kept when they
+    migrated off direct shard_map wraps)."""
+    src = ("from flink_ml_tpu.parallel import mapreduce as mr\n"
+           "def map_fn(x):\n"
+           "    if float(x.sum()) > 0:\n"
+           "        return x\n"
+           "    return -x\n"
+           "def update_fn(red, x):\n"
+           "    metrics.group('ml').counter('steps')\n"
+           "    return red\n"
+           "prog = mr.MapReduceProgram(None)\n"
+           "step = prog.build(map_fn, update_fn, in_specs=None,\n"
+           "                  out_specs=None)\n")
+    rules = {f.rule for f in analyze_source(src, "m.py")}
+    assert "tracer-leak" in rules      # map_fn's float() branch
+    assert "metric-in-jit" in rules    # update_fn's counter
+
+
+def test_generic_build_without_mapreduce_import_is_not_traced():
+    """COMPOSE recognition is scoped to files importing the mapreduce
+    layer — an unrelated `router.build(handler)` must not mark host
+    code as traced (no false tracer-leak on the float branch)."""
+    src = ("def handler(x):\n"
+           "    if float(x.sum()) > 0:\n"
+           "        return x\n"
+           "    return -x\n"
+           "router.build(handler)\n")
+    assert not [f for f in analyze_source(src, "m.py")
+                if f.rule == "tracer-leak"]
