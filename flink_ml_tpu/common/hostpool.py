@@ -111,6 +111,14 @@ def _child_main(fn, lo, hi, wfd, chaos_action=None):
     _srv = _sys.modules.get("flink_ml_tpu.observability.server")
     if _srv is not None:
         _srv.reseed_child()
+    # drift sketches fold across the fork exactly like the metric
+    # registry: reseed so the child's snapshot holds only its own
+    # sketches. Gated on the module being loaded — in practice the
+    # observability package import chain loads it, but this must not
+    # break if an embedding strips that import
+    _drift = _sys.modules.get("flink_ml_tpu.observability.drift")
+    if _drift is not None:
+        _drift.reseed_child()
     try:
         if chaos_action is not None:
             # decided in the PARENT pre-fork so the schedule counter
@@ -126,9 +134,15 @@ def _child_main(fn, lo, hi, wfd, chaos_action=None):
         with tracing.tracer.span("hostpool.child", rows_lo=lo,
                                  rows_hi=hi):
             result = fn(lo, hi)
-        payload = pickle.dumps(
-            {"result": result, "metrics": metrics.snapshot()},
-            protocol=pickle.HIGHEST_PROTOCOL)
+        envelope = {"result": result, "metrics": metrics.snapshot()}
+        # re-check: fn may have imported the drift module itself
+        _drift = _sys.modules.get("flink_ml_tpu.observability.drift")
+        if _drift is not None:
+            dsnap = _drift.state_snapshot()
+            if dsnap.get("servables"):
+                envelope["drift"] = dsnap
+        payload = pickle.dumps(envelope,
+                               protocol=pickle.HIGHEST_PROTOCOL)
     except BaseException:  # noqa: BLE001 — report the traceback, then _exit
         status = 1
         payload = traceback.format_exc().encode("utf-8", "replace")
@@ -237,6 +251,20 @@ def _finalize(child):
                 "droppedChildSnapshots")
             logging.getLogger(__name__).warning(
                 "dropping worker %d metric snapshot (bucket drift)",
+                child.idx, exc_info=True)
+    dsnap = envelope.get("drift")
+    if dsnap:
+        from flink_ml_tpu.observability import drift
+
+        try:
+            drift.merge_state(dsnap)
+        except ValueError:
+            import logging
+
+            metrics.group("ml", "hostpool").counter(
+                "droppedChildDriftSnapshots")
+            logging.getLogger(__name__).warning(
+                "dropping worker %d drift snapshot (bin mismatch)",
                 child.idx, exc_info=True)
     return envelope["result"]
 

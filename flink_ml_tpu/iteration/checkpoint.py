@@ -219,18 +219,27 @@ class CheckpointManager:
         self.sweep_orphans()
 
     # -- write ---------------------------------------------------------------
-    def save(self, carry: Any, epoch: int) -> str:
+    def save(self, carry: Any, epoch: int,
+             extras: Optional[Dict[str, dict]] = None) -> str:
+        """Save one checkpoint. ``extras`` maps artifact names to JSON
+        documents written as ``<name>.json`` beside the manifest INSIDE
+        the atomic rename — how the serving publish path ships a drift
+        baseline (observability/drift.py) with the exact model snapshot
+        it was captured from; a torn write can never publish leaves
+        without their companion artifacts. Extra files are ignored by
+        integrity validation (the manifest enumerates leaves only)."""
         from flink_ml_tpu.observability import tracing
 
         start = time.perf_counter()
         self._last_save_bytes = 0
         with tracing.tracer.span("checkpoint.save", epoch=epoch) as sp:
-            ckpt_dir = self._save(carry, epoch, sp)
+            ckpt_dir = self._save(carry, epoch, sp, extras=extras)
         _observe("save", (time.perf_counter() - start) * 1000.0,
                  self._last_save_bytes)
         return ckpt_dir
 
-    def _save(self, carry: Any, epoch: int, sp) -> str:
+    def _save(self, carry: Any, epoch: int, sp,
+              extras: Optional[Dict[str, dict]] = None) -> str:
         faults.inject("checkpoint-save", epoch=epoch)
         leaves, treedef = jax.tree_util.tree_flatten(carry)
         ckpt_dir = os.path.join(self.base_dir, f"ckpt-{epoch:08d}")
@@ -258,6 +267,12 @@ class CheckpointManager:
             json.dump(manifest, f)
             f.flush()
             os.fsync(f.fileno())
+        for name, doc in (extras or {}).items():
+            extra_path = os.path.join(tmp_dir, f"{name}.json")
+            with open(extra_path, "w") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())
         # fsync data before the rename: the atomic publish must never
         # expose a directory whose contents still live in the page cache
         # only (a power cut would produce exactly the torn checkpoint the

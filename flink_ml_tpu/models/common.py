@@ -180,6 +180,34 @@ class IterationRuntimeMixin:
                               listeners=self._iteration_listeners)
 
 
+def _capture_drift_baseline(estimator, model, x, coeffs) -> None:
+    """The traced-fit drift seam (observability/drift.py): sketch a
+    row-capped sample of the training inputs per feature plus the final
+    model's predictions on that sample, attaching the
+    :class:`~flink_ml_tpu.observability.drift.DriftBaseline` to the
+    fitted model — ``serving.publish_model`` ships it beside the
+    checkpoint manifest so live traffic is compared against the
+    distribution THIS model was trained on. Armed like the rich health
+    tier (trace dir or ``FLINK_ML_TPU_DRIFT``); a capture failure is
+    logged and never fails the fit."""
+    try:
+        from flink_ml_tpu.observability import drift
+
+        if not drift.capture_armed():
+            return
+        xs = drift.sample_rows(x)
+        dots, xp = predict_dots(xs, coeffs)
+        pred = model._predict_columns(dots, xp).get(
+            model.prediction_col)
+        drift.capture_fit_baseline(model, type(estimator).__name__,
+                                   features=xs, predictions=pred)
+    except Exception:  # noqa: BLE001 — telemetry must not sink the fit
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "drift baseline capture failed", exc_info=True)
+
+
 class LinearEstimatorBase(Estimator, LinearTrainParams,
                           IterationRuntimeMixin):
     """Shared SGD fit path (ref: LogisticRegression.fit:60 → SGD.optimize)."""
@@ -221,7 +249,9 @@ class LinearEstimatorBase(Estimator, LinearTrainParams,
         self.last_execution_path = getattr(sgd, "last_execution_path",
                                            None)
         model = self.model_class(coefficients=coeffs)
-        return self.copy_params_to(model)
+        model = self.copy_params_to(model)
+        _capture_drift_baseline(self, model, x, coeffs)
+        return model
 
 
 def prediction_output(table: Table, name: str, values: np.ndarray) -> Table:

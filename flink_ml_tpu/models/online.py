@@ -833,6 +833,34 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
         model.coefficients = coeffs
         model.model_version = version
         model.history = history
+        # drift baseline (observability/drift.py): sketch a row-capped
+        # sample of the training inputs + the FINAL model's predictions
+        # on it, so publish_model ships the distribution this exact
+        # snapshot was trained on (the train-and-serve handoff's other
+        # half). Table path only — an unbounded stream has no finite
+        # "training set" to summarize; its consumers publish per
+        # snapshot from the batch view instead.
+        try:
+            from flink_ml_tpu.observability import drift as _mldrift
+
+            if _mldrift.capture_armed() and isinstance(data, Table):
+                from flink_ml_tpu.linalg import sparse as _sparse
+                from flink_ml_tpu.models.common import predict_dots
+
+                xs = _mldrift.sample_rows(
+                    _sparse.features_matrix(data, self.features_col))
+                fdots, _xp = predict_dots(xs, coeffs)
+                pred = (np.asarray(fdots, np.float64)
+                        >= 0).astype(np.float64)
+                _mldrift.capture_fit_baseline(
+                    model, algo, features=xs, predictions=pred,
+                    version=version)
+        except Exception:  # noqa: BLE001 — telemetry must not sink
+            # the fit that just produced a valid model
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "drift baseline capture failed", exc_info=True)
         return model
 
 

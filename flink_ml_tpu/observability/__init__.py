@@ -11,7 +11,11 @@ XLA compile counts/durations, recompile storms, per-program FLOP/byte
 cost and HBM watermarks into the same artifact set. Model-health
 telemetry (``health``) adds convergence series, device-side non-finite
 sentinels, divergence events and serving-path metrics — inspect with
-``flink-ml-tpu-trace health <dir>``.
+``flink-ml-tpu-trace health <dir>``. Drift detection (``drift``)
+captures training-time distribution baselines at fit time, sketches
+live serving traffic with mergeable streaming sketches, and compares
+the two (PSI / Jensen-Shannon / KS) per model version — inspect with
+``flink-ml-tpu-trace drift <dir>`` or the live ``/drift`` route.
 """
 
 from flink_ml_tpu.observability.compilestats import (
@@ -32,6 +36,17 @@ from flink_ml_tpu.observability.health import (
     guard_final_state,
     observe_serving,
     summarize_values,
+)
+from flink_ml_tpu.observability.drift import (
+    DRIFT_EVENT,
+    DriftBaseline,
+    SketchGroup,
+    StreamingSketch,
+    capture_fit_baseline,
+    compare_sketches,
+    drift_report,
+    install_baseline,
+    observe_transform,
 )
 from flink_ml_tpu.observability.exporters import (
     chrome_trace,
@@ -77,7 +92,16 @@ from flink_ml_tpu.observability.tracing import (
 
 __all__ = [
     "CONVERGENCE_EVENT",
+    "DRIFT_EVENT",
+    "DriftBaseline",
     "HEALTH_EVENT",
+    "SketchGroup",
+    "StreamingSketch",
+    "capture_fit_baseline",
+    "compare_sketches",
+    "drift_report",
+    "install_baseline",
+    "observe_transform",
     "METRICS_PORT_ENV",
     "SKEW_EVENT",
     "SLO",

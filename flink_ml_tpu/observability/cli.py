@@ -15,6 +15,7 @@ rerunning anything:
     flink-ml-tpu-trace health TRACE_DIR --check  # model health (exit 3)
     flink-ml-tpu-trace shards TRACE_DIR --check  # per-device mesh view
     flink-ml-tpu-trace slo TRACE_DIR --check     # SLO verdicts (exit 4)
+    flink-ml-tpu-trace drift TRACE_DIR --check   # drift verdicts (exit 4)
     flink-ml-tpu-trace ROOT --latest             # newest trace dir under ROOT
 
 Sections: top spans by self-time (time in a span minus its children —
@@ -38,7 +39,14 @@ lane really ran multi-device. The ``slo`` subcommand
 against the metrics artifacts and with ``--check`` exits 4 on a
 violation — the serving twin of the ``diff`` perf gate; the live,
 windowed verdicts come from the ``/slo`` endpoint of a running process
-(observability/server.py). Every subcommand accepts ``--latest``:
+(observability/server.py). The ``drift`` subcommand
+(observability/drift.py) compares the live sketch artifacts against
+their training-time baselines (PSI / Jensen-Shannon distance / KS per
+feature and for predictions) and with ``--check`` exits 4 when any
+servable drifted, 2 on missing/broken artifacts — a servable published
+without a baseline reports ``source: missing`` and never fails the
+gate; the live verdicts come from the ``/drift`` endpoint. Every
+subcommand accepts ``--latest``:
 treat the positional dir as a root and resolve the newest trace dir
 under it (exporters.resolve_trace_dir) — no more hand-globbing.
 
@@ -199,6 +207,12 @@ def main(argv=None) -> int:
         from flink_ml_tpu.observability.slo import main as slo_main
 
         return slo_main(argv[1:])
+    if argv and argv[0] == "drift":
+        # drift verdicts (observability/drift.py); same dispatch rule —
+        # use ./drift to summarize a directory named "drift"
+        from flink_ml_tpu.observability.drift import main as drift_main
+
+        return drift_main(argv[1:])
     if argv and argv[0] == "summary":
         # explicit subcommand spelling for the default view, so
         # unattended consumers can write `summary --json` without

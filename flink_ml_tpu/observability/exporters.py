@@ -174,13 +174,23 @@ def write_chrome_trace(trace_dir: str, out_path: str) -> int:
 def dump_metrics(trace_dir: str,
                  registry: MetricsRegistry = metrics) -> str:
     """Write the registry snapshot as ``metrics-<pid>.json`` (overwrite:
-    the newest snapshot per process supersedes earlier ones)."""
+    the newest snapshot per process supersedes earlier ones). When the
+    drift module is loaded (observability/drift.py — the package import
+    chain loads it; the sys.modules gate only protects embeddings that
+    strip it), its live-sketch state dumps alongside as
+    ``drift-<pid>.json`` — a no-op for processes that never sketched."""
     os.makedirs(trace_dir, exist_ok=True)
     path = os.path.join(trace_dir, f"metrics-{os.getpid()}.json")
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(registry.snapshot(), f, default=str)
     os.replace(tmp, path)
+    drift_mod = sys.modules.get("flink_ml_tpu.observability.drift")
+    if drift_mod is not None:
+        try:
+            drift_mod.dump_state(trace_dir)
+        except OSError:
+            pass  # the metrics snapshot is the primary artifact
     return path
 
 

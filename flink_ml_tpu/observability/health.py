@@ -70,6 +70,7 @@ __all__ = [
     "CONVERGENCE_EVENT",
     "VALUE_BUCKETS",
     "COUNT_BUCKETS",
+    "SUMMARY_BUCKETS",
     "armed",
     "guard_enabled",
     "finite_sentinel",
@@ -116,6 +117,13 @@ VALUE_BUCKETS = (1e-8, 1e-6, 1e-4, 1e-3, 1e-2, 0.1, 0.25, 0.5, 1.0, 2.5,
 #: row-count-shaped bounds for serving batch sizes
 COUNT_BUCKETS = (1.0, 8.0, 32.0, 128.0, 512.0, 2048.0, 8192.0, 65536.0,
                  1048576.0)
+
+#: prediction/probability-shaped bounds for the windowed value
+#: distributions :func:`summarize_values` records — symmetric around 0
+#: with fine structure in [0, 1] (probabilities, 0/1 predictions) and
+#: coarse decades outward (margins, regression outputs)
+SUMMARY_BUCKETS = (-1e6, -1e3, -10.0, -1.0, -0.1, 0.0, 0.1, 0.25, 0.5,
+                   0.75, 0.9, 1.0, 10.0, 1e3, 1e6)
 
 #: probabilistic request-trace sampling rate for the serving seam
 #: (0..1; default 1.0 — every request, turn it down under load)
@@ -518,11 +526,18 @@ def observe_serving_rejected(servable: str, reason: str) -> None:
 
 
 def summarize_values(servable: str, name: str, values) -> None:
-    """Record a distribution summary — ``<name>Min/Max/Mean/
-    FiniteFraction`` gauges in ``ml.serving``, labeled by servable — for
-    one batch of numeric values (the drift baseline). A batch with
-    non-finite values emits an ``ml.health`` ``non-finite-<name>``
-    event; nothing ever raises from here."""
+    """Record a distribution summary for one batch of numeric values:
+    the ``<name>Min/Max/Mean/FiniteFraction`` gauges in ``ml.serving``
+    (labeled by servable — per-batch, last-write-wins: the cumulative
+    Prometheus view, byte-identical to before) PLUS a **windowed**
+    ``<name>Values`` histogram (common/metrics.py WindowedHistogram,
+    :data:`SUMMARY_BUCKETS`), so ``/slo``, ``/drift`` and the drift
+    evaluator (observability/drift.py) can read the *recent* value
+    distribution instead of whatever batch happened to write the gauges
+    last — one early outlier batch no longer poisons the only record of
+    the distribution for the process lifetime. A batch with non-finite
+    values emits an ``ml.health`` ``non-finite-<name>`` event; nothing
+    ever raises from here."""
     group = metrics.group(ML_GROUP, "serving")
     labels = {"servable": servable}
     try:
@@ -539,6 +554,12 @@ def summarize_values(servable: str, name: str, values) -> None:
         group.gauge(f"{name}Min", float(fv.min()), labels=labels)
         group.gauge(f"{name}Max", float(fv.max()), labels=labels)
         group.gauge(f"{name}Mean", float(fv.mean()), labels=labels)
+        hist = group.windowed_histogram(
+            f"{name}Values", buckets=SUMMARY_BUCKETS,
+            horizon_s=SERVING_HORIZON_S, slices=SERVING_SLICES,
+            labels=labels)
+        for v in fv:
+            hist.observe(float(v))
     if frac < 1.0:
         report_divergence(servable, f"non-finite-{name}",
                           fraction=round(frac, 6), rows=int(vals.size))

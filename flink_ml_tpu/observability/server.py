@@ -6,24 +6,45 @@ from a trace dir; this module serves the live half — a stdlib
 ``FLINK_ML_TPU_METRICS_PORT`` (``0`` binds an ephemeral port; read it
 back from :attr:`TelemetryServer.port`), started lazily by the first
 instrumented seam that runs (api/stage.py fit/transform, the servable
-``_served`` wrapper). Routes:
+``_served`` wrapper).
 
-- ``/metrics`` — the process registry in Prometheus text exposition
-  (observability/exporters.py), cumulative histograms included, so any
-  scraper computes its own windows;
-- ``/healthz`` — liveness + readiness JSON (status, pid, uptime): 200
-  while every registered readiness gate is ready, 503 with per-gate
-  reasons otherwise (serving warmup registers one, serving/warmup.py);
-- ``/serving`` — the serving runtime's live status (queue depth, bucket
-  table, active model version) when a runtime registered a provider
-  (serving/batcher.py), ``{"serving": null}`` otherwise;
-- ``/slo`` — live SLO verdicts (observability/slo.py) over the
-  registry's *windowed* metrics; violations emit their events/counters
-  on every evaluation, so scraping doubles as the burn-rate alerter;
-- ``/spans/recent`` — the tracer's in-memory ring of recently closed
-  spans (tracing.RECENT_SPANS; arming the endpoint flips
-  ``tracer.keep_recent`` so request-scoped spans exist even without a
-  trace dir).
+THE route table (also :data:`ROUTE_TABLE` — the dispatch map, the 404
+body and this doc all render from one definition, so they cannot
+drift):
+
+================  ==========================================  =============================
+route             serves                                      response with no data
+================  ==========================================  =============================
+``/metrics``      process registry, Prometheus text           empty exposition (0 families)
+                  exposition (cumulative histograms — any
+                  scraper computes its own windows)
+``/healthz``      liveness + readiness JSON (status, pid,     200 ``{"status": "ok"}`` —
+                  uptime); 503 + per-gate reasons while any   no gates registered means
+                  readiness gate is unready (serving          ready
+                  warmup registers one, serving/warmup.py)
+``/slo``          live SLO verdicts (observability/slo.py)    200, verdicts evaluate over
+                  over the registry's *windowed* metrics;     empty windows (every
+                  violations emit events/counters on every    objective ``ok`` with 0
+                  evaluation — scraping doubles as the        samples)
+                  burn-rate alerter
+``/serving``      the serving runtime's live status (queue    200 ``{"serving": null}`` —
+                  depth, bucket table, active model version)  no runtime registered a
+                  from the registered provider                provider (serving/batcher.py)
+                  (serving/batcher.py)
+``/drift``        live drift verdicts                         200 with an empty
+                  (observability/drift.py): PSI/JS/KS per     ``servables`` map — nothing
+                  servable series vs the installed            sketched yet; a servable
+                  training-time baselines; evaluating emits   without a baseline reports
+                  the events/gauges, so scraping doubles as   ``source: "missing"``
+                  the drift alerter
+``/spans/recent`` the tracer's in-memory ring of recently     200 ``{"spans": []}``
+                  closed spans (tracing.RECENT_SPANS;
+                  arming the endpoint flips
+                  ``tracer.keep_recent`` so request-scoped
+                  spans exist even without a trace dir)
+================  ==========================================  =============================
+
+Any other path: 404 JSON naming the known routes.
 
 **Driver-only.** Host-pool children (common/hostpool.py) never listen:
 :func:`maybe_start` refuses in any pid other than the one that imported
@@ -47,7 +68,8 @@ from typing import Optional
 from flink_ml_tpu.common.metrics import metrics
 from flink_ml_tpu.observability import tracing
 
-__all__ = ["METRICS_PORT_ENV", "METRICS_HOST_ENV", "TelemetryServer",
+__all__ = ["METRICS_PORT_ENV", "METRICS_HOST_ENV", "ROUTE_TABLE",
+           "ROUTES", "TelemetryServer",
            "maybe_start", "stop", "reseed_child", "set_gate",
            "clear_gate", "readiness", "set_serving_status",
            "get_serving_status", "clear_serving_status"]
@@ -58,7 +80,25 @@ METRICS_PORT_ENV = "FLINK_ML_TPU_METRICS_PORT"
 #: bind address (default loopback — a sidecar scraper; widen explicitly)
 METRICS_HOST_ENV = "FLINK_ML_TPU_METRICS_HOST"
 
-ROUTES = ("/metrics", "/healthz", "/slo", "/serving", "/spans/recent")
+#: route → (handler method name on _Handler, no-data response note) —
+#: the ONE definition the dispatch, the 404 body and the module
+#: docstring's table derive from
+ROUTE_TABLE = {
+    "/metrics": ("_route_metrics",
+                 "empty Prometheus exposition (0 families)"),
+    "/healthz": ("_route_healthz",
+                 '200 {"status": "ok"} — no gates registered'),
+    "/slo": ("_route_slo",
+             "200, every objective ok with 0 samples"),
+    "/serving": ("_route_serving",
+                 '200 {"serving": null} — no runtime provider'),
+    "/drift": ("_route_drift",
+               '200 with an empty "servables" map; no baseline → '
+               'source: "missing"'),
+    "/spans/recent": ("_route_spans_recent", '200 {"spans": []}'),
+}
+
+ROUTES = tuple(ROUTE_TABLE)
 
 _PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
 _JSON_CTYPE = "application/json"
@@ -146,59 +186,79 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    # -- one method per ROUTE_TABLE row --------------------------------------
+    def _route_metrics(self) -> None:
+        from flink_ml_tpu.observability.exporters import (
+            prometheus_text,
+        )
+
+        self._send(200, prometheus_text(metrics.snapshot()),
+                   _PROM_CTYPE)
+
+    def _route_healthz(self) -> None:
+        ready, blocked = readiness()
+        body = {"status": "ok" if ready else "unready",
+                "pid": os.getpid(),
+                "uptime_s": round(time.monotonic() - _t0, 3),
+                "tracing": tracing.tracer.enabled}
+        if not ready:
+            # 503: the readiness half of the probe — alive but not yet
+            # fit to take traffic (e.g. serving warmup still compiling
+            # bucket shapes)
+            body["reasons"] = blocked
+        self._send(200 if ready else 503, json.dumps(body),
+                   _JSON_CTYPE)
+
+    def _route_slo(self) -> None:
+        from flink_ml_tpu.observability import slo
+
+        verdicts = slo.evaluate_slos(slo.active_slos(), emit=True)
+        self._send(200, json.dumps(
+            {"source": "windowed", "verdicts": verdicts,
+             "violated": [v["slo"] for v in verdicts
+                          if not v["ok"]]},
+            default=str), _JSON_CTYPE)
+
+    def _route_serving(self) -> None:
+        provider = _serving_status
+        status = provider() if provider is not None else None
+        self._send(200, json.dumps({"serving": status},
+                                   default=str), _JSON_CTYPE)
+
+    def _route_drift(self) -> None:
+        from flink_ml_tpu.observability import drift
+        from flink_ml_tpu.observability.health import _json_safe
+
+        # emit=True: scraping doubles as the drift alerter, exactly
+        # like /slo — the verdict gauges/events land on every scrape.
+        # _json_safe: never-observed series carry NaN stats, and the
+        # bare NaN token is unparseable strict JSON
+        self._send(200, json.dumps(
+            _json_safe(drift.drift_report(emit=True)),
+            default=str), _JSON_CTYPE)
+
+    def _route_spans_recent(self) -> None:
+        # deque.append is thread-safe but ITERATION is not: serving
+        # threads ring spans concurrently, and a mid-iteration append
+        # raises RuntimeError — retry
+        spans = []
+        for _ in range(8):
+            try:
+                spans = list(tracing.tracer.recent)
+                break
+            except RuntimeError:
+                continue
+        self._send(200, json.dumps({"spans": spans},
+                                   default=str), _JSON_CTYPE)
+
     def do_GET(self):  # noqa: N802 — http.server's casing
         path = self.path.split("?", 1)[0]
         if path != "/" and path.endswith("/"):
             path = path.rstrip("/")
         try:
-            if path == "/metrics":
-                from flink_ml_tpu.observability.exporters import (
-                    prometheus_text,
-                )
-
-                self._send(200, prometheus_text(metrics.snapshot()),
-                           _PROM_CTYPE)
-            elif path == "/healthz":
-                ready, blocked = readiness()
-                body = {"status": "ok" if ready else "unready",
-                        "pid": os.getpid(),
-                        "uptime_s": round(time.monotonic() - _t0, 3),
-                        "tracing": tracing.tracer.enabled}
-                if not ready:
-                    # 503: the readiness half of the probe — alive but
-                    # not yet fit to take traffic (e.g. serving warmup
-                    # still compiling bucket shapes)
-                    body["reasons"] = blocked
-                self._send(200 if ready else 503, json.dumps(body),
-                           _JSON_CTYPE)
-            elif path == "/slo":
-                from flink_ml_tpu.observability import slo
-
-                verdicts = slo.evaluate_slos(slo.active_slos(),
-                                             emit=True)
-                self._send(200, json.dumps(
-                    {"source": "windowed", "verdicts": verdicts,
-                     "violated": [v["slo"] for v in verdicts
-                                  if not v["ok"]]},
-                    default=str), _JSON_CTYPE)
-            elif path == "/serving":
-                provider = _serving_status
-                status = provider() if provider is not None else None
-                self._send(200, json.dumps({"serving": status},
-                                           default=str), _JSON_CTYPE)
-            elif path == "/spans/recent":
-                # deque.append is thread-safe but ITERATION is not:
-                # serving threads ring spans concurrently, and a
-                # mid-iteration append raises RuntimeError — retry
-                spans = []
-                for _ in range(8):
-                    try:
-                        spans = list(tracing.tracer.recent)
-                        break
-                    except RuntimeError:
-                        continue
-                self._send(200, json.dumps({"spans": spans},
-                                           default=str), _JSON_CTYPE)
+            row = ROUTE_TABLE.get(path)
+            if row is not None:
+                getattr(self, row[0])()
             else:
                 self._send(404, json.dumps(
                     {"error": f"no route {path!r}",

@@ -8,8 +8,11 @@ and error counters into ``ml.serving`` (common/metrics.py
 turns them into verdicts:
 
 - an :class:`SLO` pairs a metric selector with ONE objective — a
-  latency quantile bound (``p99 of transformMs <= threshold_ms``) or a
-  max error ratio (``errors / (errors + transforms) <= max``) — over a
+  latency quantile bound (``p99 of transformMs <= threshold_ms``), a
+  max error ratio (``errors / (errors + transforms) <= max``), or a
+  **drift** bound (the worst ``drift{servable=,feature=,stat=}`` gauge
+  the drift evaluator records, observability/drift.py, must stay
+  ``<= max_drift``; no gauges → ok, ``source: "missing"``) — over a
   primary ``window_s``;
 - every SLO additionally evaluates **multi-window burn rates** (Google
   SRE style): the fraction of the error budget being consumed, per
@@ -86,16 +89,24 @@ SLO_SPEC_ENV = "FLINK_ML_TPU_SLO_SPEC"
 #: the SRE-handbook fast/slow pair scaled to a process-local horizon
 DEFAULT_BURN_WINDOWS = ((60.0, 14.4), (300.0, 6.0))
 
-_KINDS = ("latency", "error-rate")
+_KINDS = ("latency", "error-rate", "drift")
 
 
 @dataclasses.dataclass
 class SLO:
     """One declarative objective over a metric family. Fields unused by
-    the ``kind`` (e.g. ``threshold_ms`` for error-rate) are ignored."""
+    the ``kind`` (e.g. ``threshold_ms`` for error-rate) are ignored.
+
+    Kind ``drift`` reads the ``drift{servable=,feature=,stat=}`` gauges
+    the drift evaluator records (observability/drift.py): the max gauge
+    matching ``stat`` (+ any ``labels`` narrowing) must stay at or
+    under ``max_drift``; with no matching gauges the objective is ok
+    and tagged ``source: "missing"`` — an unpublished baseline must
+    never fail an SLO. ``group`` defaults to ``ml.drift`` for this
+    kind."""
 
     name: str
-    kind: str = "latency"            # "latency" | "error-rate"
+    kind: str = "latency"            # "latency" | "error-rate" | "drift"
     group: str = f"{ML_GROUP}.serving"
     histogram: str = "transformMs"   # latency source (ms histogram)
     total: str = "transforms"        # error-rate denominator counter
@@ -106,6 +117,8 @@ class SLO:
     max_error_ratio: float = 0.01
     window_s: float = 60.0
     burn_windows: Tuple[Tuple[float, float], ...] = DEFAULT_BURN_WINDOWS
+    stat: str = "psi"                # drift statistic: psi | js | ks
+    max_drift: float = 0.2           # drift gauge bound
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -117,6 +130,16 @@ class SLO:
                 f"SLO {self.name!r}: quantile must be in (0, 1)")
         if float(self.window_s) <= 0:
             raise ValueError(f"SLO {self.name!r}: window_s must be > 0")
+        if self.kind == "drift":
+            if self.stat not in ("psi", "js", "ks"):
+                raise ValueError(
+                    f"SLO {self.name!r}: drift stat must be psi|js|ks, "
+                    f"got {self.stat!r}")
+            if self.group == f"{ML_GROUP}.serving":
+                # the drift gauges live in their own group; only the
+                # untouched default is redirected — an explicit group
+                # (a custom evaluator's) is honored
+                self.group = f"{ML_GROUP}.drift"
         self.burn_windows = tuple(
             (float(w), float(m)) for w, m in self.burn_windows)
 
@@ -284,6 +307,13 @@ class _RegistrySource:
             return sum(vals), "cumulative"
         return 0, "none"
 
+    def gauge_values(self, group: str, name: str,
+                     labels: Optional[Dict[str, str]]):
+        gauges = self._registry.group(
+            *group.split(".")).snapshot().get("gauges", {})
+        return [(k, float(v)) for k, v in gauges.items()
+                if _match_key(k, name, labels)]
+
 
 class _SnapshotSource:
     """Artifact evaluation: a merged registry snapshot is cumulative —
@@ -307,6 +337,18 @@ class _SnapshotSource:
         if vals:
             return sum(vals), "cumulative"
         return 0, "none"
+
+    def gauge_values(self, group, name, labels):
+        gauges = (self._snap.get(group) or {}).get("gauges", {})
+        out = []
+        for k, v in gauges.items():
+            if not _match_key(k, name, labels):
+                continue
+            try:
+                out.append((k, float(v)))
+            except (TypeError, ValueError):
+                continue  # non-numeric gauge: not comparable
+        return out
 
 
 # -- evaluation ---------------------------------------------------------------
@@ -376,6 +418,29 @@ def _eval_error_rate(slo: SLO, source) -> List[dict]:
     return objectives
 
 
+def _eval_drift(slo: SLO, source) -> List[dict]:
+    """The ``drift`` objective: the worst matching
+    ``drift{servable=,feature=,stat=}`` gauge (observability/drift.py
+    records them on every evaluation) must not exceed ``max_drift``.
+    No matching gauges — no baseline published, or no evaluation yet —
+    is ok with ``source: "missing"``: drift absence of evidence never
+    burns an error budget."""
+    labels = dict(slo.labels or {})
+    labels["stat"] = slo.stat
+    gauges = source.gauge_values(slo.group, "drift", labels)
+    finite = [(k, v) for k, v in gauges if math.isfinite(v)]
+    if not finite:
+        return [{"objective": "drift-stat", "stat": slo.stat,
+                 "value": None, "max_drift": slo.max_drift,
+                 "series": 0, "worst": None, "ok": True,
+                 "source": "missing"}]
+    worst_key, worst = max(finite, key=lambda kv: kv[1])
+    return [{"objective": "drift-stat", "stat": slo.stat,
+             "value": round(worst, 6), "max_drift": slo.max_drift,
+             "series": len(finite), "worst": worst_key,
+             "ok": worst <= slo.max_drift, "source": "gauge"}]
+
+
 def evaluate_slos(slos: Optional[Sequence[SLO]] = None, registry=None,
                   snapshot: Optional[Dict[str, dict]] = None,
                   emit: bool = False) -> List[dict]:
@@ -394,9 +459,12 @@ def evaluate_slos(slos: Optional[Sequence[SLO]] = None, registry=None,
                                  else registry)
     verdicts = []
     for slo in slos:
-        objectives = (_eval_latency(slo, source)
-                      if slo.kind == "latency"
-                      else _eval_error_rate(slo, source))
+        if slo.kind == "latency":
+            objectives = _eval_latency(slo, source)
+        elif slo.kind == "drift":
+            objectives = _eval_drift(slo, source)
+        else:
+            objectives = _eval_error_rate(slo, source)
         ok = all(o["ok"] for o in objectives)
         verdicts.append({"slo": slo.name, "kind": slo.kind, "ok": ok,
                          "objectives": objectives})
@@ -420,6 +488,16 @@ def render_verdicts(verdicts: List[dict]) -> str:
         out.append(f"SLO {v['slo']} ({v['kind']})  "
                    f"[{'ok' if v['ok'] else 'VIOLATED'}]")
         for o in v["objectives"]:
+            if o["objective"] == "drift-stat":
+                val = "-" if o["value"] is None else f"{o['value']:g}"
+                worst = f" worst {o['worst']}" if o.get("worst") else ""
+                flag = "ok" if o["ok"] else "VIOLATED"
+                out.append(
+                    f"  {o['objective']:<17} "
+                    f"{'(' + o['source'] + ')':<26} "
+                    f"{o['stat']} {val} (<= {o['max_drift']:g}, "
+                    f"{o['series']} series){worst}  [{flag}]")
+                continue
             window = f"window {o['window_s']:g}s ({o['source']})"
             if o["objective"] == "latency-quantile":
                 val = "-" if o["value_ms"] is None else \

@@ -184,8 +184,10 @@ def _served(method):
     (observability/health.py; docs/observability.md "Live telemetry &
     SLOs"): windowed latency + row-count histograms and a
     prediction-distribution summary labeled by servable class — the
-    ``MLMetrics`` role of the reference's servable core, and this
-    repo's drift baseline — plus an in-flight gauge, per-exception-class
+    ``MLMetrics`` role of the reference's servable core — feeds the
+    windowed live sketches drift detection compares against the
+    training-time baseline (observability/drift.py) — plus an
+    in-flight gauge, per-exception-class
     error counters (the error-rate SLO input; the exception re-raises
     after being counted), a request-scoped span sampled at
     ``FLINK_ML_TPU_TRACE_SAMPLE``, and a best-effort start of the
@@ -260,6 +262,30 @@ def _served(method):
                     predictions = out.get(col).values
             health.observe_serving(servable, rows, elapsed_ms,
                                    predictions=predictions)
+            # drift: sketch this transform's feature columns +
+            # predictions into the servable's windowed live sketches
+            # (observability/drift.py) — the live half the training-time
+            # baseline is compared against
+            from flink_ml_tpu.observability import drift
+
+            # the micro-batcher pads batches by duplicating the tail
+            # row and marks the real count — sketch only real rows, or
+            # a 1-row request padded to bucket 8 would overweight one
+            # sample 8x and inflate the min-count floor
+            real = getattr(df, "drift_real_rows", None)
+            features = None
+            fcol = getattr(self, "features_col", None)
+            if (fcol and isinstance(df, DataFrame)
+                    and fcol in df.column_names):
+                features = df.get(fcol).values
+                if real is not None:
+                    features = features[:real]
+            drift_preds = predictions
+            if real is not None and drift_preds is not None:
+                drift_preds = list(drift_preds)[:real]
+            if features is not None or drift_preds is not None:
+                drift.observe_transform(servable, features=features,
+                                        predictions=drift_preds)
         except Exception:  # noqa: BLE001 — see docstring
             logging.getLogger(__name__).warning(
                 "serving metrics recording failed", exc_info=True)

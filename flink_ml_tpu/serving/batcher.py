@@ -387,6 +387,11 @@ class MicroBatcher:
             rows.append(type(rows[-1])(list(rows[-1].values)))
         batch_df = DataFrame(list(schema),
                              list(kept[0].df.data_types), rows)
+        # drift seam (observability/drift.py): pad rows are DUPLICATES
+        # appended at the tail — sketching them would overweight one
+        # row and inflate the sample floor with dependent copies; the
+        # _served wrapper slices features/predictions to this count
+        batch_df.drift_real_rows = n_real
         fill = n_real / bucket if bucket else 1.0
         waste = pad / bucket if bucket else 0.0
         for req in kept:

@@ -60,13 +60,27 @@ __all__ = ["publish_model", "ModelRegistry"]
 
 
 def publish_model(watch_dir: str, leaves, version: int,
-                  keep: int = 8) -> str:
+                  keep: int = 8, baseline=None) -> str:
     """Trainer-side publish: write model ``leaves`` (a list/pytree of
     arrays) as checkpoint version ``version`` under ``watch_dir`` —
     v2 manifest, fsynced, atomically renamed — and return the published
-    path. The serving registry's watcher picks it up on its next poll."""
+    path. The serving registry's watcher picks it up on its next poll.
+
+    ``baseline`` (a :class:`~flink_ml_tpu.observability.drift
+    .DriftBaseline`, typically the fitted model's ``drift_baseline``
+    captured by the traced-fit seam) is serialized as
+    ``drift-baseline.json`` beside the manifest inside the same atomic
+    rename, so the watcher installs the *matching* training-time
+    distribution summary with every hot-swap; publishing without one is
+    fine — drift evaluation then reports ``source: missing``."""
     manager = CheckpointManager(watch_dir, keep=keep)
-    return manager.save(leaves, int(version))
+    extras = None
+    if baseline is not None:
+        from flink_ml_tpu.observability import drift
+
+        extras = {os.path.splitext(drift.BASELINE_FILENAME)[0]:
+                  baseline.to_json()}
+    return manager.save(leaves, int(version), extras=extras)
 
 
 class ModelRegistry:
@@ -174,7 +188,19 @@ class ModelRegistry:
             raise CandidateRejected(self.model, version, "load-error",
                                     f"{type(e).__name__}: {e}") from e
         candidate.serving_name = f"{self.model}@v{version}"
-        self._probe_candidate(candidate, version)
+        # install the baseline BEFORE the probe: the probe's transform
+        # runs through the _served seam, which creates the candidate's
+        # live drift window — it must be seeded with the baseline's bin
+        # edges at creation, not auto-range its own
+        self._install_baseline(candidate.serving_name, ckpt_dir,
+                               version)
+        try:
+            self._probe_candidate(candidate, version)
+        except Exception:
+            # a rejected candidate's versioned name never serves —
+            # drop its drift state so it cannot linger as "missing"
+            self._forget_baseline(candidate.serving_name)
+            raise
         with self._lock:
             previous = self._version
             self._active = candidate
@@ -186,6 +212,50 @@ class ModelRegistry:
                              version=version,
                              previous=previous if previous is not None
                              else "none")
+
+    def _install_baseline(self, serving_name: str, ckpt_dir: str,
+                          version: int) -> None:
+        """Install the drift baseline published beside this version's
+        manifest (observability/drift.py), keyed by the VERSIONED
+        serving name — so requests still in flight on the previous
+        version keep comparing against the previous baseline. Runs
+        BEFORE the candidate probe (whose transform creates the live
+        window that must seed from these bin edges); a missing or
+        unreadable baseline records ``source: missing`` / a
+        ``baselineMissing`` counter and NEVER blocks the swap."""
+        try:
+            from flink_ml_tpu.observability import drift
+        except ImportError:  # pragma: no cover — drift rides the pkg
+            return
+        baseline = None
+        try:
+            baseline = drift.load_baseline_file(
+                os.path.join(ckpt_dir, drift.BASELINE_FILENAME))
+        except ValueError as e:
+            tracing.tracer.event("serving.baseline.invalid",
+                                 model=self.model, version=version,
+                                 detail=str(e))
+        if baseline is not None:
+            # the registry's published version is the authoritative one
+            # (the fit-side capture may carry the trainer's own counter)
+            baseline.version = int(version)
+        try:
+            drift.install_baseline(serving_name, baseline)
+        except Exception:  # noqa: BLE001 — telemetry must never undo
+            # a committed swap
+            pass
+        if baseline is None:
+            self._group.counter("baselineMissing",
+                                labels={"model": self.model})
+
+    def _forget_baseline(self, serving_name: str) -> None:
+        try:
+            from flink_ml_tpu.observability import drift
+
+            drift.forget_servable(serving_name)
+        except Exception:  # noqa: BLE001 — cleanup only; the rejection
+            # (the real verdict) must propagate unchanged
+            pass
 
     def _probe_candidate(self, candidate, version: int) -> None:
         if self._probe is not None:

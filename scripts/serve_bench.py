@@ -81,10 +81,12 @@ def fail(code: int, message: str):
     raise SystemExit(code)
 
 
-def train_ftrl(dim: int, rows: int, batch: int) -> np.ndarray:
+def train_ftrl(dim: int, rows: int, batch: int):
     """FTRL-train an LR model on a synthetic stream; returns the
-    coefficient vector — the online-learning producer whose snapshots
-    the registry serves."""
+    coefficient vector and the training-time drift baseline the
+    traced-fit seam captured (observability/drift.py) — the
+    online-learning producer whose snapshots the registry serves,
+    published WITH the distribution they were trained on."""
     from flink_ml_tpu.common.table import Table, as_dense_vector_column
     from flink_ml_tpu.models.online import OnlineLogisticRegression
 
@@ -99,7 +101,8 @@ def train_ftrl(dim: int, rows: int, batch: int) -> np.ndarray:
     model = (OnlineLogisticRegression(global_batch_size=batch,
                                       alpha=0.5, beta=0.5)
              .set_initial_model_data(init).fit(table))
-    return np.asarray(model.coefficients, np.float64)
+    return (np.asarray(model.coefficients, np.float64),
+            getattr(model, "drift_baseline", None))
 
 
 def make_frame_factory(dim: int):
@@ -169,13 +172,14 @@ def main(argv=None) -> int:
     def request_frame(i: int) -> DataFrame:
         return frame(REQUEST_SIZES[i % len(REQUEST_SIZES)])
 
-    # -- train (FTRL) and publish v1 -----------------------------------------
+    # -- train (FTRL) and publish v1 (baseline rides the checkpoint) ---------
     t0 = time.perf_counter()
-    coef = train_ftrl(args.dim, rows=4000 if args.smoke else 20000,
-                      batch=500)
+    coef, baseline = train_ftrl(args.dim,
+                                rows=4000 if args.smoke else 20000,
+                                batch=500)
     train_ms = (time.perf_counter() - t0) * 1000.0
     watch_dir = os.path.join(root, "models")
-    publish_model(watch_dir, [coef], 1)
+    publish_model(watch_dir, [coef], 1, baseline=baseline)
     registry = ModelRegistry(watch_dir, lr_loader, model="lr",
                              probe=lambda: frame(buckets[0]),
                              poll_interval_s=0.05)
@@ -225,8 +229,9 @@ def main(argv=None) -> int:
     registry.start_watcher()
     steady_base = compile_count()
     # publish v2 NOW: the watcher adopts it while the measured run is
-    # in flight — the zero-downtime hot-swap under load
-    publish_model(watch_dir, [coef * 1.01], 2)
+    # in flight — the zero-downtime hot-swap under load (v2 carries the
+    # same training baseline: the coefficients moved, the data did not)
+    publish_model(watch_dir, [coef * 1.01], 2, baseline=baseline)
     batched = best_of(batcher.submit)
     steady_compiles = compile_count() - steady_base
     swapped_version = registry.version
@@ -286,6 +291,15 @@ def main(argv=None) -> int:
         "ftrl_train_ms": round(train_ms, 1),
         "sweep": sweep,
     }
+    # drift provenance (observability/drift.py): the benchmark's own
+    # traffic is drawn from the training distribution, so a non-null
+    # psi here that crosses the threshold means the drift layer (not
+    # the workload) regressed; baselineVersion proves the publish path
+    # shipped the baseline
+    from flink_ml_tpu.observability import drift
+
+    drift.drift_report(emit=False)  # refresh the per-servable stats
+    record.update(drift.provenance())
     with open(args.output, "w", encoding="utf-8") as f:
         json.dump(record, f, indent=2)
     print(f"serve_bench: wrote {args.output}")

@@ -1,0 +1,766 @@
+"""Drift detection (ISSUE 10): mergeable streaming sketches, fit-time
+baseline capture, baseline shipping through publish/hot-swap, the live
+comparison evaluator, the ``drift`` SLO objective, the ``/drift`` live
+route and the ``flink-ml-tpu-trace drift`` CLI gate.
+
+Acceptance bar: hostpool child sketches fold bit-exactly to the driver
+across the fork; a registry hot-swap to v2 installs v2's baseline while
+v1's stays installed for requests still in flight; shifted traffic
+drives ``mltrace drift --check`` to exit 4 while identically-distributed
+traffic exits 0; a missing baseline reports ``source: missing`` and
+never blocks a swap or fails the gate.
+"""
+
+import json
+import math
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.hostpool import map_row_shards
+from flink_ml_tpu.common.metrics import metrics
+from flink_ml_tpu.linalg.vectors import DenseVector
+from flink_ml_tpu.observability import drift, health, server, slo
+from flink_ml_tpu.observability.cli import main as trace_cli
+from flink_ml_tpu.observability.exporters import dump_metrics
+from flink_ml_tpu.observability.tracing import TRACE_DIR_ENV, tracer
+from flink_ml_tpu.servable.api import (
+    DataFrame,
+    DataTypes,
+    Row,
+    TransformerServable,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_drift(monkeypatch):
+    """Drift/tracer/endpoint singletons are process-wide — reset them,
+    and pin the evaluator knobs to deterministic test values."""
+    for var in (TRACE_DIR_ENV, drift.DRIFT_ENV, drift.PSI_ENV,
+                drift.JS_ENV, drift.KS_ENV, drift.MIN_COUNT_ENV,
+                drift.INTERVAL_ENV, drift.WINDOW_ENV,
+                server.METRICS_PORT_ENV):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv(drift.INTERVAL_ENV, "0")
+    monkeypatch.setenv(drift.MIN_COUNT_ENV, "20")
+    drift.clear()
+    metrics.clear()  # ml.drift gauges are last-write: stale ones from
+    # an earlier test would read as live drift
+    server.stop()
+    yield
+    drift.clear()
+    server.stop()
+    tracer.shutdown()
+
+
+def _normal_sketch(rng, n=2000, loc=0.0, edges=None):
+    sk = drift.StreamingSketch(edges=edges)
+    sk.observe_many(rng.normal(loc, 1.0, size=n))
+    return sk.finalize()
+
+
+# -- the streaming sketch -----------------------------------------------------
+
+def test_sketch_moments_and_range():
+    sk = drift.StreamingSketch(bins=8)
+    vals = np.asarray([1.0, 2.0, 3.0, 4.0, np.nan, np.inf])
+    sk.observe_many(vals)
+    assert sk.count == 4
+    assert sk.nonfinite == 2
+    assert sk.mean == pytest.approx(2.5)
+    assert sk.stddev == pytest.approx(np.std([1, 2, 3, 4.0]))
+    assert sk.vmin == 1.0 and sk.vmax == 4.0
+    # under warmup: raw values buffered, not yet binned
+    assert sk.edges is None and len(sk.pending) == 4
+    sk.finalize()
+    assert sk.edges is not None
+    assert sum(sk.counts) == 4 and not sk.pending
+
+
+def test_sketch_auto_range_freezes_at_warmup():
+    sk = drift.StreamingSketch()
+    sk.observe_many(np.linspace(0.0, 1.0, drift.WARMUP_VALUES))
+    assert sk.edges is not None  # warmup reached → range frozen
+    assert sk.edges[0] == 0.0 and sk.edges[-1] == 1.0
+    sk.observe(5.0)  # past the frozen range: overflow, not a rebin
+    assert sk.overflow == 1
+
+
+def test_sketch_json_round_trip_is_lossless():
+    rng = np.random.default_rng(3)
+    sk = _normal_sketch(rng)
+    doc = json.loads(json.dumps(sk.to_json()))
+    back = drift.StreamingSketch.from_json(doc)
+    assert back.to_json() == sk.to_json()
+
+
+def test_sketch_merge_same_edges_bit_exact():
+    rng = np.random.default_rng(4)
+    edges = tuple(np.linspace(-4, 4, 33))
+    a = _normal_sketch(rng, n=500, edges=edges)
+    b = _normal_sketch(rng, n=700, edges=edges)
+    both = drift.StreamingSketch(edges=edges)
+    # same observation batches in the same order → identical state
+    rng2 = np.random.default_rng(4)
+    both.observe_many(rng2.normal(size=500))
+    both.observe_many(rng2.normal(size=700))
+    a.merge(b.to_json())
+    assert a.to_json() == both.to_json()
+
+
+def test_sketch_merge_adopts_ranged_side_and_rebins_mismatch():
+    ranged = drift.StreamingSketch(edges=(0.0, 1.0, 2.0))
+    ranged.observe_many([0.5, 1.5])
+    fresh = drift.StreamingSketch()
+    fresh.observe_many([0.25, 1.75])
+    fresh.merge(ranged.to_json())
+    assert fresh.edges == (0.0, 1.0, 2.0)  # adopted, buffer flushed
+    assert sum(fresh.counts) == 4
+    other = drift.StreamingSketch(edges=(0.0, 0.5, 4.0))
+    other.observe_many([0.2, 3.0])
+    fresh.merge(other.to_json())
+    assert fresh.rebinned == 1
+    assert fresh.count == 6  # moments exact even when bins approximate
+
+
+def test_sketch_merge_rejects_malformed_counts():
+    sk = drift.StreamingSketch(edges=(0.0, 1.0, 2.0))
+    with pytest.raises(ValueError, match="bin mismatch"):
+        sk.merge({"edges": [0.0, 1.0, 2.0], "counts": [1]})
+
+
+# -- statistics ---------------------------------------------------------------
+
+def test_stats_identical_distribution_near_zero():
+    rng = np.random.default_rng(5)
+    base = _normal_sketch(rng, n=3000)
+    live = _normal_sketch(rng, n=1000, edges=base.edges)
+    stats = drift.compare_sketches(base, live)
+    assert stats["psi"] < 0.1
+    assert stats["js"] < 0.15
+    assert stats["ks"] < 0.1
+
+
+def test_stats_shifted_distribution_fires_all_three():
+    rng = np.random.default_rng(6)
+    base = _normal_sketch(rng, n=3000)
+    live = _normal_sketch(rng, n=1000, loc=3.0, edges=base.edges)
+    stats = drift.compare_sketches(base, live)
+    thr = drift.thresholds()
+    assert stats["psi"] > thr["psi"]
+    assert stats["js"] > thr["js"]
+    assert stats["ks"] > thr["ks"]
+    assert stats["mean_delta"] == pytest.approx(3.0, abs=0.3)
+
+
+def test_stats_empty_sides_are_nan_not_crash():
+    base = drift.StreamingSketch(edges=(0.0, 1.0))
+    live = drift.StreamingSketch(edges=(0.0, 1.0))
+    stats = drift.compare_sketches(base, live)
+    assert math.isnan(stats["psi"])
+    # an unranged (never-observed) baseline cannot anchor a comparison
+    assert drift.compare_sketches(drift.StreamingSketch(),
+                                  live) is None
+
+
+def test_stats_align_rebin_when_live_edges_differ():
+    rng = np.random.default_rng(7)
+    base = _normal_sketch(rng, n=3000)
+    live = drift.StreamingSketch(edges=(-10.0, 0.0, 10.0))
+    live.observe_many(rng.normal(3.0, 1.0, size=1000))
+    stats = drift.compare_sketches(base, live)
+    assert stats is not None and stats["psi"] > drift.thresholds()["psi"]
+
+
+# -- the fork boundary --------------------------------------------------------
+
+def test_hostpool_child_sketches_fold_bit_exactly():
+    """Each child observes ITS shard under its own key: the sketch the
+    driver holds after the fold must be byte-identical (to_json) to the
+    same shard's sketch built in-process — nothing is lost or distorted
+    crossing the fork."""
+    drift.clear()
+    rng = np.random.default_rng(8)
+    values = rng.normal(size=4096)
+
+    def shard(lo, hi):
+        drift.observe_transform(f"m@v1/rows{lo}",
+                                predictions=values[lo:hi])
+        return (lo, hi)
+
+    out = map_row_shards(shard, len(values), workers=2, min_rows=1,
+                         shard_cap=1024)
+    assert len(out) == 4  # really sharded (4096 / 1024)
+    driver_state = drift.state_snapshot()["servables"]
+    for lo, hi in out:
+        expected = drift.SketchGroup()
+        expected.sketch("prediction").observe_many(values[lo:hi])
+        assert (driver_state[f"m@v1/rows{lo}"]["live"]
+                == expected.to_json())
+
+
+def test_hostpool_same_key_fold_is_exact_with_seeded_edges():
+    """All children feed ONE servable whose live sketches are seeded
+    with the baseline's bin edges: bin counts, totals and min/max add
+    commutatively, so the fold is exact regardless of which child
+    finished first (moments use Chan's update — order-dependent only in
+    the last float bits, asserted to 1e-9)."""
+    drift.clear()
+    rng = np.random.default_rng(8)
+    values = rng.normal(size=4096)
+    base = drift.DriftBaseline("m", version=1)
+    base.group.sketches["prediction"] = drift.StreamingSketch(
+        edges=tuple(np.linspace(-4.0, 4.0, 33)))
+    base.group.sketch("prediction").observe_many(values)
+    drift.install_baseline("m@v1", base)
+
+    def shard(lo, hi):
+        drift.observe_transform("m@v1", predictions=values[lo:hi])
+        return hi - lo
+
+    out = map_row_shards(shard, len(values), workers=2, min_rows=1,
+                         shard_cap=1024)
+    assert sum(out) == len(values)
+    merged = drift.state_snapshot()["servables"]["m@v1"]["live"]
+    expected = drift.StreamingSketch(
+        edges=tuple(np.linspace(-4.0, 4.0, 33)))
+    expected.observe_many(values)
+    got = merged["prediction"]
+    want = expected.to_json()
+    for key in ("edges", "counts", "underflow", "overflow", "count",
+                "min", "max", "nonfinite"):
+        assert got[key] == want[key], key
+    assert got["mean"] == pytest.approx(want["mean"], abs=1e-9)
+
+
+def test_hostpool_fork_without_drift_state_ships_nothing():
+    drift.clear()
+    out = map_row_shards(lambda lo, hi: hi - lo, 256, workers=2,
+                         min_rows=1, shard_cap=64)
+    assert sum(out) == 256
+    assert drift.state_snapshot() == {"servables": {}}
+
+
+# -- fit-time capture ---------------------------------------------------------
+
+def test_linear_fit_captures_baseline_when_traced(tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv(TRACE_DIR_ENV, str(tmp_path / "trace"))
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.regression import LinearRegression
+
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(400, 3)).astype(np.float32)
+    y = (x @ np.asarray([1.0, 2.0, 3.0])).astype(np.float32)
+    model = LinearRegression(max_iter=5, global_batch_size=100).fit(
+        Table.from_columns(features=x, label=y))
+    baseline = getattr(model, "drift_baseline", None)
+    assert baseline is not None
+    assert {"f0", "f1", "f2", "prediction"} <= set(
+        baseline.group.sketches)
+    assert baseline.group.sketch("f0").count == 400
+    # the trace-dir artifact landed too
+    files = os.listdir(tmp_path / "trace")
+    assert any(f.startswith("drift-baseline-LinearRegression")
+               for f in files)
+
+
+def test_fit_without_arming_captures_nothing():
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.regression import LinearRegression
+
+    rng = np.random.default_rng(10)
+    x = rng.normal(size=(200, 2)).astype(np.float32)
+    y = (x @ np.asarray([1.0, -1.0])).astype(np.float32)
+    model = LinearRegression(max_iter=3, global_batch_size=64).fit(
+        Table.from_columns(features=x, label=y))
+    assert getattr(model, "drift_baseline", None) is None
+
+
+def test_ftrl_fit_captures_baseline(monkeypatch):
+    monkeypatch.setenv(drift.DRIFT_ENV, "1")
+    from flink_ml_tpu.common.table import (
+        Table,
+        as_dense_vector_column,
+    )
+    from flink_ml_tpu.models.online import OnlineLogisticRegression
+
+    rng = np.random.default_rng(11)
+    dim = 4
+    x = rng.normal(size=(1200, dim))
+    y = (x @ rng.normal(size=dim) > 0).astype(np.float64)
+    init = Table.from_columns(
+        coefficient=as_dense_vector_column(np.zeros((1, dim))),
+        modelVersion=np.asarray([0], np.int64))
+    model = (OnlineLogisticRegression(global_batch_size=300,
+                                      alpha=0.5, beta=0.5)
+             .set_initial_model_data(init)
+             .fit(Table.from_columns(features=x, label=y)))
+    baseline = model.drift_baseline
+    assert set(baseline.group.sketches) == {"f0", "f1", "f2", "f3",
+                                            "prediction"}
+    assert baseline.version == model.model_version
+
+
+def test_sample_rows_caps_and_max_features(monkeypatch):
+    monkeypatch.setenv(drift.SAMPLE_ROWS_ENV, "100")
+    monkeypatch.setenv(drift.MAX_FEATURES_ENV, "2")
+    x = np.zeros((500, 5))
+    assert drift.sample_rows(x).shape == (100, 5)
+    cols = drift.feature_columns(
+        [DenseVector(np.arange(5.0)) for _ in range(3)])
+    assert set(cols) == {"f0", "f1"}
+
+
+# -- publish / hot-swap baseline shipping -------------------------------------
+
+class _LrServable(TransformerServable):
+    features_col = "features"
+    prediction_col = "pred"
+
+    def __init__(self, coef):
+        self.coef = np.asarray(coef, np.float64)
+
+    def transform(self, df):
+        x = np.stack([v.to_array() for v in
+                      df.get(self.features_col).values])
+        df.add_column("pred", DataTypes.DOUBLE,
+                      (x @ self.coef >= 0).astype(float).tolist())
+        return df
+
+
+def _vec_frame(rng, rows, dim, shift=0.0):
+    return DataFrame(
+        ["features"], [DataTypes.vector()],
+        [Row([DenseVector(rng.normal(size=dim) + shift)])
+         for _ in range(rows)])
+
+
+def _baseline_from(rng, dim, n=2000):
+    base = drift.DriftBaseline("lr")
+    mat = rng.normal(size=(n, dim))
+    for i in range(dim):
+        base.group.sketch(f"f{i}").observe_many(mat[:, i])
+    base.group.sketch("prediction").observe_many(
+        (mat.sum(axis=1) >= 0).astype(float))
+    base.group.finalize()
+    return base
+
+
+def test_publish_ships_baseline_and_adopt_installs_per_version(
+        tmp_path):
+    from flink_ml_tpu.serving import ModelRegistry, publish_model
+
+    rng = np.random.default_rng(12)
+    dim = 3
+    watch = str(tmp_path / "models")
+    publish_model(watch, [np.ones(dim)], 1,
+                  baseline=_baseline_from(rng, dim))
+    ckpt = os.path.join(watch, "ckpt-00000001")
+    assert drift.BASELINE_FILENAME in os.listdir(ckpt)
+
+    reg = ModelRegistry(watch, lambda leaves, v: _LrServable(leaves[0]),
+                        model="lr",
+                        probe=lambda: _vec_frame(rng, 4, dim))
+    assert reg.poll() and reg.version == 1
+    b1 = drift.baseline_for("lr@v1")
+    assert b1 is not None and b1.version == 1
+
+    # v2 published with its OWN baseline: the swap installs v2's while
+    # v1's stays for requests still in flight on the old version
+    publish_model(watch, [np.ones(dim) * 2], 2,
+                  baseline=_baseline_from(rng, dim))
+    assert reg.poll() and reg.version == 2
+    assert drift.baseline_for("lr@v2").version == 2
+    assert drift.baseline_for("lr@v1") is not None  # still installed
+
+
+def test_publish_without_baseline_reports_missing_never_blocks(
+        tmp_path):
+    from flink_ml_tpu.serving import ModelRegistry, publish_model
+
+    rng = np.random.default_rng(13)
+    watch = str(tmp_path / "models")
+    publish_model(watch, [np.ones(3)], 1)  # no baseline
+    reg = ModelRegistry(watch, lambda leaves, v: _LrServable(leaves[0]),
+                        model="lr")
+    assert reg.poll() and reg.version == 1  # swap not blocked
+    assert drift.baseline_for("lr@v1") is None
+    result = drift.evaluate("lr@v1")
+    assert result["source"] == "missing" and not result["drifted"]
+    counters = metrics.group("ml", "serving").snapshot()["counters"]
+    assert any(k.startswith("baselineMissing") for k in counters)
+
+
+def test_probe_window_seeds_from_baseline_edges(tmp_path):
+    """The baseline installs BEFORE the candidate probe: the probe's
+    transform creates the live window, which must be seeded with the
+    baseline's bin edges (not auto-range its own)."""
+    from flink_ml_tpu.serving import ModelRegistry, publish_model
+
+    rng = np.random.default_rng(25)
+    dim = 2
+    base = _baseline_from(rng, dim)
+    watch = str(tmp_path / "models")
+    publish_model(watch, [np.ones(dim)], 1, baseline=base)
+    reg = ModelRegistry(watch, lambda leaves, v: _LrServable(leaves[0]),
+                        model="lr",
+                        probe=lambda: _vec_frame(rng, 4, dim))
+    assert reg.poll()
+    with drift._lock:
+        win = drift._windows.get("lr@v1")
+    assert win is not None  # the probe created it...
+    assert win._template  # ...with the baseline's edge template
+    assert win._template["f0"] == base.group.sketch("f0").edges
+
+
+def test_rejected_candidate_leaves_no_drift_state(tmp_path):
+    """A probe-rejected candidate's versioned name never serves — its
+    pre-installed baseline must not linger."""
+    from flink_ml_tpu.serving import ModelRegistry, publish_model
+
+    rng = np.random.default_rng(26)
+    watch = str(tmp_path / "models")
+    publish_model(watch, [np.ones(2)], 1,
+                  baseline=_baseline_from(rng, 2))
+
+    def bad_probe():
+        raise RuntimeError("probe frame factory exploded")
+
+    reg = ModelRegistry(watch, lambda leaves, v: _LrServable(leaves[0]),
+                        model="lr", probe=bad_probe)
+    assert not reg.poll()  # rejected, never raises
+    assert drift.baseline_for("lr@v1") is None
+    assert "lr@v1" not in drift.state_snapshot()["servables"]
+
+
+def test_corrupt_baseline_file_never_blocks_swap(tmp_path):
+    from flink_ml_tpu.serving import ModelRegistry, publish_model
+
+    watch = str(tmp_path / "models")
+    publish_model(watch, [np.ones(3)], 1)
+    ckpt = os.path.join(watch, "ckpt-00000001")
+    with open(os.path.join(ckpt, drift.BASELINE_FILENAME), "w") as f:
+        f.write("{ not json")
+    reg = ModelRegistry(watch, lambda leaves, v: _LrServable(leaves[0]),
+                        model="lr")
+    assert reg.poll() and reg.version == 1
+    assert drift.baseline_for("lr@v1") is None
+
+
+# -- live comparison ----------------------------------------------------------
+
+def test_served_seam_feeds_sketches_and_detects_shift():
+    rng = np.random.default_rng(14)
+    dim = 3
+    base = _baseline_from(rng, dim)
+    drift.install_baseline("_LrServable", base)
+    servable = _LrServable(np.ones(dim))
+    for _ in range(10):
+        servable.transform(_vec_frame(rng, 16, dim, shift=3.0))
+    result = drift.evaluate("_LrServable")
+    assert result["source"] == "baseline"
+    assert "f0" in result["drifted"]
+    # the gauges landed with the full label set
+    gauges = metrics.group("ml", "drift").snapshot()["gauges"]
+    key = ('drift{feature="f0",servable="_LrServable",stat="psi"}')
+    assert key in gauges and gauges[key] > drift.thresholds()["psi"]
+    counters = metrics.group("ml", "drift").snapshot()["counters"]
+    assert counters.get('violations{servable="_LrServable"}', 0) > 0
+
+
+def test_clean_traffic_does_not_drift():
+    rng = np.random.default_rng(15)
+    dim = 3
+    drift.install_baseline("_LrServable", _baseline_from(rng, dim))
+    servable = _LrServable(np.ones(dim))
+    for _ in range(20):
+        servable.transform(_vec_frame(rng, 16, dim))
+    result = drift.evaluate("_LrServable")
+    assert result["drifted"] == []
+
+
+def test_drift_event_rides_the_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv(TRACE_DIR_ENV, str(tmp_path / "trace"))
+    rng = np.random.default_rng(16)
+    drift.install_baseline("m@v1", _baseline_from(rng, 1))
+    for _ in range(5):
+        drift.observe_transform("m@v1",
+                                predictions=rng.normal(5, 1, 64))
+    drift.evaluate("m@v1")
+    tracer.shutdown()
+    from flink_ml_tpu.observability.exporters import read_spans
+
+    events = [ev for sp in read_spans(str(tmp_path / "trace"))
+              for ev in sp.get("events", ())
+              if ev.get("name") == drift.DRIFT_EVENT]
+    assert events and events[0]["attrs"]["servable"] == "m@v1"
+
+
+def test_min_count_gate_withholds_verdict_and_gauges(monkeypatch):
+    """Below the sample floor: no verdict, no gauges (a thin window's
+    psi is noise, and the drift SLO consumes the gauges raw — a
+    just-started service must not flip /slo to VIOLATED), and the
+    series is marked thin."""
+    monkeypatch.setenv(drift.MIN_COUNT_ENV, "1000")
+    rng = np.random.default_rng(17)
+    drift.install_baseline("m@v1", _baseline_from(rng, 1))
+    drift.observe_transform("m@v1", predictions=rng.normal(9, 1, 50))
+    result = drift.evaluate("m@v1")
+    assert result["drifted"] == []  # stats present, verdict withheld
+    assert result["series"]["prediction"]["live_n"] == 50
+    assert result["series"]["prediction"]["thin"] is True
+    gauges = metrics.group("ml", "drift").snapshot()["gauges"]
+    assert not any(k.startswith("drift{") for k in gauges)
+    spec = slo.SLO.from_dict({"name": "no-drift", "kind": "drift"})
+    (obj,) = slo.evaluate_slos([spec])[0]["objectives"]
+    assert obj["source"] == "missing" and obj["ok"]
+
+
+def test_batcher_pad_rows_excluded_from_sketches():
+    """A 1-row request padded to bucket 8 must sketch ONE sample, not
+    eight dependent copies of it."""
+    from flink_ml_tpu.serving import BatcherConfig, MicroBatcher
+
+    rng = np.random.default_rng(30)
+    servable = _LrServable(np.ones(2))
+    with MicroBatcher(servable,
+                      BatcherConfig(buckets=(8,), window_ms=0.0)) as b:
+        b.submit(_vec_frame(rng, 1, 2)).result(timeout=10)
+    live = drift.state_snapshot()["servables"]["_LrServable"]["live"]
+    assert live["prediction"]["count"] == 1
+    assert live["f0"]["count"] == 1
+
+
+def test_tracked_servables_capped():
+    """A continuously-republishing deployment mints a versioned name
+    per hot-swap; state for dead versions is evicted past the cap."""
+    rng = np.random.default_rng(31)
+    base = _baseline_from(rng, 1)
+    n = drift.MAX_TRACKED_SERVABLES + 10
+    for i in range(n):
+        drift.install_baseline(f"lr@v{i}", base)
+    assert drift.baseline_for("lr@v0") is None  # evicted
+    assert drift.baseline_for(f"lr@v{n - 1}") is not None
+    with drift._lock:
+        assert len(drift._tracked) == drift.MAX_TRACKED_SERVABLES
+
+
+def test_forget_servable_drops_all_state():
+    rng = np.random.default_rng(32)
+    drift.install_baseline("m@v1", _baseline_from(rng, 1))
+    drift.observe_transform("m@v1", predictions=[0.5] * 8)
+    drift.forget_servable("m@v1")
+    assert drift.baseline_for("m@v1") is None
+    assert drift.state_snapshot() == {"servables": {}}
+
+
+def test_kill_switch_disables_observation(monkeypatch):
+    monkeypatch.setenv(drift.DRIFT_ENV, "0")
+    drift.observe_transform("m@v1", predictions=[1.0, 2.0])
+    assert drift.state_snapshot() == {"servables": {}}
+    assert not drift.capture_armed()
+
+
+# -- the drift SLO objective --------------------------------------------------
+
+def test_slo_drift_kind_live_and_missing():
+    spec = slo.SLO.from_dict({"name": "no-drift", "kind": "drift",
+                              "max_drift": 0.25})
+    assert spec.group == "ml.drift"  # redirected default
+    verdicts = slo.evaluate_slos([spec])
+    (obj,) = verdicts[0]["objectives"]
+    assert obj["source"] == "missing" and obj["ok"]
+
+    rng = np.random.default_rng(18)
+    drift.install_baseline("m@v1", _baseline_from(rng, 1))
+    for _ in range(5):
+        drift.observe_transform("m@v1",
+                                predictions=rng.normal(6, 1, 64))
+    drift.evaluate("m@v1")
+    verdicts = slo.evaluate_slos([spec])
+    (obj,) = verdicts[0]["objectives"]
+    assert obj["source"] == "gauge" and not obj["ok"]
+    assert "m@v1" in obj["worst"]
+    rendered = slo.render_verdicts(verdicts)
+    assert "drift-stat" in rendered and "VIOLATED" in rendered
+
+
+def test_slo_drift_kind_from_artifact_snapshot(tmp_path):
+    rng = np.random.default_rng(19)
+    drift.install_baseline("m@v1", _baseline_from(rng, 1))
+    for _ in range(5):
+        drift.observe_transform("m@v1",
+                                predictions=rng.normal(6, 1, 64))
+    drift.evaluate("m@v1")
+    snap = metrics.snapshot()
+    spec = slo.SLO.from_dict({"name": "no-drift", "kind": "drift"})
+    verdicts = slo.evaluate_slos([spec], snapshot=snap)
+    (obj,) = verdicts[0]["objectives"]
+    assert obj["source"] == "gauge" and not obj["ok"]
+
+    bad_stat = {"name": "x", "kind": "drift", "stat": "chi2"}
+    with pytest.raises(ValueError, match="psi|js|ks"):
+        slo.SLO.from_dict(bad_stat)
+
+
+# -- windowed summarize_values (health satellite) -----------------------------
+
+def test_summarize_values_records_windowed_distribution():
+    health.summarize_values("svc", "prediction", [0.5] * 30)
+    health.summarize_values("svc", "prediction", [100.0])
+    group = metrics.group("ml", "serving")
+    hist = group.windowed_histogram(
+        "predictionValues", buckets=health.SUMMARY_BUCKETS,
+        labels={"servable": "svc"})
+    snap = hist.window_snapshot()
+    assert snap["count"] == 31
+    # the cumulative gauges keep their last-batch semantics
+    assert group.get_gauge("predictionMean",
+                           labels={"servable": "svc"}) == 100.0
+    # the windowed view still knows the recent distribution's bulk
+    assert hist.window_quantile(0.5) <= 1.0
+
+
+# -- /drift route -------------------------------------------------------------
+
+def test_drift_route_serves_live_report(monkeypatch):
+    monkeypatch.setenv(server.METRICS_PORT_ENV, "0")
+    srv = server.maybe_start()
+    assert srv is not None
+    rng = np.random.default_rng(20)
+    drift.install_baseline("m@v1", _baseline_from(rng, 1))
+    for _ in range(5):
+        drift.observe_transform("m@v1",
+                                predictions=rng.normal(6, 1, 64))
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/drift", timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["servables"]["m@v1"]["source"] == "baseline"
+    assert "m@v1" in doc["drifted"]
+    # the 404 body names the new route
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/nope", timeout=10)
+    except urllib.error.HTTPError as e:
+        assert "/drift" in e.read().decode()
+    else:  # pragma: no cover
+        pytest.fail("expected 404")
+
+
+def test_drift_route_empty_when_nothing_sketched(monkeypatch):
+    monkeypatch.setenv(server.METRICS_PORT_ENV, "0")
+    srv = server.maybe_start()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/drift", timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["servables"] == {} and doc["drifted"] == []
+
+
+# -- artifacts + CLI ----------------------------------------------------------
+
+def _drive_and_dump(tmp_path, shift):
+    rng = np.random.default_rng(21)
+    dim = 2
+    drift.install_baseline("lr@v1", _baseline_from(rng, dim))
+    servable = _LrServable(np.ones(dim))
+    servable.serving_name = "lr@v1"
+    for _ in range(15):
+        servable.transform(_vec_frame(rng, 16, dim, shift=shift))
+    drift.evaluate("lr@v1")
+    trace_dir = str(tmp_path / "trace")
+    dump_metrics(trace_dir)
+    return trace_dir
+
+
+def test_cli_drift_check_exit4_on_shift_exit0_clean(tmp_path,
+                                                    capsys):
+    trace_dir = _drive_and_dump(tmp_path / "shifted", shift=3.0)
+    assert trace_cli(["drift", trace_dir, "--check"]) == 4
+    out = capsys.readouterr().out
+    assert "DRIFTED" in out
+
+    drift.clear()
+    trace_dir = _drive_and_dump(tmp_path / "clean", shift=0.0)
+    assert trace_cli(["drift", trace_dir, "--check"]) == 0
+
+
+def test_cli_drift_json_and_thresholds(tmp_path, capsys):
+    trace_dir = _drive_and_dump(tmp_path, shift=0.4)
+    # absurdly loose thresholds: nothing drifts
+    rc = trace_cli(["drift", trace_dir, "--check", "--psi", "1e9",
+                    "--js", "1e9", "--ks", "1e9", "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["verdicts"][0]["servable"] == "lr@v1"
+    assert doc["thresholds"]["psi"] == 1e9
+
+
+def test_cli_drift_exit2_without_artifacts(tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert trace_cli(["drift", str(empty), "--check"]) == 2
+    assert trace_cli(["drift", str(tmp_path / "nope")]) == 2
+
+
+def test_cli_drift_baseline_override(tmp_path, capsys):
+    rng = np.random.default_rng(22)
+    # live sketches dumped WITHOUT any installed baseline
+    servable = _LrServable(np.ones(2))
+    servable.serving_name = "lr@v1"
+    for _ in range(15):
+        servable.transform(_vec_frame(rng, 16, 2, shift=3.0))
+    trace_dir = str(tmp_path / "trace")
+    dump_metrics(trace_dir)
+    assert trace_cli(["drift", trace_dir, "--check"]) == 0  # missing
+
+    path = tmp_path / "baseline.json"
+    with open(path, "w") as f:
+        json.dump(_baseline_from(rng, 2).to_json(), f)
+    rc = trace_cli(["drift", trace_dir, "--baseline", str(path),
+                    "--check"])
+    assert rc == 4
+    assert trace_cli(["drift", trace_dir, "--baseline",
+                      str(tmp_path / "missing.json")]) == 2
+
+
+def test_cli_json_is_strict_with_nan_stats(tmp_path, capsys):
+    """A baseline series never observed live has NaN stats; the --json
+    rendering must stay strict JSON (no bare NaN tokens)."""
+    rng = np.random.default_rng(24)
+    drift.install_baseline("m@v1", _baseline_from(rng, 2))
+    drift.observe_transform("m@v1", predictions=[0.5] * 8)  # f0/f1
+    # never observed → their stats are NaN
+    drift.evaluate("m@v1")
+    trace_dir = str(tmp_path / "trace")
+    dump_metrics(trace_dir)
+    assert trace_cli(["drift", trace_dir, "--json"]) == 0
+
+    def no_constants(name):  # strict parser: bare NaN/Infinity raises
+        raise ValueError(name)
+
+    doc = json.loads(capsys.readouterr().out,
+                     parse_constant=no_constants)
+    series = doc["verdicts"][0]["series"]
+    assert series["f0"]["psi"] == "NaN"  # rendered as a string
+
+
+def test_artifact_round_trip_merges_multiple_pids(tmp_path):
+    """Two processes' drift dumps (simulated via distinct filenames)
+    merge in read_state — the artifact twin of the fork fold."""
+    rng = np.random.default_rng(23)
+    edges = tuple(np.linspace(-4, 4, 33))
+    doc = {"version": 1, "servables": {"m@v1": {
+        "live": {"value": _normal_sketch(rng, 400,
+                                         edges=edges).to_json()},
+        "baseline": None, "results": None}}}
+    trace_dir = tmp_path / "trace"
+    trace_dir.mkdir()
+    for pid in (111, 222):
+        with open(trace_dir / f"drift-{pid}.json", "w") as f:
+            json.dump(doc, f)
+    state = drift.read_state(str(trace_dir))
+    assert state["m@v1"]["live"].sketch("value").count == 800
