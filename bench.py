@@ -212,6 +212,8 @@ def _worker(role: str) -> int:
                         "updateSharding": best.get("updateSharding"),
                         "optStateBytesPerReplica": best.get(
                             "optStateBytesPerReplica"),
+                        # native-kernel thread count the row ran with
+                        "nativeThreads": best.get("nativeThreads"),
                     }
                     if "executionPath" in best:
                         out[name]["executionPath"] = best["executionPath"]
@@ -247,6 +249,9 @@ def _worker(role: str) -> int:
         # than a replicated one (parallel/update_sharding.py)
         "update_sharding": best.get("updateSharding"),
         "opt_state_bytes_per_replica": best.get("optStateBytesPerReplica"),
+        # native-kernel thread provenance (FLINK_ML_TPU_NATIVE_THREADS,
+        # validated by native.native_threads — 1 = single-threaded)
+        "native_threads": best.get("nativeThreads"),
         # compile/steady split: the warmup's compile bill (excluded from
         # the measured number, as the JVM baseline excludes JIT warmup)
         # and the measured run's own compile count, which should be 0 —

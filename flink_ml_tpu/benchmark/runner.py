@@ -158,6 +158,10 @@ def _run_benchmark(name: str, spec: dict) -> dict:
         # a BENCH artifact (docs/observability.md "Distributed
         # telemetry")
         **_mesh_provenance(),
+        # native-kernel thread provenance (native.native_threads): a
+        # string-tier number measured with 4-way threaded kernels is a
+        # different machine state than a single-threaded one
+        **_native_provenance(),
         "totalTimeMs": total_ms,
         "inputRecordNum": input_num,
         "inputThroughput": input_num * 1000.0 / total_ms,
@@ -179,6 +183,18 @@ def _run_benchmark(name: str, spec: dict) -> dict:
         **({"executionPath": stage.last_execution_path}
            if getattr(stage, "last_execution_path", None) else {}),
     }
+
+
+def _native_provenance() -> dict:
+    """``nativeThreads``: the validated FLINK_ML_TPU_NATIVE_THREADS
+    value the row's native factorize/doc-freq kernels ran with (1 =
+    single-threaded, the default). Never fails a finished measurement."""
+    try:
+        from flink_ml_tpu import native
+
+        return {"nativeThreads": native.native_threads()}
+    except Exception:  # noqa: BLE001 — provenance only
+        return {}
 
 
 def _mesh_provenance() -> dict:

@@ -18,7 +18,11 @@ Ref parity map:
 from __future__ import annotations
 
 import dataclasses
+import functools
+import os
 from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +32,38 @@ from flink_ml_tpu.resilience import faults
 Carry = Any
 Body = Callable[[Carry, jnp.ndarray], Carry]
 Terminate = Callable[[Carry, jnp.ndarray], jnp.ndarray]  # -> bool scalar
+
+
+def segment_fusion_enabled() -> bool:
+    """Segment-boundary fusion (default ON): the compiled segment
+    programs stack their per-boundary scalars — epoch, stop flag, and
+    (with health telemetry) the non-finite sentinel — into ONE int32
+    vector, so each boundary costs one device→host transfer instead of
+    one per scalar. ``FLINK_ML_TPU_SEGMENT_FUSION=0`` restores the
+    scalar-by-scalar pre-fusion path (results are bit-identical either
+    way — the fusion only changes how the already-computed scalars reach
+    the host, never what the program computes)."""
+    return os.environ.get("FLINK_ML_TPU_SEGMENT_FUSION", "1") != "0"
+
+
+def read_boundary(boundary) -> list:
+    """Fetch a segment boundary's host-visible scalars, counting the
+    device→host transfers it costs into ``ml.iteration
+    boundaryFetches`` (the quantity the perf ratchet gates on: 1 per
+    boundary when fused). ``boundary`` is either one stacked device
+    vector (the fused form — ONE transfer) or a tuple/list of separate
+    scalars (the pre-fusion form — one transfer each). Returns the
+    values as numpy scalars in order."""
+    from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+
+    grp = metrics.group(ML_GROUP, "iteration")
+    if isinstance(boundary, (tuple, list)):
+        vals = [np.asarray(v) for v in boundary]
+        grp.counter("boundaryFetches", len(vals))
+        return vals
+    vals = list(np.asarray(boundary))
+    grp.counter("boundaryFetches")
+    return vals
 
 
 @dataclasses.dataclass
@@ -81,7 +117,8 @@ def iterate_bounded(initial_carry: Carry,
                     terminate: Optional[Terminate] = None,
                     config: IterationConfig = None,
                     listeners: Sequence[IterationListener] = (),
-                    jit_round: bool = True) -> Carry:
+                    jit_round: bool = True,
+                    donate_carry: bool = False) -> Carry:
     """Run ``body`` for up to ``max_iter`` epochs; stop early when
     ``terminate(carry, epoch)`` is True. Returns the final carry.
 
@@ -92,14 +129,24 @@ def iterate_bounded(initial_carry: Carry,
     ``jit_round=False`` runs the body as plain host code per round (no
     tracing) — for bodies whose math lives on host (the CSR sparse trainer:
     scipy matvecs have no XLA form). Such bodies always use the host loop.
-    """
+
+    ``donate_carry=True`` donates the carry buffers through the compiled
+    device/segment loops (the update happens in place — no fresh
+    allocation per call). Opt-in because donation CONSUMES
+    ``initial_carry``: only callers that build fresh carry buffers and
+    never reuse them afterwards (the algorithm fast paths) may set it.
+    The host loop never donates — listeners legitimately hold references
+    to lagged carries (health.ConvergenceListener), which donation would
+    delete out from under them."""
     config = config or IterationConfig()
     seg = device_checkpoint_segment(config, listeners)
     if jit_round and seg:
         return _segmented_device_loop(initial_carry, body, max_iter,
-                                      terminate, config, seg)
+                                      terminate, config, seg,
+                                      donate_carry=donate_carry)
     if jit_round and not needs_host_loop(config, listeners):
-        return _device_loop(initial_carry, body, max_iter, terminate)
+        return _device_loop(initial_carry, body, max_iter, terminate,
+                            donate_carry=donate_carry)
     return _host_loop(initial_carry, body, max_iter, terminate, config,
                       listeners, jit_round)
 
@@ -151,12 +198,21 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
     ``iterate_bounded``, which wraps its shard_mapped round body in the
     segmented while_loop).
 
+    ``run_segment`` implementations fetch their own boundary scalars
+    (through :func:`read_boundary`, so the transfers are counted and —
+    fused — cost ONE device→host round-trip per boundary) and return
+    host values; legacy device scalars still work (``int``/``bool``
+    coerce them, at one transfer each).
+
     Checkpoint cadence matches the host loop exactly: a snapshot lands
-    after every K completed rounds (including a termination that coincides
-    with a boundary); an early stop mid-segment saves nothing, and a
-    completed run clears its checkpoints.  A restore landing off the
-    K-grid (a snapshot from a different interval or mode) realigns at the
-    first segment so later boundaries checkpoint on-grid again."""
+    after every K completed rounds — EXCEPT the final boundary of a
+    completing run, whose snapshot ``mgr.clear()`` below would delete
+    before anything could restore it: that save (a full carry
+    device→host transfer) is skipped. An early stop mid-segment saves
+    nothing, and a completed run clears its checkpoints. A restore
+    landing off the K-grid (a snapshot from a different interval or
+    mode) realigns at the first segment so later boundaries checkpoint
+    on-grid again."""
     from flink_ml_tpu.common.metrics import ML_GROUP, metrics
     from flink_ml_tpu.observability import compilestats, tracing
     iter_group = metrics.group(ML_GROUP, "iteration")
@@ -177,19 +233,25 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
                                  epoch_to=limit) as sp:
             carry, e, s = run_segment(carry, epoch, limit)
             if tracing.tracer.enabled:
-                # per-shard time-to-ready before the int(e) host sync:
-                # the straggler surface of the segment (ml.shard readyMs
-                # with shard=/device= labels, ml.skew on spread)
+                # per-shard time-to-ready at the boundary: the straggler
+                # surface of the segment (ml.shard readyMs with
+                # shard=/device= labels, ml.skew on spread). With fusion
+                # the boundary scalars synced inside run_segment, so on
+                # a real TPU this measures the residual drain of the
+                # carry outputs (on CPU the program was always complete
+                # by now either way).
                 from flink_ml_tpu.observability import meshstats
                 meshstats.observe_shard_ready(carry, span=sp,
                                               phase="segment")
             rounds = int(e) - epoch
             epoch, stop = int(e), bool(s)
             sp.set_attribute("rounds", rounds)
+            iter_group.counter("boundaries")
             # chaos site: the segment boundary is this mode's epoch
             # boundary
             faults.inject("epoch-boundary", epoch=epoch)
-            if epoch % K == 0:
+            done = epoch >= max_iter or stop
+            if epoch % K == 0 and not done:
                 mgr.save(carry, epoch)
             if tracing.tracer.enabled:
                 # HBM watermark at the segment boundary (the host-sync
@@ -214,22 +276,37 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
 
 
 def _segmented_device_loop(initial_carry, body, max_iter, terminate, config,
-                           K: int):
+                           K: int, donate_carry: bool = False):
     """Device-mode iteration with interval checkpointing: one jitted
     ``while_loop`` per K-round segment (epoch bounds are device scalars, so
     every segment reuses one compilation), carry snapshotted between
     segments.  Numerically identical to :func:`_device_loop` by
-    construction — both build on :func:`_loop_pieces`."""
-    cond, step = _loop_pieces(body, terminate)
+    construction — both build on :func:`_loop_pieces`.
 
-    @jax.jit
+    The boundary scalars (epoch, stop) come back stacked as one int32
+    vector when :func:`segment_fusion_enabled` — one transfer per
+    boundary; ``FLINK_ML_TPU_SEGMENT_FUSION=0`` keeps them separate.
+    With ``donate_carry`` the carry buffers are donated into each
+    segment (in-place update; the previous segment's output is consumed
+    only after its checkpoint snapshot, so restore still sees every
+    saved state)."""
+    cond, step = _loop_pieces(body, terminate)
+    fused = segment_fusion_enabled()
+
+    @functools.partial(jax.jit,
+                       donate_argnums=(0,) if donate_carry else ())
     def seg(carry, epoch0, limit):
         carry, epoch, stop, _ = jax.lax.while_loop(
             cond, step, (carry, epoch0, jnp.asarray(False), limit))
+        if fused:
+            return carry, jnp.stack([epoch, stop.astype(jnp.int32)])
         return carry, epoch, stop
 
     def run_segment(carry, epoch0, limit):
-        return seg(carry, jnp.int32(epoch0), jnp.int32(limit))
+        out = seg(carry, jnp.int32(epoch0), jnp.int32(limit))
+        boundary = out[1] if fused else out[1:]
+        vals = read_boundary(boundary)
+        return out[0], int(vals[0]), bool(vals[1])
 
     return run_segmented(run_segment, initial_carry, max_iter, K,
                          config.checkpoint_manager)
@@ -258,12 +335,16 @@ def _loop_pieces(body, terminate):
     return cond, step
 
 
-def _device_loop(initial_carry, body, max_iter, terminate):
+def _device_loop(initial_carry, body, max_iter, terminate,
+                 donate_carry: bool = False):
     """Single compiled while_loop: the whole iteration is one XLA program
-    (the K=max_iter degenerate case of the segmented loop)."""
+    (the K=max_iter degenerate case of the segmented loop). With
+    ``donate_carry`` the carry buffers update in place (the caller's
+    ``initial_carry`` is consumed)."""
     cond, step = _loop_pieces(body, terminate)
 
-    @jax.jit
+    @functools.partial(jax.jit,
+                       donate_argnums=(0,) if donate_carry else ())
     def run(carry):
         final_carry, _, _, _ = jax.lax.while_loop(
             cond, step,

@@ -153,12 +153,20 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
     once instead of once per sub-op; the shard is zero-weight-padded to
     the kernel tile ONCE, outside the rounds.
 
-    With ``health`` (observability/health.py) the program returns
-    ``(packed, shifts)`` where ``shifts`` is the per-round Frobenius
-    center-shift series ``(max_iter,)`` — ONE scalar per round folding
-    every centroid element, so a NaN centroid surfaces as a NaN shift
-    with no per-leaf host sync; without it the return is the packed
-    array alone, exactly as before."""
+    Signature: ``fit(xs, n_valid, c0, counts0) -> (centroids, counts)``
+    (``(..., shifts)`` with health). The ``(c0, counts0)`` carry is
+    DONATED: the carry leaves match the outputs shape-for-shape, so the
+    while/unrolled loop updates the centroid state in place — the same
+    in-place contract as the SGD/FTRL carries. (The pre-donation layout
+    packed ``[centroids | counts]`` into one ``(k, d+1)`` output to save
+    a fetch, which matched no input buffer and blocked donation; the
+    split costs one extra ``(k,)`` fetch ONCE per fit and unblocks the
+    per-round in-place update.)
+
+    With ``health`` (observability/health.py) ``shifts`` is the
+    per-round Frobenius center-shift series ``(max_iter,)`` — ONE scalar
+    per round folding every centroid element, so a NaN centroid surfaces
+    as a NaN shift with no per-leaf host sync."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     partials_fn = None
@@ -169,8 +177,7 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
         DistanceMeasure.get_instance(measure_name), axes, partials_fn,
         sharded=sharded)
 
-    def per_shard(xl, n_valid, c0):
-        k = c0.shape[0]
+    def per_shard(xl, n_valid, c0, counts0):
         vl = mr.local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
         if use_kernel:
             from flink_ml_tpu.ops.pallas_kernels import TILE_N
@@ -178,7 +185,7 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
             if pad:  # once per fit, not per round (loop-invariant)
                 xl = jnp.pad(xl, ((0, pad), (0, 0)))
                 vl = jnp.pad(vl, (0, pad))
-        centroids, counts = c0, jnp.zeros((k,), xl.dtype)
+        centroids, counts = c0, counts0
         shifts = jnp.zeros((max_iter if health else 0,), jnp.float32)
         if unroll:
             for epoch in range(max_iter):
@@ -205,18 +212,14 @@ def _build_lloyd_program(mesh, measure_name: str, max_iter: int,
 
             centroids, counts, _, shifts = jax.lax.while_loop(
                 cond, step, (centroids, counts, jnp.int32(0), shifts))
-        # one packed output = one device->host fetch for the whole fit
-        packed = jnp.concatenate([centroids, counts[:, None]], axis=1)
-        return (packed, shifts) if health else packed
+        return ((centroids, counts, shifts) if health
+                else (centroids, counts))
 
-    # no donation here: the program's one packed output is (k, d+1) —
-    # no input buffer matches it, so a donated c0 would just warn.
-    # The donated sharded-update carries live in the SGD/FTRL programs,
-    # whose state flows through with identical shapes.
     return mr.map_shards(
         per_shard, mesh,
-        in_specs=(P(spec0, None), P(), P()),
-        out_specs=((P(), P()) if health else P()),
+        in_specs=(P(spec0, None), P(), P(), P()),
+        out_specs=((P(), P(), P()) if health else (P(), P())),
+        donate_argnums=(2, 3),
         name="kmeans.lloyd" if sharded else None)
 
 
@@ -231,14 +234,25 @@ _UNROLL_MAX_ROUNDS = int(os.environ.get(
 
 @functools.lru_cache(maxsize=32)
 def _build_lloyd_round_program(mesh, measure_name: str,
-                               sharded: bool = False):
+                               sharded: bool = False,
+                               use_kernel: bool = False):
     """ONE Lloyd round — the building block of the checkpointable host
     loop; wraps the same _lloyd_round_math as the all-device program
-    (iterate_bounded jits the round, hence ``jit=False``)."""
+    (iterate_bounded jits the round, hence ``jit=False``). With
+    ``use_kernel`` (TPU + euclidean, segment-mode fits) the per-shard
+    partials come from the fused pallas assign+accumulate kernel —
+    lloyd_partial_sums pads the shard internally, and inside the
+    segmented while_loop the pad of the loop-invariant shard hoists out
+    of the rounds."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
+    partials_fn = None
+    if use_kernel:
+        from flink_ml_tpu.ops.pallas_kernels import lloyd_partial_sums
+        partials_fn = lloyd_partial_sums
     round_step = _lloyd_round_math(
-        DistanceMeasure.get_instance(measure_name), axes, sharded=sharded)
+        DistanceMeasure.get_instance(measure_name), axes, partials_fn,
+        sharded=sharded)
 
     def per_shard(xl, n_valid, centroids):
         vl = mr.local_valid_mask(axes, xl.shape[0], n_valid, xl.dtype)
@@ -341,6 +355,7 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
         return self._supervised_fit(lambda: self._fit_once(table))
 
     def _fit_once(self, table: Table) -> KMeansModel:
+        global _pallas_lloyd_broken
         x = table.vectors(self.features_col)
         n, dim = x.shape
         k = self.k
@@ -381,7 +396,6 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                                self._iteration_listeners):
             from flink_ml_tpu.ops.pallas_kernels import (
                 lloyd_kernel_fits, pallas_supported)
-            global _pallas_lloyd_broken
             unroll = self.max_iter <= _UNROLL_MAX_ROUNDS
             use_kernel = (self.distance_measure == "euclidean"
                           and pallas_supported()
@@ -393,12 +407,24 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                     mesh, self.distance_measure, self.max_iter,
                     unroll=unroll, use_kernel=use_kernel,
                     health=health_on, sharded=sharded)
-                out = fit(xs, n_valid, jnp.asarray(init))
-                packed, shifts = out if health_on else (out, None)
-                return np.asarray(packed), shifts
+                # the (c0, counts0) carry is DONATED — copy=True builds
+                # a fresh buffer per attempt even when `init` is itself
+                # a device array (device-resident features: vectors()
+                # returns the jax array, and asarray would ALIAS it —
+                # the first attempt would consume it and the
+                # pallas-fallback retry would pass a deleted buffer);
+                # the split (centroids, counts) outputs fetch once per
+                # fit
+                out = fit(xs, n_valid, jnp.array(init, copy=True),
+                          jnp.zeros((k,), jnp.float32))
+                if health_on:
+                    centroids, counts, shifts = out
+                else:
+                    (centroids, counts), shifts = out, None
+                return np.asarray(centroids), np.asarray(counts), shifts
 
             try:
-                packed, shifts = run_fit(use_kernel)
+                centroids, counts, shifts = run_fit(use_kernel)
                 # benchmark provenance (runner.py executionPath)
                 self.last_execution_path = (
                     "pallas-lloyd" if use_kernel else "xla-lloyd")
@@ -416,9 +442,8 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                     "pallas Lloyd kernel failed; using the XLA fit path "
                     "for the rest of this process", exc_info=True)
                 _pallas_lloyd_broken = True
-                packed, shifts = run_fit(False)
+                centroids, counts, shifts = run_fit(False)
                 self.last_execution_path = "xla-lloyd"
-            centroids, counts = packed[:, :-1], packed[:, -1]
             if health_on:
                 s = np.asarray(shifts, np.float64)
                 _health.check_fit("KMeans", {"centerShift": s},
@@ -426,15 +451,6 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
             else:
                 _health.guard_final_state("KMeans", centroids)
         else:
-
-            round_fn = _build_lloyd_round_program(mesh,
-                                                  self.distance_measure,
-                                                  sharded=sharded)
-
-            def body(carry, epoch):
-                centroids, _ = carry
-                return round_fn(xs, n_valid, centroids)
-
             from flink_ml_tpu.iteration.iteration import (
                 device_checkpoint_segment)
             listeners = self._iteration_listeners
@@ -450,15 +466,59 @@ class KMeans(Estimator, KMeansParams, IterationRuntimeMixin):
                     _health.ConvergenceListener.for_centroids(
                         "KMeans", init),)
 
-            from jax.sharding import NamedSharding
-            repl = NamedSharding(mesh, P())
-            centroids, counts = iterate_bounded(
-                (jax.device_put(jnp.asarray(init), repl),
-                 jax.device_put(jnp.zeros((k,), jnp.float32), repl)),
-                body, max_iter=self.max_iter,
-                config=self._iteration_config,
-                listeners=listeners)
-            self.last_execution_path = "host-rounds"
+            from flink_ml_tpu.ops.pallas_kernels import (
+                lloyd_kernel_fits, pallas_supported)
+            # segment-mode fits (compiled K-round while_loop slices) use
+            # the fused pallas partials like the all-device path; true
+            # host rounds keep the XLA partials (per-round dispatch is
+            # already host-bound there, and listeners may inspect the
+            # carry between rounds)
+            use_kernel = (seg > 0 and self.distance_measure == "euclidean"
+                          and pallas_supported()
+                          and not _pallas_lloyd_broken
+                          and lloyd_kernel_fits(k, dim))
+
+            def run_host_fit(use_k):
+                round_fn = _build_lloyd_round_program(
+                    mesh, self.distance_measure, sharded=sharded,
+                    use_kernel=use_k)
+
+                def body(carry, epoch):
+                    centroids, _ = carry
+                    return round_fn(xs, n_valid, centroids)
+
+                from jax.sharding import NamedSharding
+                repl = NamedSharding(mesh, P())
+                # fresh carry per attempt: the segmented loop DONATES
+                # the carry into each compiled segment (in-place
+                # update). copy=True — device_put on an already-device
+                # `init` (device-resident features) would SHARE its
+                # buffer, and the kernel-fallback retry would re-pass
+                # the consumed array.
+                return iterate_bounded(
+                    (jax.device_put(jnp.array(init, copy=True), repl),
+                     jax.device_put(jnp.zeros((k,), jnp.float32), repl)),
+                    body, max_iter=self.max_iter,
+                    config=self._iteration_config,
+                    listeners=listeners, donate_carry=True)
+
+            try:
+                centroids, counts = run_host_fit(use_kernel)
+                self.last_execution_path = (
+                    "pallas-lloyd-segments" if use_kernel
+                    else "xla-lloyd-segments" if seg else "host-rounds")
+            except Exception as e:
+                if not use_kernel or not _is_pallas_failure(e):
+                    raise
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "pallas Lloyd kernel failed in the segmented fit; "
+                    "using the XLA round for the rest of this process",
+                    exc_info=True)
+                _pallas_lloyd_broken = True
+                centroids, counts = run_host_fit(False)
+                self.last_execution_path = "xla-lloyd-segments"
             if not health_on or seg:
                 _health.guard_final_state(
                     "KMeans", np.asarray(centroids, np.float64))

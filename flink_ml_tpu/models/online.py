@@ -167,18 +167,24 @@ def _ftrl_program(mesh, alpha: float, beta: float, l1: float, l2: float,
     reduce = {"grad": mr.reduce_scatter if sharded else mr.reduce_sum}
     if health:
         reduce["loss"] = mr.reduce_sum
+    # the (z, n) accumulator carries donate in EVERY build (in-place
+    # update; each batch's inputs are the previous batch's outputs, and
+    # to_host()/history read only the CURRENT state, never a consumed
+    # input). The coefficient carry does NOT donate — every version's w
+    # buffer lives on in the model history. Unsharded builds keep plain
+    # jit's C++ dispatch cache (map_shards: donation without a name).
     return prog.build(
         map_fn, update_fn,
         in_specs=(P(spec0, None), P(spec0), P(), P(), zspec, zspec),
         out_specs=(P(), zspec, zspec) + ((P(),) if health else ()),
         reduce=reduce,
-        donate_argnums=(4, 5) if sharded else None)
+        donate_argnums=(4, 5))
 
 
 @functools.lru_cache(maxsize=32)
 def _ftrl_sparse_program(mesh, alpha: float, beta: float, l1: float,
                          l2: float, health: bool = False,
-                         sharded: bool = False):
+                         sharded: bool = False, use_kernel: bool = False):
     """ONE sparse-batch FTRL update as a compiled map-reduce program —
     the device twin of the host CSR branch (ref CalculateLocalGradient:
     364-388: gradient and weight sums accumulate ONLY at a sample's
@@ -194,6 +200,15 @@ def _ftrl_sparse_program(mesh, alpha: float, beta: float, l1: float,
     dense program's); the FTRL elementwise rule is the *update*. Padded
     nnz slots carry validity 0 so they contribute nothing; padded rows
     own no nnz so their p never enters a sum.
+
+    With ``use_kernel`` (TPU, small segment domains — fit() gates on
+    ``segment_reduce_fits``) the three segment-sums run the fused pallas
+    segment-reduce: the per-coordinate gradient and weight sums share
+    ONE kernel pass over the nnz (stacked into two value columns)
+    instead of two serialized XLA scatters, and the forward per-row sum
+    is a third; the cross-shard reduce and the FTRL rule are unchanged,
+    so results match the XLA program up to float reassociation in the
+    per-tile partial sums.
 
     NO buffer donation here, deliberately: a first-batch device-sparse
     failure falls back to the host CSR engine (fit()), and that
@@ -214,15 +229,27 @@ def _ftrl_sparse_program(mesh, alpha: float, beta: float, l1: float,
         yb, wb = yb[0], wb[0]
         rows_s = yb.shape[0]
         d_pad = coeffs.shape[0]
-        dots = jax.ops.segment_sum(vals * coeffs[col] * valid, row,
-                                   num_segments=rows_s)
-        p = 1.0 / (1.0 + jnp.exp(-dots))
-        partials = {
-            "grad": jax.ops.segment_sum(vals * (p - yb)[row] * valid,
-                                        col, num_segments=d_pad),
-            "wsum": jax.ops.segment_sum(wb[row] * valid, col,
-                                        num_segments=d_pad),
-        }
+        if use_kernel:
+            from flink_ml_tpu.ops.pallas_kernels import segment_reduce_sum
+            dots = segment_reduce_sum(vals * coeffs[col] * valid, row,
+                                      rows_s)
+            p = 1.0 / (1.0 + jnp.exp(-dots))
+            # grad and wsum share one fused pass: two value columns,
+            # one scatter domain (the nnz column ids)
+            gw = segment_reduce_sum(
+                jnp.stack([vals * (p - yb)[row] * valid,
+                           wb[row] * valid], axis=1), col, d_pad)
+            partials = {"grad": gw[:, 0], "wsum": gw[:, 1]}
+        else:
+            dots = jax.ops.segment_sum(vals * coeffs[col] * valid, row,
+                                       num_segments=rows_s)
+            p = 1.0 / (1.0 + jnp.exp(-dots))
+            partials = {
+                "grad": jax.ops.segment_sum(vals * (p - yb)[row] * valid,
+                                            col, num_segments=d_pad),
+                "wsum": jax.ops.segment_sum(wb[row] * valid, col,
+                                            num_segments=d_pad),
+            }
         if health:
             # per-batch mean logloss, weighted by the sample weights
             # (padded rows carry weight 0, so they contribute nothing)
@@ -316,6 +343,11 @@ def _ftrl_sparse_min_nnz() -> int:
 # set on the first device-sparse failure so later batches skip straight to
 # the host engine instead of re-tracing the same exception
 _ftrl_sparse_broken = False
+
+# set on the first pallas segment-reduce lowering failure so later sparse
+# batches go straight to the XLA segment-sums (still on device) instead of
+# re-tracing the kernel to the same exception
+_pallas_segreduce_broken = False
 
 
 # ---------------------------------------------------------------------------
@@ -698,7 +730,7 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                      if self.weight_col is not None
                      and self.weight_col in batch
                      else np.ones(x.shape[0], np.float64))
-            global _ftrl_sparse_broken
+            global _ftrl_sparse_broken, _pallas_segreduce_broken
             if x.nnz >= _ftrl_sparse_min_nnz() and not _ftrl_sparse_broken:
                 # large sparse batches update ON DEVICE: segment-sums
                 # over the sharded nnz (the device twin of the host CSR
@@ -709,6 +741,11 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                     from jax.sharding import (NamedSharding,
                                               PartitionSpec as P)
 
+                    from flink_ml_tpu.ops.pallas_kernels import (
+                        is_pallas_failure,
+                        pallas_supported,
+                        segment_reduce_fits,
+                    )
                     from flink_ml_tpu.parallel.mesh import (
                         data_pspec,
                         data_shard_count,
@@ -717,24 +754,62 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
                     if mesh is None:
                         mesh = default_mesh()
                         axes = data_axes(mesh)
-                    program = _ftrl_sparse_program(mesh, alpha, beta,
-                                                   l1, l2,
-                                                   health=health_on,
-                                                   sharded=sharded)
                     packed = _pack_csr_shards(x, y, w_col,
                                               data_shard_count(mesh))
+                    rows_s = packed[4].shape[1]
+                    # fused pallas segment-reduce for the shapes whose
+                    # one-hot block fits VMEM (small coordinate domains;
+                    # hashed 2^18 features keep the XLA scatter). The
+                    # coordinate domain the program scatters over is the
+                    # PADDED model dim (sharded mode pads to the shard
+                    # multiple).
+                    d_dom = (_upd.padded_len(d, data_shard_count(mesh))
+                             if sharded else d)
+                    use_kernel = (pallas_supported()
+                                  and not _pallas_segreduce_broken
+                                  and segment_reduce_fits(d_dom, 2)
+                                  and segment_reduce_fits(rows_s, 1))
                     sh = NamedSharding(mesh, P(data_pspec(mesh), None))
                     packed_dev = tuple(jax.device_put(a, sh)
                                        for a in packed)
-                    out = program(*packed_dev, *device_state())
-                    if n_sparse_dev == 0:
-                        # first sparse-device batch runs SYNCHRONOUSLY:
-                        # dispatch is async, so without this an execution
-                        # failure (e.g. OOM) would surface much later at
-                        # a blocking fetch outside this try and crash the
-                        # fit instead of degrading. Later batches reuse
-                        # the proven program shape and stay async.
-                        jax.block_until_ready(out)
+
+                    def sparse_step(use_k):
+                        # the sparse program never donates, so a kernel
+                        # retry may re-pass the same state buffers
+                        program = _ftrl_sparse_program(
+                            mesh, alpha, beta, l1, l2, health=health_on,
+                            sharded=sharded, use_kernel=use_k)
+                        res = program(*packed_dev, *device_state())
+                        if n_sparse_dev == 0:
+                            # first sparse-device batch runs
+                            # SYNCHRONOUSLY: dispatch is async, so
+                            # without this an execution failure (e.g.
+                            # OOM) would surface much later at a
+                            # blocking fetch outside this try and crash
+                            # the fit instead of degrading. Later
+                            # batches reuse the proven program shape
+                            # and stay async.
+                            jax.block_until_ready(res)
+                        return res
+
+                    try:
+                        out = sparse_step(use_kernel)
+                    except Exception as e:
+                        if not use_kernel or not is_pallas_failure(e):
+                            raise
+                        # kernel lowering/compile failed: keep the XLA
+                        # segment-sums ON DEVICE for the rest of the
+                        # process, loudly (the assign/Lloyd/SGD kernel
+                        # policy) — only a non-pallas failure falls
+                        # through to the host-engine demotion below
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "pallas segment-reduce kernel failed; using "
+                            "the XLA segment-sums for the rest of this "
+                            "process", exc_info=True)
+                        _pallas_segreduce_broken = True
+                        out = sparse_step(False)
                     if health_on:
                         *new_state, batch_loss = out
                         new_state = tuple(new_state)

@@ -47,7 +47,7 @@ def _build() -> Optional[ctypes.CDLL]:
             try:
                 subprocess.run(
                     ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                     "-std=c++17", *_SOURCES, "-o", tmp],
+                     "-pthread", "-std=c++17", *_SOURCES, "-o", tmp],
                     check=True, capture_output=True)
                 os.replace(tmp, _LIB)
             finally:
@@ -78,11 +78,12 @@ def _build() -> Optional[ctypes.CDLL]:
         lib.factorize_i64.argtypes = [
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int64]
+            ctypes.c_int64, ctypes.c_int64]
         lib.doc_freq_i64.restype = ctypes.c_int64
         lib.doc_freq_i64.argtypes = [
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64]
         for fn_name in ("rowwise_counts_u8", "rowwise_counts_u16",
                         "rowwise_counts_u32", "rowwise_counts_i64"):
             fn = getattr(lib, fn_name)
@@ -184,13 +185,53 @@ def csv_parse_numeric(data: bytes, n_cols: int, delimiter: str = ","):
 #: uniq buffer would get large — callers fall back to their Python engine
 FACTORIZE_UNIQ_CAP = 1 << 24
 
+#: env var: worker-thread count for the threadable native kernels
+#: (factorize_i64, doc_freq_i64). Default 1 — the host string tier
+#: already shards rows over FORKED pool workers, and threads multiply
+#: per worker; keep threads × workers within the core count.
+NATIVE_THREADS_ENV = "FLINK_ML_TPU_NATIVE_THREADS"
 
-def factorize_i64(keys: np.ndarray):
+#: sanity ceiling on the parsed thread count (a fat-fingered value must
+#: not spawn thousands of threads)
+_NATIVE_THREADS_MAX = 256
+
+_threads_warned = False
+
+
+def native_threads() -> int:
+    """The validated FLINK_ML_TPU_NATIVE_THREADS value: a positive int,
+    capped at 256. Unset/empty → 1. Non-positive or unparsable values →
+    1 with ONE warning per process — a bad knob degrades to the
+    single-threaded kernels, never crashes a fit."""
+    global _threads_warned
+    raw = os.environ.get(NATIVE_THREADS_ENV)
+    if not raw:
+        return 1
+    try:
+        value = int(raw)
+    except ValueError:
+        value = 0
+    if value < 1:
+        if not _threads_warned:
+            _threads_warned = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s=%r is not a positive integer; native kernels run "
+                "single-threaded", NATIVE_THREADS_ENV, raw)
+        return 1
+    return min(value, _NATIVE_THREADS_MAX)
+
+
+def factorize_i64(keys: np.ndarray, n_threads: Optional[int] = None):
     """First-appearance factorization of a 1-D int64 array via the native
     open-addressing kernel: returns (uniq_keys, codes) with uniq in
     appearance order, or None when the native tier is unavailable or the
     distinct count exceeds FACTORIZE_UNIQ_CAP (callers fall back to
-    pandas/np.unique)."""
+    pandas/np.unique). ``n_threads`` (default: the validated
+    FLINK_ML_TPU_NATIVE_THREADS) shards the keys across worker threads
+    with a deterministic chunk-order merge — output byte-identical to
+    the single-threaded pass."""
     faults.inject("native-kernel", kernel="factorize_i64")
     lib = _get_lib()
     if lib is None:
@@ -202,19 +243,28 @@ def factorize_i64(keys: np.ndarray):
     uniq = np.empty(cap, np.int64)
     nu = lib.factorize_i64(_ptr(keys, ctypes.c_int64), ctypes.c_int64(n),
                            _ptr(codes, ctypes.c_int64),
-                           _ptr(uniq, ctypes.c_int64), ctypes.c_int64(cap))
+                           _ptr(uniq, ctypes.c_int64), ctypes.c_int64(cap),
+                           ctypes.c_int64(n_threads if n_threads is not None
+                                          else native_threads()))
     if nu < 0:
         return None
     return uniq[:nu].copy(), codes
 
 
-def doc_freq_i64(codes_mat: np.ndarray, u: int):
+def doc_freq_i64(codes_mat: np.ndarray, u: int,
+                 n_threads: Optional[int] = None):
     """Per-code document frequency of an (n_rows, w) int64 code matrix
     with domain [0, u) — one native pass with a last-seen-row stamp; or
     None when the native tier is unavailable, any code falls outside
     [0, u) (the kernel bounds-checks and returns -1 rather than corrupt
     the heap), or the domain exceeds ROWWISE_DOMAIN_CAP (callers fall
     back to the bincount/row-sort python engines).
+
+    ``n_threads`` (default: the validated FLINK_ML_TPU_NATIVE_THREADS)
+    splits the rows across worker threads, each with its own stamp and
+    df partial (another 16·u bytes per thread — the domain cap bounds
+    it), merged by exact integer sum: byte-identical to single-threaded,
+    and ANY thread's bounds hit fails the whole call.
 
     The cap mirrors the counter siblings: the last-seen stamp is 8*u
     bytes PER FORKED WORKER, and _cv_shard_counts calls this with
@@ -233,7 +283,9 @@ def doc_freq_i64(codes_mat: np.ndarray, u: int):
     df = np.zeros(u, np.int64)
     rc = lib.doc_freq_i64(_ptr(codes_mat, ctypes.c_int64),
                           ctypes.c_int64(n_rows), ctypes.c_int64(w),
-                          ctypes.c_int64(u), _ptr(df, ctypes.c_int64))
+                          ctypes.c_int64(u), _ptr(df, ctypes.c_int64),
+                          ctypes.c_int64(n_threads if n_threads is not None
+                                         else native_threads()))
     if rc < 0:  # out-of-domain code: python engines raise IndexError
         return None
     return df

@@ -11,7 +11,12 @@ benchmark results file reduced to a snapshot). The diff reports:
 - **histogram-quantile deltas** (q50/q90/q99 of every registry
   histogram, labeled series kept apart),
 - **compile-count deltas** (the ``ml.compile`` counters, plus the
-  backend_compile total `compilestats` aggregates).
+  backend_compile total `compilestats` aggregates),
+- **per-phase compile-TIME deltas** (the ``ml.compile
+  phaseMs{phase=...}`` histograms: count and summed ms per monitoring
+  phase), so a gate trip distinguishes "B compiles MORE" from "B's
+  compiles got SLOWER" — two different regressions with two different
+  fixes.
 
 ``--budget <pct>`` turns the report into a regression gate: exit
 :data:`EXIT_BUDGET` (4) when side B regresses side A beyond the budget.
@@ -30,6 +35,7 @@ import argparse
 import json
 import math
 import os
+import re
 import sys
 from typing import Dict, List, Optional
 
@@ -98,6 +104,24 @@ def load_side(path: str) -> dict:
 
 
 # -- delta computation --------------------------------------------------------
+_PHASE_KEY = re.compile(r'^phaseMs\{phase="((?:[^"\\]|\\.)*)"\}$')
+
+
+def _phase_totals(snap: Optional[dict]) -> Dict[str, dict]:
+    """``phase → {count, ms}`` from a snapshot's ``ml.compile``
+    ``phaseMs{phase="..."}`` histograms (count + summed ms — the
+    jax.monitoring per-phase channels compilestats subscribes to)."""
+    out: Dict[str, dict] = {}
+    hists = ((snap or {}).get("ml.compile") or {}).get("histograms", {})
+    for key, hist in hists.items():
+        m = _PHASE_KEY.match(key)
+        if not m:
+            continue
+        out[m.group(1)] = {"count": int(hist.get("count", 0)),
+                           "ms": float(hist.get("sum", 0.0))}
+    return out
+
+
 def _pct(a: float, b: float) -> Optional[float]:
     if a <= 0:
         return None if b <= 0 else math.inf
@@ -152,8 +176,25 @@ def diff_profiles(a: dict, b: dict) -> dict:
     totals_a = compile_totals_from_snapshot(ma)
     totals_b = compile_totals_from_snapshot(mb)
 
+    # per-phase compile-time deltas (ml.compile phaseMs{phase=...}):
+    # count AND summed ms per monitoring phase, so "more compiles" and
+    # "slower compiles" read as distinct findings
+    pa, pb = _phase_totals(ma), _phase_totals(mb)
+    phase_rows = []
+    for phase in sorted(set(pa) | set(pb)):
+        ra = pa.get(phase, {"count": 0, "ms": 0.0})
+        rb = pb.get(phase, {"count": 0, "ms": 0.0})
+        phase_rows.append({
+            "phase": phase,
+            "a_count": ra["count"], "b_count": rb["count"],
+            "a_ms": round(ra["ms"], 3), "b_ms": round(rb["ms"], 3),
+            "delta_ms": round(rb["ms"] - ra["ms"], 3),
+            "delta_pct": _pct(ra["ms"], rb["ms"])})
+    phase_rows.sort(key=lambda r: -abs(r["delta_ms"]))
+
     return {"spans": span_rows, "histograms": hist_rows,
             "compile": compile_rows,
+            "compile_phases": phase_rows,
             "compile_totals": {"a": totals_a, "b": totals_b},
             # span gating needs span data on BOTH sides: against a
             # metrics-only side (a snapshot file, or a dir that captured
@@ -233,6 +274,17 @@ def render_diff(diff: dict, viol: List[dict], top_n: int = 15) -> str:
         if row["delta"]:
             out.append(f"  {row['key']}: {row['a']}→{row['b']} "
                        f"({row['delta']:+d})")
+    phases = [r for r in diff.get("compile_phases", ())
+              if r["a_count"] or r["b_count"]]
+    if phases:
+        out.append("per-phase compile time (count / ms — 'more compiles'"
+                   " vs 'slower compiles'):")
+        for row in phases[:top_n]:
+            out.append(
+                f"  {row['phase']}: {row['a_count']}→{row['b_count']} "
+                f"compiles, {row['a_ms']:.1f}→{row['b_ms']:.1f} ms "
+                f"({row['delta_ms']:+.1f} ms, "
+                f"{_fmt_pct(row['delta_pct']).strip()})")
 
     if viol:
         out.append("")

@@ -199,7 +199,8 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
 @functools.lru_cache(maxsize=128)
 def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams,
                                health: bool = False,
-                               sharded: bool = False):
+                               sharded: bool = False,
+                               fused: bool = False):
     """A K-round slice of the training loop as ONE compiled SPMD program:
     ``segment(xs, ys, ws, coeffs, offsets, epoch0, limit, hist, fin) ->
     (coeffs, offsets, mean_loss, epoch, stop, hist, fin)``.  The epoch
@@ -221,7 +222,18 @@ def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams,
     host reads both only at segment boundaries, so telemetry adds zero
     extra device syncs. Without ``health`` the signature is EXACTLY the
     pre-health 7-in/5-out contract (external callers — the TPU
-    profiling scripts — build with the default flag)."""
+    profiling scripts — build with the default flag).
+
+    With ``fused`` (iteration.segment_fusion_enabled) the per-boundary
+    scalars come back STACKED as one int32 vector — ``[epoch, stop]``,
+    or ``[epoch, stop, fin]`` with health — so the host pays ONE
+    device→host transfer per segment boundary instead of one per
+    scalar; the outputs become ``(coeffs, offsets, mean_loss, bundle)``
+    (+ ``hist`` with health). The (coeffs, offsets) carry — and the
+    hist buffer with health — is DONATED in every build (the in-place
+    update of the raw-speed ladder); sharded builds additionally route
+    through ``instrumented_jit`` via their name for per-function
+    compile accounting."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     p = data_shard_count(mesh)
@@ -255,26 +267,41 @@ def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams,
         return coeffs, offset[None], mean_loss, epoch, stop, hist, fin
 
     if health:
-        per_shard = run
-        extra_in, extra_out = (P(), P()), (P(), P())
+        def per_shard(xl, yl, wl, coeffs, offsets, epoch0, limit, hist,
+                      fin):
+            out = run(xl, yl, wl, coeffs, offsets, epoch0, limit, hist,
+                      fin)
+            if not fused:
+                return out
+            coeffs, offsets, mean_loss, epoch, stop, hist, fin = out
+            bundle = jnp.stack([epoch, stop.astype(jnp.int32),
+                                fin.astype(jnp.int32)])
+            return coeffs, offsets, mean_loss, bundle, hist
+
+        extra_in = (P(), P())
+        extra_out = (P(),) if fused else (P(), P())
+        donate = (3, 4, 7)
     else:
         def per_shard(xl, yl, wl, coeffs, offsets, epoch0, limit):
-            return run(xl, yl, wl, coeffs, offsets, epoch0, limit,
-                       jnp.zeros((0, 3), jnp.float32),
-                       jnp.asarray(True))[:5]
+            out = run(xl, yl, wl, coeffs, offsets, epoch0, limit,
+                      jnp.zeros((0, 3), jnp.float32),
+                      jnp.asarray(True))[:5]
+            if not fused:
+                return out
+            coeffs, offsets, mean_loss, epoch, stop = out
+            bundle = jnp.stack([epoch, stop.astype(jnp.int32)])
+            return coeffs, offsets, mean_loss, bundle
 
         extra_in, extra_out = (), ()
+        donate = (3, 4)
 
-    # sharded-update programs donate the (coeffs, offsets) carry through
-    # instrumented_jit: the update happens in place in the donated
-    # buffers (the first rung of the raw-speed ladder) and the compile
-    # is counted per-function
+    scalar_out = (P(),) if fused else (P(), P())
     return mr.map_shards(
         per_shard, mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
                   P(spec0), P(), P()) + extra_in,
-        out_specs=(wspec, P(spec0), P(), P(), P()) + extra_out,
-        donate_argnums=(3, 4) if sharded else None,
+        out_specs=(wspec, P(spec0), P()) + scalar_out + extra_out,
+        donate_argnums=donate,
         name="sgd.segment" if sharded else None)
 
 
@@ -397,13 +424,16 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
                     jnp.stack(rows), fin)
         return coeffs, offset[None], mean_loss, epoch, stop
 
+    # the (coeffs, offsets) carry donates in EVERY build — the update
+    # happens in place in the donated buffers; callers rebuild the carry
+    # on the pallas-fallback retry (make_init in optimize)
     return mr.map_shards(
         per_shard, mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
                   P(spec0)),
         out_specs=(wspec, P(spec0), P(), P(), P())
         + ((P(), P()) if health else ()),
-        donate_argnums=(3, 4) if sharded else None,
+        donate_argnums=(3, 4),
         name="sgd.unrolled" if sharded else None)
 
 
@@ -660,7 +690,7 @@ class SGD:
         # model-sharded coeffs, per-task offsets) — both for the
         # mapped round/segment and so that checkpoint restore
         # re-places leaves onto the right shardings. A closure, not a
-        # tuple: the sharded programs DONATE the carry, so the pallas
+        # tuple: the compiled programs DONATE the carry, so the pallas
         # fallback retry must rebuild it rather than re-pass consumed
         # buffers.
         def make_init():
@@ -739,10 +769,14 @@ class SGD:
                 _finish_fit_health(algo, health_on, hist, fin, epoch,
                                    mean_loss, out)
                 return out, float(mean_loss)
+            from flink_ml_tpu.iteration.iteration import (
+                read_boundary, segment_fusion_enabled)
+            fused = segment_fusion_enabled()
             seg_prog = _build_sgd_segment_program(type(loss_func), mesh,
                                                   self.params,
                                                   health=health_on,
-                                                  sharded=sharded)
+                                                  sharded=sharded,
+                                                  fused=fused)
             # health carry lives OUTSIDE the checkpointed carry so the
             # snapshot format is identical with telemetry on or off; a
             # restore simply resumes the series at its epoch (earlier
@@ -752,8 +786,7 @@ class SGD:
                 "hist": jax.device_put(jnp.full(
                     (self.params.max_iter if health_on else 0, 3),
                     jnp.nan, jnp.float32), repl),
-                "fin": jax.device_put(jnp.asarray(True), repl),
-                "first": None, "epoch": 0,
+                "fin": True, "first": None, "epoch": 0,
             }
 
             def run_segment(carry, epoch0, limit):
@@ -761,27 +794,46 @@ class SGD:
                 if hstate["first"] is None:
                     hstate["first"] = int(epoch0)
                 if health_on:
-                    (coeffs, offsets, mean_loss, epoch, stop,
-                     hstate["hist"], hstate["fin"]) = seg_prog(
+                    out = seg_prog(
                         xs, ys, ws, coeffs, offsets,
                         jnp.int32(epoch0), jnp.int32(limit),
-                        hstate["hist"], hstate["fin"])
-                else:
-                    coeffs, offsets, mean_loss, epoch, stop = seg_prog(
-                        xs, ys, ws, coeffs, offsets,
-                        jnp.int32(epoch0), jnp.int32(limit))
-                if health_on:
+                        hstate["hist"], jnp.asarray(bool(hstate["fin"])))
+                    if fused:
+                        # ONE stacked [epoch, stop, fin] transfer per
+                        # boundary instead of three scalar fetches
+                        (coeffs, offsets, mean_loss, bundle,
+                         hstate["hist"]) = out
+                        vals = read_boundary(bundle)
+                        epoch, stop = int(vals[0]), bool(vals[1])
+                        hstate["fin"] = bool(vals[2])
+                    else:
+                        (coeffs, offsets, mean_loss, epoch, stop,
+                         hstate["hist"], fin) = out
+                        vals = read_boundary((epoch, stop, fin))
+                        epoch, stop = int(vals[0]), bool(vals[1])
+                        hstate["fin"] = bool(vals[2])
                     # epoch-boundary health check: the segment boundary
                     # is this mode's host sync point, so reading the
-                    # sentinel costs no extra round-trip — and a NaN
-                    # state fails the fit NOW instead of burning the
-                    # remaining segments
-                    hstate["epoch"] = int(epoch)
-                    if not bool(hstate["fin"]):
+                    # sentinel costs no extra round-trip (it rides the
+                    # fused bundle) — and a NaN state fails the fit NOW
+                    # instead of burning the remaining segments
+                    hstate["epoch"] = epoch
+                    if not hstate["fin"]:
                         _finish_fit_health(
                             algo, True, hstate["hist"], False,
                             hstate["epoch"], mean_loss, None,
                             epoch0=hstate["first"])
+                else:
+                    out = seg_prog(
+                        xs, ys, ws, coeffs, offsets,
+                        jnp.int32(epoch0), jnp.int32(limit))
+                    if fused:
+                        coeffs, offsets, mean_loss, bundle = out
+                        vals = read_boundary(bundle)
+                    else:
+                        coeffs, offsets, mean_loss, epoch, stop = out
+                        vals = read_boundary((epoch, stop))
+                    epoch, stop = int(vals[0]), bool(vals[1])
                 return (coeffs, offsets, mean_loss), epoch, stop
 
             if seg_k:

@@ -307,6 +307,96 @@ def sgd_batch_terms(xl, yl, wl, coeffs, start, clip, lb: int, tile: int,
                              interpret=interpret)
 
 
+# -- fused segment-reduce (scatter-add by segment id) ------------------------
+
+SEGREDUCE_TILE_N = 512
+
+#: VMEM one grid step may claim: double-buffered (tile, d) value blocks,
+#: the (tile, u) one-hot block, the ids tile and the (u, d) accumulator
+#: that persists across grid steps
+SEGREDUCE_VMEM_BUDGET_BYTES = 8 << 20
+
+
+def segment_reduce_fits(num_segments: int, d: int) -> bool:
+    """True when the fused segment-reduce kernel's working set fits the
+    VMEM budget for these shapes — the gate callers apply. Scatter-add
+    here is a one-hot matmul, so the segment domain must be small enough
+    for a (tile, u) block; wide domains (hashed 2^18 features) keep
+    XLA's native scatter."""
+    t = SEGREDUCE_TILE_N
+    working = (2 * t * d + t * num_segments + 2 * num_segments * d
+               + 2 * t) * 4
+    return 0 < num_segments and working <= SEGREDUCE_VMEM_BUDGET_BYTES
+
+
+def _segreduce_kernel(x_ref, ids_ref, out_ref):
+    """One row tile of a segment-sum, entirely in VMEM: the (tile, u)
+    one-hot block exists only here — XLA's scatter-add lowers to a
+    serialized per-row update on shapes this small, while the one-hot
+    matmul runs on the MXU and reads the tile ONCE. The TPU grid
+    iterates sequentially per core, so out_ref accumulates across tiles
+    (init at step 0 — the Lloyd-partials idiom above). Out-of-range ids
+    (negative padding included) match no one-hot column and contribute
+    nothing, mirroring jax.ops.segment_sum's drop semantics."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = x_ref[:]                       # (tile, d)
+    ids = ids_ref[:]                   # (tile, 1) int32
+    u = out_ref.shape[0]
+    one_hot = (ids == jax.lax.broadcasted_iota(
+        jnp.int32, (1, u), 1)).astype(x.dtype)        # (tile, u)
+    out_ref[:] += jnp.dot(one_hot.T, x,
+                          preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
+def _segreduce_padded(x, ids, num_segments, interpret=False):
+    n, d = x.shape
+    return pl.pallas_call(
+        _segreduce_kernel,
+        out_shape=jax.ShapeDtypeStruct((num_segments, d), jnp.float32),
+        grid=(n // SEGREDUCE_TILE_N,),
+        in_specs=[
+            pl.BlockSpec((SEGREDUCE_TILE_N, d), lambda i: (i, 0)),
+            pl.BlockSpec((SEGREDUCE_TILE_N, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((num_segments, d), lambda i: (0, 0)),
+        interpret=interpret,
+    )(x, ids)
+
+
+def segment_reduce_sum(values, segment_ids, num_segments: int,
+                       interpret: bool = False):
+    """Fused per-segment sums — ``out[s] = Σ values[i] where
+    segment_ids[i] == s`` — the segment-reduce shape XLA serializes as a
+    per-row scatter. values: (n,) or (n, d) float32; segment_ids: (n,)
+    int32 → (num_segments,) / (num_segments, d) float32. Callers gate
+    with :func:`segment_reduce_fits`; rows with out-of-range ids are
+    dropped (segment_sum parity). Pads n up to the tile size with id -1
+    rows; euclidean of use: the FTRL sparse program's per-coordinate
+    gradient/weight sums."""
+    values = jnp.asarray(values, jnp.float32)
+    squeeze = values.ndim == 1
+    if squeeze:
+        values = values[:, None]
+    ids = jnp.asarray(segment_ids, jnp.int32)
+    n = values.shape[0]
+    if n == 0:  # empty grid would skip the step-0 init and return garbage
+        out = jnp.zeros((num_segments, values.shape[1]), jnp.float32)
+        return out[:, 0] if squeeze else out
+    pad = (-n) % SEGREDUCE_TILE_N
+    if pad:
+        values = jnp.pad(values, ((0, pad), (0, 0)))
+        ids = jnp.pad(ids, (0, pad), constant_values=-1)
+    out = _segreduce_padded(values, ids[:, None], num_segments,
+                            interpret=interpret)
+    return out[:, 0] if squeeze else out
+
+
 # -- fused distance + top-k (KNN) -------------------------------------------
 
 KNN_TILE_N = 256   # test rows per grid step

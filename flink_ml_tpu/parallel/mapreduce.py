@@ -124,27 +124,27 @@ def map_shards(fn, mesh, in_specs, out_specs, *, check_vma: bool = False,
 
     THE seam every fit program builds through (JL108): wraps ``fn`` in
     the version-portable ``shard_map`` (recording mesh topology when
-    tracing is armed) and jits the result. With ``donate_argnums`` (the
-    sharded-update state carries) or ``name``, the jit goes through
-    ``instrumented_jit`` so the program gets per-function compile
-    accounting and the donated buffers are updated in place — the
-    first rung of the raw-speed ladder (docs/performance.md).
-    ``jit=False`` returns the bare mapped callable for host loops that
-    jit the round themselves (iteration.iterate_bounded)."""
+    tracing is armed) and jits the result. ``donate_argnums`` (the
+    iteration state carries) makes the donated buffers update in place —
+    the first rung of the raw-speed ladder (docs/performance.md); with
+    ``name`` the jit additionally goes through ``instrumented_jit`` for
+    per-function compile accounting. Donation WITHOUT a name keeps
+    plain ``jax.jit``'s C++ dispatch cache — the per-batch hot loops
+    (replicated FTRL, unsharded SGD) donate without paying a Python
+    signature lookup per call. ``jit=False`` returns the bare mapped
+    callable for host loops that jit the round themselves
+    (iteration.iterate_bounded)."""
     mapped = _shard_map(fn, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_vma=check_vma)
     if not jit:
         return mapped
-    if donate_argnums is not None or name is not None:
+    donate_kw = ({"donate_argnums": tuple(donate_argnums)}
+                 if donate_argnums else {})
+    if name is not None:
         from flink_ml_tpu.observability.compilestats import instrumented_jit
 
-        kwargs = {}
-        if donate_argnums:
-            kwargs["donate_argnums"] = tuple(donate_argnums)
-        return instrumented_jit(
-            mapped, name=name or getattr(fn, "__name__", "map_shards"),
-            **kwargs)
-    return jax.jit(mapped)
+        return instrumented_jit(mapped, name=name, **donate_kw)
+    return jax.jit(mapped, **donate_kw)
 
 
 class MapReduceProgram:

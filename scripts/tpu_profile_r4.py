@@ -118,16 +118,23 @@ def _profile_programs() -> int:
     ys, _ = ensure_on_mesh(mesh, y, axes, jnp.float32)
     from flink_ml_tpu.parallel.collective import ones_on_mesh
     ws = ones_on_mesh(mesh, n, axes, jnp.float32)
-    c0 = jax.device_put(jnp.zeros((d,), jnp.float32))
-    offs = jax.device_put(jnp.zeros((1,), jnp.int32))
+
+    # the fit programs DONATE their (coeffs, offsets) carry — every
+    # invocation (the AOT compile's example args included) needs fresh
+    # carry buffers
+    def sgd_args(label):
+        args = (xs, ys, ws,
+                jax.device_put(jnp.zeros((d,), jnp.float32)),
+                jax.device_put(jnp.zeros((1,), jnp.int32)))
+        if label == "while-segment":
+            args = args + (jnp.int32(0), jnp.int32(prm.max_iter))
+        return args
 
     for label, builder in (
             ("unrolled", om._build_sgd_unrolled_program),
             ("while-segment", om._build_sgd_segment_program)):
         prog = builder(BinaryLogisticLoss, mesh, prm)
-        args = (xs, ys, ws, c0, offs)
-        if label == "while-segment":
-            args = args + (jnp.int32(0), jnp.int32(prm.max_iter))
+        args = sgd_args(label)
         with tracing.tracer.span(f"program:sgd-{label}") as sp:
             compiled = compilestats.aot_compile(prog, *args,
                                                 name=f"sgd_{label}")
@@ -145,11 +152,11 @@ def _profile_programs() -> int:
                     print(f"  arg{i}: want {f}  have {have}{mark}")
             prof_dir = os.path.join(ROOT, "profiles",
                                     f"northstar_lr_r4_{label}")
-            best = timed(lambda: compiled(*args))
+            best = timed(lambda: compiled(*sgd_args(label)))
             sp.set_attribute("best_wall_ms", round(best * 1e3, 3))
             compilestats.sample_memory("program", span=sp)
             with jax.profiler.trace(prof_dir):
-                jax.block_until_ready(compiled(*args))
+                jax.block_until_ready(compiled(*sgd_args(label)))
         print(f"SGD {label}: best wall {best * 1e3:.1f} ms; device ops:")
         device_op_table(prof_dir)
 
@@ -159,17 +166,24 @@ def _profile_programs() -> int:
     n, d, k = 1_000_000, 100, 10
     x = _device_random(2, (n, d))
     xs, nn = ensure_on_mesh(mesh, x, axes, jnp.float32)
-    init = jnp.asarray(np.random.default_rng(2).random((k, d)), jnp.float32)
+    init_host = np.random.default_rng(2).random((k, d))
+
+    def km_carry():
+        # fresh donated (c0, counts0) carry per invocation
+        return (jnp.asarray(init_host, jnp.float32),
+                jnp.zeros((k,), jnp.float32))
+
     fit = _build_lloyd_program(mesh, "euclidean", 10)
     with tracing.tracer.span("program:kmeans-lloyd10") as sp:
-        fit_c = compilestats.aot_compile(fit, xs, jnp.int32(n), init,
+        fit_c = compilestats.aot_compile(fit, xs, jnp.int32(n),
+                                         *km_carry(),
                                          name="kmeans_lloyd10")
-        best = timed(lambda: fit_c(xs, jnp.int32(n), init))
+        best = timed(lambda: fit_c(xs, jnp.int32(n), *km_carry()))
         sp.set_attribute("best_wall_ms", round(best * 1e3, 3))
         compilestats.sample_memory("program", span=sp)
         prof_dir = os.path.join(ROOT, "profiles", "northstar_kmeans_r4")
         with jax.profiler.trace(prof_dir):
-            jax.block_until_ready(fit_c(xs, jnp.int32(n), init))
+            jax.block_until_ready(fit_c(xs, jnp.int32(n), *km_carry()))
     print(f"\nKMeans lloyd 10 rounds: best wall {best * 1e3:.1f} ms; "
           "device ops:")
     device_op_table(prof_dir)

@@ -433,3 +433,69 @@ def test_diff_on_two_traced_fits_end_to_end(tmp_path):
         == trace_diff.EXIT_OK
     assert trace_diff.main([a, slow, "--budget", "400", "--min-ms", "100"]) \
         == trace_diff.EXIT_BUDGET
+
+
+def test_diff_per_phase_compile_time_golden(tmp_path, capsys):
+    """Golden snapshot: same compile COUNT, slower compile TIME — the
+    per-phase rows must carry both quantities so the ratchet can tell
+    'more compiles' from 'slower compiles', in text and JSON output."""
+    def snapshot_file(path, ms_each, n=3, phase="backend_compile"):
+        reg = MetricsRegistry()
+        h = reg.group("ml", "compile").histogram(
+            "phaseMs", buckets=cs.COMPILE_BUCKETS,
+            labels={"phase": phase})
+        for _ in range(n):
+            h.observe(ms_each)
+        with open(path, "w") as f:
+            json.dump(reg.snapshot(), f)
+        return str(path)
+
+    a = snapshot_file(tmp_path / "a.json", 10.0)
+    b = snapshot_file(tmp_path / "b.json", 50.0)
+    side_a = trace_diff.load_side(a)
+    side_b = trace_diff.load_side(b)
+    diff = trace_diff.diff_profiles(side_a, side_b)
+    # the golden row: 3→3 compiles (no count delta), 30→150 ms
+    assert diff["compile_phases"] == [{
+        "phase": "backend_compile",
+        "a_count": 3, "b_count": 3,
+        "a_ms": 30.0, "b_ms": 150.0,
+        "delta_ms": 120.0, "delta_pct": 400.0,
+    }]
+    # count totals see no regression; the time delta is report-only
+    assert diff["compile_totals"]["a"]["count"] == 3
+    assert diff["compile_totals"]["b"]["count"] == 3
+    assert trace_diff.main([a, b, "--budget", "50"]) == trace_diff.EXIT_OK
+    out = capsys.readouterr().out
+    assert "per-phase compile time" in out
+    assert "backend_compile: 3→3 compiles, 30.0→150.0 ms" in out
+    # JSON carries the same rows
+    assert trace_diff.main([a, b, "--format", "json"]) == trace_diff.EXIT_OK
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["diff"]["compile_phases"][0]["phase"] \
+        == "backend_compile"
+    assert payload["diff"]["compile_phases"][0]["b_ms"] == 150.0
+
+
+def test_diff_phase_rows_absent_phase_reads_zero(tmp_path):
+    """A phase present on only one side diffs against an explicit zero
+    row instead of vanishing."""
+    def snapshot_file(path, phases):
+        reg = MetricsRegistry()
+        for phase, ms in phases:
+            reg.group("ml", "compile").histogram(
+                "phaseMs", buckets=cs.COMPILE_BUCKETS,
+                labels={"phase": phase}).observe(ms)
+        with open(path, "w") as f:
+            json.dump(reg.snapshot(), f)
+        return str(path)
+
+    a = snapshot_file(tmp_path / "a.json", [("backend_compile", 5.0)])
+    b = snapshot_file(tmp_path / "b.json",
+                      [("backend_compile", 5.0), ("lower_jaxpr", 7.0)])
+    diff = trace_diff.diff_profiles(trace_diff.load_side(a),
+                                    trace_diff.load_side(b))
+    rows = {r["phase"]: r for r in diff["compile_phases"]}
+    assert rows["lower_jaxpr"]["a_count"] == 0
+    assert rows["lower_jaxpr"]["a_ms"] == 0.0
+    assert rows["lower_jaxpr"]["b_ms"] == 7.0

@@ -124,7 +124,8 @@ def test_pipeline_with_kmeans(rng, tmp_path):
 
 def test_unrolled_lloyd_matches_while_program(rng):
     """The unrolled fit program (static round count) must equal the
-    while-loop program — same round_step, same order."""
+    while-loop program — same round_step, same order. The (c0, counts0)
+    carry is donated, so every call gets fresh carry buffers."""
     import jax.numpy as jnp
 
     from flink_ml_tpu.models.clustering.kmeans import _build_lloyd_program
@@ -133,11 +134,109 @@ def test_unrolled_lloyd_matches_while_program(rng):
 
     mesh = default_mesh()
     x = rng.random((500, 6)).astype(np.float32)
-    init = jnp.asarray(x[:4])
     xs, _ = ensure_on_mesh(mesh, x, data_axes(mesh), jnp.float32)
+
+    def run(measure, unroll):
+        prog = _build_lloyd_program(mesh, measure, 5, unroll=unroll)
+        c, cnt = prog(xs, jnp.int32(500), jnp.asarray(x[:4]),
+                      jnp.zeros((4,), jnp.float32))
+        return np.asarray(c), np.asarray(cnt)
+
     for measure in ("euclidean", "manhattan", "cosine"):
-        a = np.asarray(_build_lloyd_program(mesh, measure, 5, unroll=True)(
-            xs, jnp.int32(500), init))
-        b = np.asarray(_build_lloyd_program(mesh, measure, 5, unroll=False)(
-            xs, jnp.int32(500), init))
-        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-12)
+        ca, cnta = run(measure, True)
+        cb, cntb = run(measure, False)
+        np.testing.assert_allclose(ca, cb, rtol=1e-6, atol=1e-12)
+        np.testing.assert_allclose(cnta, cntb, rtol=1e-6, atol=1e-12)
+
+
+def test_lloyd_program_donates_carry(rng):
+    """The donation satellite's bar for KMeans: the fit program's
+    (c0, counts0) carry must be CONSUMED (in-place update) without a
+    single 'donated buffers were not usable' warning."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.clustering.kmeans import _build_lloyd_program
+    from flink_ml_tpu.parallel.collective import ensure_on_mesh
+    from flink_ml_tpu.parallel.mesh import data_axes, default_mesh
+
+    mesh = default_mesh()
+    x = rng.random((256, 4)).astype(np.float32)
+    xs, _ = ensure_on_mesh(mesh, x, data_axes(mesh), jnp.float32)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        # while program: both carry leaves flow through the loop carry
+        c0 = jax.device_put(jnp.asarray(x[:3]))
+        counts0 = jax.device_put(jnp.zeros((3,), jnp.float32))
+        prog = _build_lloyd_program(mesh, "euclidean", 4, unroll=False)
+        jax.block_until_ready(prog(xs, jnp.int32(256), c0, counts0))
+        assert c0.is_deleted()
+        assert counts0.is_deleted()
+        # unrolled program: the centroid carry donates; counts0 is a
+        # dead input there (counts are recomputed every straight-line
+        # round) which jit drops before donation — no warning either way
+        c0u = jax.device_put(jnp.asarray(x[:3]))
+        prog_u = _build_lloyd_program(mesh, "euclidean", 4, unroll=True)
+        jax.block_until_ready(prog_u(xs, jnp.int32(256), c0u,
+                                     jnp.zeros((3,), jnp.float32)))
+        assert c0u.is_deleted()
+    assert not [w for w in caught
+                if "donat" in str(w.message).lower()], \
+        [str(w.message) for w in caught]
+
+
+def test_kmeans_fit_emits_no_donation_warnings(rng):
+    """Public-API bar: a KMeans.fit through the donated-carry program
+    must stay warning-free (matching the PR 9 SGD/FTRL satellite)."""
+    import warnings
+
+    x, _ = make_blobs(rng, np.array([[0.0, 0.0], [6.0, 6.0]]), n_per=40)
+    table = Table.from_columns(features=as_dense_vector_column(x))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        KMeans(k=2, seed=3, max_iter=8).fit(table)
+    assert not [w for w in caught
+                if "donat" in str(w.message).lower()], \
+        [str(w.message) for w in caught]
+
+
+def test_kmeans_pallas_fallback_retry_with_device_input(rng, monkeypatch):
+    """The pallas-fallback retry must rebuild a FRESH donated carry even
+    when the features column is device-resident (vectors() returns the
+    jax array and init is a device gather): the first attempt consumes
+    its carry, and the XLA retry must not re-pass deleted buffers."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.clustering import kmeans as km_mod
+
+    x = rng.normal(size=(256, 4)).astype(np.float32)
+    table = Table.from_columns(features=jnp.asarray(x))
+
+    calls = []
+
+    def fake_partials(xl, vl, c, interpret=False):
+        calls.append(True)
+        raise RuntimeError("Mosaic lowering failed (synthetic)")
+
+    monkeypatch.setattr(km_mod, "_pallas_lloyd_broken", False)
+    from flink_ml_tpu.ops import pallas_kernels as pk
+    monkeypatch.setattr(pk, "pallas_supported", lambda: True)
+    monkeypatch.setattr(pk, "lloyd_kernel_fits", lambda k, d: True)
+    monkeypatch.setattr(pk, "lloyd_partial_sums", fake_partials)
+    km_mod._build_lloyd_program.cache_clear()
+    est = KMeans(k=3, seed=7, max_iter=5)
+    try:
+        model = est.fit(table)
+    finally:
+        km_mod._build_lloyd_program.cache_clear()
+        km_mod._pallas_lloyd_broken = False
+    assert calls  # the kernel path was really attempted
+    assert model.centroids.shape == (3, 4)
+    assert est.last_execution_path == "xla-lloyd"
+    # the fallback matches a plain XLA fit exactly
+    want = KMeans(k=3, seed=7, max_iter=5).fit(
+        Table.from_columns(features=x))
+    np.testing.assert_allclose(model.centroids, want.centroids,
+                               rtol=1e-6)

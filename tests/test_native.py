@@ -335,3 +335,75 @@ def test_cv_fit_survives_corrupt_codes_via_fallback(monkeypatch):
     monkeypatch.setattr(native, "doc_freq_i64", lambda *a, **k: None)
     got = CountVectorizer(input_col="doc").fit(t).vocabulary
     assert got == want
+
+
+def test_native_threads_env_validation(monkeypatch):
+    """Non-positive / garbage FLINK_ML_TPU_NATIVE_THREADS degrades to 1
+    with a warning — never a crash; valid values parse and cap."""
+    from flink_ml_tpu import native
+
+    monkeypatch.delenv(native.NATIVE_THREADS_ENV, raising=False)
+    assert native.native_threads() == 1
+    monkeypatch.setenv(native.NATIVE_THREADS_ENV, "4")
+    assert native.native_threads() == 4
+    monkeypatch.setenv(native.NATIVE_THREADS_ENV, "100000")
+    assert native.native_threads() == native._NATIVE_THREADS_MAX
+    for bad in ("0", "-3", "two", "", "2.5"):
+        monkeypatch.setenv(native.NATIVE_THREADS_ENV, bad)
+        monkeypatch.setattr(native, "_threads_warned", False)
+        assert native.native_threads() == 1
+    # a factorize under a garbage knob still runs (single-threaded)
+    monkeypatch.setenv(native.NATIVE_THREADS_ENV, "garbage")
+    if native.available():
+        keys = np.asarray([5, 5, 7, 5, 9], np.int64)
+        out = native.factorize_i64(keys)
+        assert out is not None
+        np.testing.assert_array_equal(out[1], [0, 0, 1, 0, 2])
+
+
+def test_factorize_i64_threaded_byte_identical(rng):
+    """The threaded factorizer's chunk-order merge must reproduce the
+    sequential first-appearance codes and alphabet EXACTLY, at every
+    thread count — including key sets spanning chunk boundaries."""
+    from flink_ml_tpu import native
+
+    if not native.available():
+        pytest.skip("native tier unavailable")
+    # > 2 * 65536 keys so clamp_threads really splits; repeated keys
+    # across the whole range force cross-chunk merges
+    keys = rng.integers(0, 5000, size=300_000).astype(np.int64)
+    uniq1, codes1 = native.factorize_i64(keys, n_threads=1)
+    for t in (2, 3, 4):
+        uniq_t, codes_t = native.factorize_i64(keys, n_threads=t)
+        np.testing.assert_array_equal(uniq_t, uniq1)
+        np.testing.assert_array_equal(codes_t, codes1)
+    # mostly-distinct tail: the merge path with large local alphabets
+    keys2 = np.concatenate([np.arange(200_000, dtype=np.int64),
+                            keys[:100_000]])
+    u1, c1 = native.factorize_i64(keys2, n_threads=1)
+    u4, c4 = native.factorize_i64(keys2, n_threads=4)
+    np.testing.assert_array_equal(u4, u1)
+    np.testing.assert_array_equal(c4, c1)
+
+
+def test_doc_freq_i64_threaded_byte_identical(rng):
+    """Threaded doc-freq partials must merge to the exact sequential
+    counts, and ANY thread's bounds hit must fail the whole call (the
+    guard contract is thread-count-invariant)."""
+    from flink_ml_tpu import native
+
+    if not native.available():
+        pytest.skip("native tier unavailable")
+    u = 64
+    codes = rng.integers(0, u, size=(30_000, 20)).astype(np.int64)
+    df1 = native.doc_freq_i64(codes, u, n_threads=1)
+    assert df1 is not None
+    for t in (2, 4):
+        df_t = native.doc_freq_i64(codes, u, n_threads=t)
+        np.testing.assert_array_equal(df_t, df1)
+    # out-of-range code in the LAST chunk: threaded call must reject
+    bad = codes.copy()
+    bad[-1, -1] = u + 5
+    assert native.doc_freq_i64(bad, u, n_threads=4) is None
+    bad[-1, -1] = -2
+    assert native.doc_freq_i64(bad, u, n_threads=4) is None

@@ -173,9 +173,14 @@ def test_lloyd_fit_program_with_kernel_partials(rng):
         per_shard, mesh=mesh, in_specs=(P(spec0, None), P(), P()),
         out_specs=P(), check_vma=False))
     got = np.asarray(fit_k(xs, jnp.int32(n), init))
-    want = np.asarray(km._build_lloyd_program(mesh, "euclidean", 3,
-                                              unroll=True)(
-        xs, jnp.int32(n), init))
+    # fresh donated carry for the reference program (init was consumed
+    # by nothing above — but the program donates, so pass copies)
+    c_w, cnt_w = km._build_lloyd_program(mesh, "euclidean", 3,
+                                         unroll=True)(
+        xs, jnp.int32(n), jnp.asarray(x[:k]),
+        jnp.zeros((k,), jnp.float32))
+    want = np.concatenate([np.asarray(c_w),
+                           np.asarray(cnt_w)[:, None]], axis=1)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
 
 
@@ -331,3 +336,103 @@ def test_sgd_unrolled_kernel_program_matches_xla(rng, monkeypatch):
     om._build_sgd_unrolled_program.cache_clear()
     np.testing.assert_allclose(c_kernel, c_xla, rtol=1e-5, atol=1e-7)
     np.testing.assert_allclose(l_kernel, l_xla, rtol=1e-5)
+
+
+def test_segment_reduce_sum_matches_segment_sum(rng):
+    """The fused segment-reduce kernel must equal jax.ops.segment_sum —
+    1-D and 2-D values, out-of-range ids dropped, padding inert."""
+    import jax
+    from flink_ml_tpu.ops.pallas_kernels import segment_reduce_sum
+
+    n, u = 1000, 12
+    ids = rng.integers(0, u, size=n).astype(np.int32)
+    vals = rng.normal(size=n).astype(np.float32)
+    got = np.asarray(segment_reduce_sum(vals, ids, u, interpret=True))
+    want = np.asarray(jax.ops.segment_sum(jnp.asarray(vals),
+                                          jnp.asarray(ids),
+                                          num_segments=u))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    vals2 = rng.normal(size=(n, 3)).astype(np.float32)
+    got2 = np.asarray(segment_reduce_sum(vals2, ids, u, interpret=True))
+    want2 = np.asarray(jax.ops.segment_sum(jnp.asarray(vals2),
+                                           jnp.asarray(ids),
+                                           num_segments=u))
+    np.testing.assert_allclose(got2, want2, rtol=1e-5, atol=1e-5)
+
+    # out-of-range ids contribute nothing (segment_sum drop parity)
+    ids_oob = ids.copy()
+    ids_oob[:100] = u + 3
+    got3 = np.asarray(segment_reduce_sum(vals, ids_oob, u,
+                                         interpret=True))
+    want3 = np.zeros(u, np.float32)
+    np.add.at(want3, ids[100:][ids_oob[100:] < u], 0)  # shape only
+    want3 = np.asarray(jax.ops.segment_sum(
+        jnp.asarray(vals[100:]), jnp.asarray(ids_oob[100:]),
+        num_segments=u))
+    np.testing.assert_allclose(got3, want3, rtol=1e-5, atol=1e-5)
+
+
+def test_segment_reduce_sum_empty_and_gate():
+    from flink_ml_tpu.ops.pallas_kernels import (
+        SEGREDUCE_VMEM_BUDGET_BYTES,
+        segment_reduce_fits,
+        segment_reduce_sum,
+    )
+
+    out = np.asarray(segment_reduce_sum(
+        np.zeros((0,), np.float32), np.zeros((0,), np.int32), 5,
+        interpret=True))
+    np.testing.assert_array_equal(out, np.zeros(5))
+    assert segment_reduce_fits(64, 2)
+    # a domain whose one-hot block alone overflows the budget is gated
+    assert not segment_reduce_fits(
+        SEGREDUCE_VMEM_BUDGET_BYTES, 2)
+    assert not segment_reduce_fits(0, 2)
+
+
+def test_ftrl_sparse_kernel_program_matches_xla(rng):
+    """The kernel-partialed FTRL sparse program (fused segment-reduce)
+    must match the XLA segment-sum program on the same batch."""
+    import jax
+    import scipy.sparse as sp
+
+    from flink_ml_tpu.models import online as om
+    from flink_ml_tpu.parallel.mesh import data_shard_count, default_mesh
+
+    mesh = default_mesh()
+    n, d = 128, 16
+    dense = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.3)
+    x = sp.csr_matrix(dense.astype(np.float64))
+    y = (rng.random(n) > 0.5).astype(np.float64)
+    w = np.ones(n, np.float64)
+    packed = om._pack_csr_shards(x, y, w, data_shard_count(mesh))
+    state = (jnp.zeros(d, jnp.float32), jnp.zeros(d, jnp.float32),
+             jnp.zeros(d, jnp.float32))
+
+    def run(use_kernel):
+        om._ftrl_sparse_program.cache_clear()
+        prog = om._ftrl_sparse_program(mesh, 0.1, 0.1, 0.01, 0.01,
+                                       use_kernel=use_kernel)
+        return [np.asarray(a) for a in prog(*packed, *state)]
+
+    # interpret mode rides through monkeypatching segment_reduce_sum?
+    # no — the program calls the kernel directly; on CPU the compiled
+    # kernel path is exercised via interpret fallback in the kernel
+    # tests above, so here we compare XLA vs XLA only when pallas is
+    # unsupported
+    from flink_ml_tpu.ops import pallas_kernels as pk
+
+    if not pk.pallas_supported():
+        import functools as ft
+        from unittest import mock
+
+        with mock.patch.object(
+                pk, "segment_reduce_sum",
+                ft.partial(pk.segment_reduce_sum, interpret=True)):
+            got = run(True)
+    else:
+        got = run(True)
+    want = run(False)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
