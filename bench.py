@@ -207,6 +207,10 @@ def _worker(role: str) -> int:
                         # mesh provenance: 1-device fallback vs real mesh
                         "deviceCount": best.get("deviceCount"),
                         "meshShape": best.get("meshShape"),
+                        # serving-dispatch provenance (null on plain
+                        # fits — no micro-batcher ran beside this row)
+                        "shardedDispatch": best.get("shardedDispatch"),
+                        "pipelineDepth": best.get("pipelineDepth"),
                         # replicated vs cross-replica sharded update
                         # (parallel/update_sharding.py)
                         "updateSharding": best.get("updateSharding"),
@@ -243,6 +247,11 @@ def _worker(role: str) -> int:
         # number actually measured
         "device_count": best.get("deviceCount"),
         "mesh_shape": best.get("meshShape"),
+        # serving-dispatch provenance (serving/batcher.py): whether a
+        # mesh-sharded, pipelined micro-batcher served beside this row
+        # (null on plain fit benches)
+        "sharded_dispatch": best.get("shardedDispatch"),
+        "pipeline_depth": best.get("pipelineDepth"),
         # whether the fit ran the cross-replica sharded update and the
         # per-replica update-state bytes it recorded — a throughput
         # number with 1/N optimizer memory is a different machine state

@@ -215,9 +215,30 @@ def _mesh_provenance() -> dict:
         return {"deviceCount": int(mesh.devices.size),
                 "meshShape": ",".join(f"{a}={int(mesh.shape[a])}"
                                       for a in mesh.axis_names),
-                **update_sharding.provenance()}
+                **update_sharding.provenance(),
+                **_serving_provenance()}
     except Exception:  # noqa: BLE001 — provenance only
         return {}
+
+
+def _serving_provenance() -> dict:
+    """``shardedDispatch`` + ``pipelineDepth`` of the live serving
+    runtime, read from the ``/serving`` status provider when a
+    micro-batcher is running beside this benchmark (serving/batcher.py)
+    — null on plain fit benches: a fit row honestly says it measured no
+    serving dispatch at all. Never fails a finished measurement."""
+    sharded, depth = None, None
+    try:
+        from flink_ml_tpu.observability import server
+
+        status = server.get_serving_status()
+        if status is not None:
+            live = status() if callable(status) else status
+            sharded = bool(live.get("sharded_dispatch", False))
+            depth = live.get("pipeline_depth")
+    except Exception:  # noqa: BLE001 — provenance only
+        pass
+    return {"shardedDispatch": sharded, "pipelineDepth": depth}
 
 
 def _table_bytes(table) -> int:

@@ -85,6 +85,7 @@ __all__ = [
     "observe_serving",
     "observe_serving_error",
     "observe_serving_rejected",
+    "observe_serving_shards",
     "serving_inflight",
     "summarize_values",
     "trace_sample_rate",
@@ -563,6 +564,28 @@ def summarize_values(servable: str, name: str, values) -> None:
     if frac < 1.0:
         report_divergence(servable, f"non-finite-{name}",
                           fraction=round(frac, 6), rows=int(vals.size))
+
+
+def observe_serving_shards(servable: str, counts, device_ids) -> None:
+    """Record one mesh-sharded serving dispatch's per-device row split
+    (serving/batcher.py → servable/lr.py sharded twin): the real rows
+    each device's slice of the padded bucket holds as
+    ``ml.serving shardRows{servable=,device=}`` gauges plus one
+    ``shardImbalance{servable=}`` gauge (max/mean over the per-device
+    counts; 1.0 = perfectly balanced, N = all real rows on one of N
+    devices). The per-tick serving twin of the training-side
+    ``ml.shard rows`` series — deliberately without the straggler
+    detector, since a partially-filled bucket loading shard 0 first is
+    the dispatch contract, not a straggler."""
+    group = metrics.group(ML_GROUP, "serving")
+    counts = [int(c) for c in counts]
+    for dev, rows in zip(device_ids, counts):
+        group.gauge("shardRows", rows,
+                    labels={"servable": servable, "device": str(dev)})
+    mean = sum(counts) / max(len(counts), 1)
+    imbalance = (max(counts) / mean) if mean > 0 else 0.0
+    group.gauge("shardImbalance", round(imbalance, 4),
+                labels={"servable": servable})
 
 
 def observe_serving(servable: str, rows: int, latency_ms: float,

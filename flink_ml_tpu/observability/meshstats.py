@@ -229,10 +229,14 @@ def detect_skew(kind: str, values: Sequence[float],
 
 # -- per-shard series ---------------------------------------------------------
 
-def shard_row_counts(mesh, n: int, axis_name=None) -> List[int]:
+def shard_row_counts(mesh, n: int, axis_name=None,
+                     local_n: Optional[int] = None) -> List[int]:
     """Valid (un-padded) rows each dim-0 shard holds after
     ``shard_batch``'s zero-padding — pure host math from the scalar
-    ``n``, in the mesh's row-major shard order."""
+    ``n``, in the mesh's row-major shard order. ``local_n`` overrides
+    the per-shard slice size for callers whose padded length is NOT the
+    ceil multiple — the serving micro-batcher pads to a bucket, so each
+    shard owns ``bucket / N`` rows and the real rows fill from shard 0."""
     from flink_ml_tpu.parallel.mesh import data_shard_count
 
     shards = data_shard_count(mesh) if axis_name is None else None
@@ -240,15 +244,22 @@ def shard_row_counts(mesh, n: int, axis_name=None) -> List[int]:
         axes = ((axis_name,) if isinstance(axis_name, str)
                 else tuple(axis_name))
         shards = int(np.prod([mesh.shape[a] for a in axes]))
-    local_n = -(-n // shards)  # ceil: padded rows land on the tail shards
+    if local_n is None:
+        local_n = -(-n // shards)  # ceil: padded rows land on the tail
     return [int(min(max(n - i * local_n, 0), local_n))
             for i in range(shards)]
 
 
-def record_shard_rows(mesh, n: int, axis_name=None) -> List[int]:
+def record_shard_rows(mesh, n: int, axis_name=None,
+                      local_n: Optional[int] = None,
+                      skew: bool = True) -> List[int]:
     """Per-shard row-count gauges (``ml.shard rows{shard=,device=}``) +
-    the row-imbalance skew check. Returns the per-shard counts."""
-    counts = shard_row_counts(mesh, n, axis_name)
+    the row-imbalance skew check. Returns the per-shard counts.
+    ``skew=False`` records the series without the straggler detector —
+    the serving dispatcher's partially-filled buckets are *expected* to
+    load shard 0 first, so a per-tick skew event would be noise, not a
+    straggler signal (the serving view is ``ml.serving shardRows``)."""
+    counts = shard_row_counts(mesh, n, axis_name, local_n=local_n)
     devices = list(mesh.devices.flat)
     group = _shard_group()
     for i, rows in enumerate(counts):
@@ -256,7 +267,8 @@ def record_shard_rows(mesh, n: int, axis_name=None) -> List[int]:
         group.gauge("rows", rows, labels={
             "shard": str(i),
             "device": str(int(dev.id)) if dev is not None else "?"})
-    detect_skew("rows", counts)
+    if skew:
+        detect_skew("rows", counts)
     return counts
 
 

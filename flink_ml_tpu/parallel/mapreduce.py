@@ -54,9 +54,9 @@ from flink_ml_tpu.parallel.shardmap import axis_size
 from flink_ml_tpu.parallel.shardmap import shard_map as _shard_map
 
 __all__ = [
-    "broadcast", "map_shards", "reduce_sum", "reduce_mean", "reduce_max",
-    "reduce_scatter", "all_gather", "shard_index", "shard_count",
-    "local_valid_mask", "MapReduceProgram",
+    "broadcast", "map_shards", "map_rows", "reduce_sum", "reduce_mean",
+    "reduce_max", "reduce_scatter", "all_gather", "shard_index",
+    "shard_count", "local_valid_mask", "MapReduceProgram",
 ]
 
 
@@ -145,6 +145,32 @@ def map_shards(fn, mesh, in_specs, out_specs, *, check_vma: bool = False,
 
         return instrumented_jit(mapped, name=name, **donate_kw)
     return jax.jit(mapped, **donate_kw)
+
+
+def map_rows(fn, mesh, *, n_extra: int = 0, name: Optional[str] = None,
+             donate_argnums=None):
+    """Row-parallel apply — the *serving* dispatch shape: argument 0 is
+    sharded on dim 0 over the mesh's data axes, the ``n_extra``
+    remaining arguments are replicated (model parameters), and the
+    output is row-sharded, gathered to the host only when the caller
+    fetches it.
+
+    This is how a padded serving micro-batch (serving/batcher.py)
+    spreads over the mesh: each device predicts its contiguous
+    ``rows / N`` slice of the batch, no collective on the hot path at
+    all — the gather happens on the fetch side of the dispatch. The
+    caller guarantees dim 0 divides the data-shard count (the bucket
+    table makes that a static property; non-divisible buckets stay on
+    the single-device path). Embarrassingly row-parallel ``fn`` bodies
+    need no primitives; a body that does reduce across rows would need
+    the in-axis primitives above and should use :func:`map_shards`
+    with explicit specs instead."""
+    from jax.sharding import PartitionSpec as P
+
+    spec0 = data_pspec(mesh)
+    in_specs = (P(spec0),) + (P(),) * int(n_extra)
+    return map_shards(fn, mesh, in_specs, P(spec0), name=name,
+                      donate_argnums=donate_argnums)
 
 
 class MapReduceProgram:

@@ -25,6 +25,7 @@ from flink_ml_tpu.servable.api import (
     DataFrame,
     DataTypes,
     ModelServable,
+    serving_name,
 )
 from flink_ml_tpu.utils import io as rw
 
@@ -49,6 +50,45 @@ class LogisticRegressionModelData:
 
 _PREDICT_JIT = None
 _PREDICT_LOCK = threading.Lock()
+
+#: one row-sharded predict twin per mesh (keyed by device ids + axes):
+#: the executable is shared across model versions — a hot-swap only
+#: re-places the coefficient vector, never recompiles — and across
+#: buckets, with one compile-cache entry per (bucket, dim) signature
+#: that serving/warmup.py pre-pays
+_SHARDED_JITS: dict = {}
+
+
+def _mesh_cache_key(mesh):
+    return (tuple(int(d.id) for d in mesh.devices.flat),
+            tuple(mesh.axis_names), mesh.devices.shape)
+
+
+def _sharded_predict_jit(mesh):
+    """The mesh-sharded twin of :func:`_predict_jit`: the same
+    ``dots = x @ coef`` kernel built through
+    :func:`~flink_ml_tpu.parallel.mapreduce.map_rows` — rows split over
+    the mesh's data axes, the coefficient replicated, each device
+    predicting its contiguous slice of the padded serving bucket with
+    no collective on the hot path (results gather on the fetch side).
+    Named ``lr.predict.sharded`` so its compiles are counted apart from
+    the single-device kernel's — the warmup matrix (serving/warmup.py)
+    and the steady-state zero-compile probe see both."""
+    key = _mesh_cache_key(mesh)
+    fn = _SHARDED_JITS.get(key)
+    if fn is None:
+        with _PREDICT_LOCK:
+            fn = _SHARDED_JITS.get(key)
+            if fn is None:
+                from flink_ml_tpu.parallel import mapreduce as mr
+
+                def _lr_dots(x, coef):
+                    return x @ coef
+
+                fn = mr.map_rows(_lr_dots, mesh, n_extra=1,
+                                 name="lr.predict.sharded")
+                _SHARDED_JITS[key] = fn
+    return fn
 
 
 def _predict_jit():
@@ -88,18 +128,48 @@ class LogisticRegressionModelServable(ModelServable, HasFeaturesCol,
         super().__init__(**kwargs)
         self.model_data: LogisticRegressionModelData = None
         self._coef_dev = None
+        self._mesh = None
+        self._coef_mesh = None
+        self._n_shards = 1
 
     def set_model_data(self, *streams) -> "LogisticRegressionModelServable":
         (stream,) = streams
         data = stream.read() if hasattr(stream, "read") else bytes(stream)
         self.model_data = LogisticRegressionModelData.decode(data)
         self._coef_dev = None
+        self._coef_mesh = None
         return self
 
     def set_device_predict(self, enabled: bool = True
                            ) -> "LogisticRegressionModelServable":
         self.device_predict = bool(enabled)
         return self
+
+    def set_mesh(self, mesh) -> "LogisticRegressionModelServable":
+        """Mesh-sharded dispatch (docs/serving.md "Mesh-sharded
+        dispatch"): batches whose row count divides the mesh's
+        data-shard count predict through the row-sharded twin — each
+        device scores its slice of the padded serving bucket — while
+        non-divisible shapes (bucket 1 on an 8-way mesh) keep the
+        single-device kernel. ``None`` reverts to single-device.
+        Idempotent on the same mesh object, so the dispatcher can
+        re-assert it per tick without churning the coefficient
+        placement."""
+        if mesh is self._mesh:
+            return self
+        self._mesh = mesh
+        self._coef_mesh = None
+        if mesh is None:
+            self._n_shards = 1
+        else:
+            from flink_ml_tpu.parallel.mesh import data_shard_count
+
+            self._n_shards = data_shard_count(mesh)
+        return self
+
+    def _use_sharded(self, rows: int) -> bool:
+        return (self._mesh is not None and self._n_shards > 1
+                and rows % self._n_shards == 0)
 
     def _device_coef(self):
         # one H2D per model version, not one per request
@@ -110,18 +180,75 @@ class LogisticRegressionModelServable(ModelServable, HasFeaturesCol,
                                          jnp.float32)
         return self._coef_dev
 
+    def _mesh_coef(self):
+        # the sharded twin's parameter placement: the coefficient
+        # replicated on every mesh device, once per (version, mesh)
+        if self._coef_mesh is None:
+            from flink_ml_tpu.parallel import collective
+
+            self._coef_mesh = collective.replicate(
+                self._mesh,
+                np.asarray(self.model_data.coefficient, np.float32))
+        return self._coef_mesh
+
+    def _sharded_dots(self, x, real_rows: int, record: bool = True):
+        """One mesh dispatch: place the padded batch row-sharded (each
+        device receives exactly its slice — ONE transfer leg per
+        device, no broadcast-then-slice), predict per device, gather on
+        fetch. The padded input buffer is consumed by the dispatch:
+        deleted as soon as the results are fetched, so the pipelined
+        dispatcher (serving/batcher.py) holds at most ``depth + 1``
+        live input buffers."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from flink_ml_tpu.observability import health, meshstats
+        from flink_ml_tpu.parallel.mesh import data_pspec
+
+        mesh = self._mesh
+        sharding = NamedSharding(mesh, P(data_pspec(mesh)))
+        x_dev = jax.device_put(x, sharding)
+        try:
+            dots = np.asarray(
+                _sharded_predict_jit(mesh)(x_dev, self._mesh_coef()),
+                np.float64)
+        finally:
+            x_dev.delete()
+        if record:
+            per_shard = x.shape[0] // self._n_shards
+            counts = meshstats.record_shard_rows(
+                mesh, real_rows, local_n=per_shard, skew=False)
+            health.observe_serving_shards(
+                serving_name(self), counts,
+                [int(d.id) for d in mesh.devices.flat])
+        return dots
+
     def aot_warm(self, rows: int) -> None:
         """Compile the device predict kernel for a ``(rows, dim)`` batch
         now (serving/warmup.py calls this once per bucket shape at
         server start, so the first real request is a compile-cache
-        hit). No-op without model data or with host predict."""
+        hit) — the SAME kernel ``transform`` will route this shape to:
+        the mesh-sharded twin when a mesh is set and ``rows`` divides
+        its shard count, the single-device kernel otherwise. No-op
+        without model data or with host predict."""
         if not self.device_predict or self.model_data is None:
             return
         import jax.numpy as jnp
 
         dim = self.model_data.coefficient.shape[0]
-        _predict_jit()(jnp.zeros((int(rows), dim), jnp.float32),
-                       self._device_coef())
+        if self._use_sharded(int(rows)):
+            # warm with the SAME committed row-sharded placement the
+            # dispatcher uses — an uncommitted zeros array would compile
+            # a second executable for the differently-placed input and
+            # the first real request would pay a steady-state compile.
+            # record=False: a synthetic warm batch must not write the
+            # shardRows/ml.shard series real traffic is gated on
+            self._sharded_dots(
+                np.zeros((int(rows), dim), np.float32), int(rows),
+                record=False)
+        else:
+            _predict_jit()(jnp.zeros((int(rows), dim), jnp.float32),
+                           self._device_coef())
 
     def transform(self, df: DataFrame) -> DataFrame:
         if self.model_data is None:
@@ -130,11 +257,17 @@ class LogisticRegressionModelServable(ModelServable, HasFeaturesCol,
         x = np.stack([f.to_array() if isinstance(f, Vector)
                       else np.asarray(f, np.float64) for f in features])
         if self.device_predict:
-            import jax.numpy as jnp
+            xf = np.asarray(x, np.float32)
+            if self._use_sharded(xf.shape[0]):
+                real = getattr(df, "drift_real_rows", None)
+                dots = self._sharded_dots(
+                    xf, int(real) if real is not None else xf.shape[0])
+            else:
+                import jax.numpy as jnp
 
-            dots = np.asarray(
-                _predict_jit()(jnp.asarray(x, jnp.float32),
-                               self._device_coef()), np.float64)
+                dots = np.asarray(
+                    _predict_jit()(jnp.asarray(xf), self._device_coef()),
+                    np.float64)
         else:
             dots = x @ self.model_data.coefficient
         prob = 1.0 - 1.0 / (1.0 + np.exp(dots))

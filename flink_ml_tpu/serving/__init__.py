@@ -5,9 +5,11 @@ The servable tier (flink_ml_tpu/servable/) answers ONE caller's
 
 - :mod:`batcher` — async micro-batching: admission-controlled queueing
   with deadlines, padding/bucketing to a fixed batch-shape table (so
-  steady-state serving never recompiles), one device dispatch per tick;
-- :mod:`warmup` — AOT-compile every bucket shape at start and gate
-  ``/healthz`` readiness on completion;
+  steady-state serving never recompiles), one device dispatch per tick
+  — pipelined (a pad stage overlapping a device stage) and, given a
+  mesh, sharded over its devices per tick;
+- :mod:`warmup` — AOT-compile every bucket shape (x the dispatch mesh)
+  at start and gate ``/healthz`` readiness on completion;
 - :mod:`registry` — versioned model hot-swap from checkpointed model
   data: manifest-validated, health-probed, atomic, rolled back on any
   failure — the online-learning (FTRL) → serving handoff;
@@ -24,6 +26,7 @@ from flink_ml_tpu.serving.batcher import (  # noqa: F401
     BUCKETS_ENV,
     DEADLINE_ENV,
     DEFAULT_BUCKET_ROWS,
+    PIPELINE_ENV,
     QUEUE_ENV,
     WINDOW_ENV,
     BatcherConfig,
@@ -48,6 +51,7 @@ __all__ = [
     "BUCKETS_ENV",
     "DEADLINE_ENV",
     "DEFAULT_BUCKET_ROWS",
+    "PIPELINE_ENV",
     "QUEUE_ENV",
     "WINDOW_ENV",
     "BatcherConfig",

@@ -1,5 +1,5 @@
 """Async micro-batching dispatcher: many callers, one device dispatch
-per tick.
+per tick — pipelined, and mesh-sharded when given a mesh.
 
 The synchronous servable path (servable/api.py) is one caller, one
 ``transform``, one dispatch — fine for a notebook, hopeless for traffic.
@@ -9,33 +9,46 @@ This module puts a queue in front of any
 - **submit** enqueues a request (a DataFrame) with a deadline and
   returns a future; admission control rejects immediately
   (:class:`~flink_ml_tpu.servable.api.RejectedRequest`) when the queue
-  is full or the request cannot fit any batch bucket — shed load, never
-  unbounded latency;
-- a **dispatcher tick** drains whole requests once the oldest has
+  (including rows already drained into the pipeline) is full or the
+  request cannot fit any batch bucket — shed load, never unbounded
+  latency;
+- the **pad/enqueue stage** drains whole requests once the oldest has
   waited ``window_ms`` or the largest bucket fills, drops requests whose
   deadline expired in queue, **pads** the concatenated rows up to the
   smallest bucket that fits (``buckets``, a small fixed table of batch
-  shapes) and issues ONE ``transform`` on the batch — so steady-state
-  serving presents XLA with a closed set of batch shapes and never
-  recompiles (the contract serving/warmup.py pre-compiles and
-  tests assert via ``ml.compile`` counters);
-- results split back per request, futures resolve, and in-flight
-  requests pin the servable they were dispatched with — a model
-  hot-swap (serving/registry.py) between ticks never yanks a batch
-  mid-flight.
+  shapes; pad rows come from a per-(schema, bucket) template cache —
+  the ``paddingReuse`` counter) — so steady-state serving presents XLA
+  with a closed set of batch shapes and never recompiles (the contract
+  serving/warmup.py pre-compiles and tests assert via ``ml.compile``
+  counters);
+- the **device stage** takes prepared batches over a
+  depth-``pipeline_depth`` handoff (default 1 — host padding of tick
+  N+1 overlaps device compute of tick N), resolves the servable ONCE
+  per tick, re-checks deadlines, asserts the dispatch ``mesh`` on the
+  servable (buckets the mesh's shard count divides predict row-sharded
+  over the devices — servable/lr.py, docs/serving.md "Mesh-sharded
+  dispatch") and issues ONE ``transform`` on the batch;
+- results split back per request, futures resolve from the fetch side,
+  and in-flight requests pin the servable they were dispatched with — a
+  model hot-swap (serving/registry.py) between device ticks never yanks
+  a batch mid-flight.
 
 Telemetry rides the PR 7 live endpoint: ``queueDepth`` /
 ``batchFill`` / ``paddingWaste`` gauges, per-request ``queueMs`` /
 ``batchMs`` windowed histograms and fill/waste distributions in
-``ml.serving``, a ``serving.batch`` span per tick, and a ``/serving``
-route (observability/server.py) exposing queue depth, the bucket table
-and the active model version. See docs/serving.md.
+``ml.serving``, per-device ``shardRows`` / ``shardImbalance`` gauges on
+sharded ticks, ``serving.pad`` + ``serving.batch`` spans per tick
+(sharing a ``tick`` attr — overlapping spans ARE the pipelining proof),
+and a ``/serving`` route (observability/server.py) exposing queue
+depth, the bucket table, pipeline depth, mesh and the active model
+version. See docs/serving.md.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import queue
 import threading
 import time
 from concurrent.futures import Future
@@ -70,6 +83,7 @@ BUCKETS_ENV = "FLINK_ML_TPU_SERVE_BUCKETS"
 WINDOW_ENV = "FLINK_ML_TPU_SERVE_WINDOW_MS"
 DEADLINE_ENV = "FLINK_ML_TPU_SERVE_DEADLINE_MS"
 QUEUE_ENV = "FLINK_ML_TPU_SERVE_MAX_QUEUE_ROWS"
+PIPELINE_ENV = "FLINK_ML_TPU_SERVE_PIPELINE_DEPTH"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,6 +109,12 @@ class BatcherConfig:
     #: cap on rows drained per tick without bucketing (with bucketing
     #: the largest bucket is the cap)
     max_batch_rows: int = 1024
+    #: dispatcher pipelining: depth of the pad→device handoff queue.
+    #: 0 runs the single-thread dispatcher (pad and dispatch serialized
+    #: on one loop — the pre-pipeline behavior); the default 1 lets the
+    #: pad stage prepare tick N+1 while the device stage computes
+    #: tick N, overlapping host padding with device compute
+    pipeline_depth: int = 1
 
     def __post_init__(self):
         if self.buckets is not None:
@@ -108,6 +128,8 @@ class BatcherConfig:
             raise ValueError("window_ms must be >= 0")
         if self.max_queue_rows <= 0 or self.max_batch_rows <= 0:
             raise ValueError("queue/batch row bounds must be > 0")
+        if self.pipeline_depth < 0:
+            raise ValueError("pipeline_depth must be >= 0")
 
     @classmethod
     def from_env(cls, **overrides) -> "BatcherConfig":
@@ -141,6 +163,7 @@ class BatcherConfig:
         read(WINDOW_ENV, float, "window_ms")
         read(DEADLINE_ENV, parse_optional_ms, "deadline_ms")
         read(QUEUE_ENV, int, "max_queue_rows")
+        read(PIPELINE_ENV, int, "pipeline_depth")
         return cls(**overrides)
 
     @property
@@ -158,31 +181,89 @@ class BatcherConfig:
         return rows  # caller enforces rows <= max_bucket at admission
 
 
+def _row_signature(row) -> tuple:
+    """Per-value shape fingerprint of one row — the pad-template cache
+    key component the declared schema cannot provide (a ``vector``
+    DataType is dimension-less): type name plus element count where one
+    is discoverable."""
+    sig = []
+    for v in row.values:
+        size = None
+        try:
+            if hasattr(v, "size"):
+                size = int(v.size() if callable(v.size) else v.size)
+            elif hasattr(v, "__len__"):
+                size = len(v)
+        except Exception:  # noqa: BLE001 — a fingerprint, not a parser
+            size = None
+        sig.append((type(v).__name__, size))
+    return tuple(sig)
+
+
 class _Request:
-    __slots__ = ("df", "rows", "n", "future", "t_enqueue", "deadline_s")
+    __slots__ = ("df", "rows", "n", "schema", "future", "t_enqueue",
+                 "deadline_s")
 
     def __init__(self, df: DataFrame, deadline_ms: Optional[float]):
         self.df = df
         self.rows = df.collect()
         self.n = len(self.rows)
+        # cached once at submit: the per-tick schema comparison is a
+        # tuple identity check instead of a fresh column_names list
+        # copy per request per tick
+        self.schema = tuple(df.column_names)
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
         self.deadline_s = (None if deadline_ms is None
                            else self.t_enqueue + deadline_ms / 1000.0)
 
 
+class _Prepared:
+    """One padded batch, handed from the pad stage to the device stage.
+    Everything the device dispatch needs travels here so the device
+    thread never touches the admission queue."""
+
+    __slots__ = ("requests", "batch_df", "bucket", "n_real", "pad",
+                 "fill", "waste", "tick", "reused", "total_rows")
+
+    def __init__(self, requests, batch_df, bucket, n_real, pad, fill,
+                 waste, tick, reused):
+        self.requests = requests
+        self.batch_df = batch_df
+        self.bucket = bucket
+        self.n_real = n_real
+        self.pad = pad
+        self.fill = fill
+        self.waste = waste
+        self.tick = tick
+        self.reused = reused
+        self.total_rows = 0  # drained-row accounting, set by the pad stage
+
+
 class MicroBatcher:
-    """The dispatcher: one daemon thread draining an admission-controlled
-    queue into padded, bucketed, single-dispatch batches.
+    """The dispatcher: a pad/enqueue stage draining an
+    admission-controlled queue into padded, bucketed batches, and a
+    device stage issuing one dispatch per batch — connected by a
+    depth-``pipeline_depth`` handoff so host padding of tick N+1
+    overlaps device compute of tick N (``pipeline_depth=0`` collapses
+    both stages onto one thread, the pre-pipeline behavior).
 
     ``target`` is the servable itself, a zero-arg provider callable, or
     anything with an ``active`` attribute (a
     :class:`~flink_ml_tpu.serving.registry.ModelRegistry`) — resolved
-    ONCE per tick, so a hot-swap lands between batches, never inside
-    one."""
+    ONCE per device tick, so a hot-swap lands between batches, never
+    inside one.
 
-    def __init__(self, target, config: Optional[BatcherConfig] = None):
+    ``mesh`` (optional) arms mesh-sharded dispatch: it is asserted on
+    the resolved servable each device tick (``set_mesh``, idempotent),
+    so buckets divisible by the mesh's data-shard count predict with
+    the micro-batch row-sharded over the devices
+    (docs/serving.md "Mesh-sharded dispatch")."""
+
+    def __init__(self, target, config: Optional[BatcherConfig] = None,
+                 mesh=None):
         self.config = config or BatcherConfig()
+        self._mesh = mesh
         if isinstance(target, TransformerServable):
             self._provider = lambda: target
         elif hasattr(target, "active"):
@@ -197,12 +278,23 @@ class MicroBatcher:
         # drain O(1) per request while it holds the condition lock
         self._queue = collections.deque()
         self._queued_rows = 0
+        # rows drained by the pad stage but not yet resolved by the
+        # device stage: admission counts them, or the pipeline would
+        # quietly extend max_queue_rows by a tick per handoff slot
+        self._inflight_rows = 0
         self._cond = threading.Condition()
         self._stopping = False
         self._thread: Optional[threading.Thread] = None
+        self._device_thread: Optional[threading.Thread] = None
+        self._handoff: Optional[queue.Queue] = None
         self._ticks = 0
+        self._tick_seq = 0
         self._served_requests = 0
         self._prev_status = None
+        # pad-template cache, keyed by (schema, type key, bucket): the
+        # duplicated-row values each tick's padding appends, extracted
+        # once instead of re-copied from the tail request every tick
+        self._pad_templates: dict = {}
         self._group = metrics.group(ML_GROUP, "serving")
 
     # -- lifecycle -----------------------------------------------------------
@@ -210,6 +302,13 @@ class MicroBatcher:
         if self._thread is not None:
             return self
         self._stopping = False
+        if self.config.pipeline_depth > 0:
+            self._handoff = queue.Queue(
+                maxsize=self.config.pipeline_depth)
+            self._device_thread = threading.Thread(
+                target=self._run_device,
+                name="flink-ml-tpu-batcher-dev", daemon=True)
+            self._device_thread.start()
         self._thread = threading.Thread(target=self._run,
                                         name="flink-ml-tpu-batcher",
                                         daemon=True)
@@ -239,6 +338,13 @@ class MicroBatcher:
             self._cond.notify_all()
         thread.join(timeout=30.0)
         self._thread = None
+        # the pad stage put its sentinel on exit; wait for the device
+        # stage to finish whatever was already in the handoff (a
+        # prepared batch is in flight — it completes, never rejects)
+        if self._device_thread is not None:
+            self._device_thread.join(timeout=30.0)
+            self._device_thread = None
+            self._handoff = None
         from flink_ml_tpu.observability import server
 
         # only clear OUR registration (a later-started batcher may have
@@ -276,7 +382,8 @@ class MicroBatcher:
             if cfg.buckets is not None and req.n > cfg.max_bucket:
                 self._reject(req, "too-large")
                 return req.future
-            if self._queued_rows + req.n > cfg.max_queue_rows:
+            if (self._queued_rows + self._inflight_rows + req.n
+                    > cfg.max_queue_rows):
                 self._reject(req, "queue-full")
                 return req.future
             self._queue.append(req)
@@ -300,61 +407,193 @@ class MicroBatcher:
         return (serving_name(servable) if servable is not None
                 else "unbound")
 
-    # -- dispatch loop -------------------------------------------------------
+    # -- pad/enqueue stage ---------------------------------------------------
     def _run(self) -> None:
         cfg = self.config
         window_s = cfg.window_ms / 1000.0
-        while True:
-            batch: List[_Request] = []
-            with self._cond:
-                while not self._queue and not self._stopping:
-                    self._cond.wait()
-                if not self._queue and self._stopping:
-                    return
-                # fill-or-window: dispatch early only when the LARGEST
-                # bucket's worth of rows is queued (any smaller fill
-                # threshold would defeat batching — one row "fills"
-                # bucket 1), else when the oldest request's window
-                # lapses; window_ms is therefore the latency bound a
-                # partially-filled batch pays
-                while (self._queue
-                       and self._queued_rows < cfg.max_bucket
-                       and not self._stopping):
-                    remaining = (self._queue[0].t_enqueue + window_s
-                                 - time.perf_counter())
-                    if remaining <= 0:
-                        break
-                    self._cond.wait(timeout=remaining)
-                if not self._queue:
-                    continue
-                total = 0
-                while (self._queue
-                       and total + self._queue[0].n <= cfg.max_bucket):
-                    req = self._queue.popleft()
-                    total += req.n
-                    batch.append(req)
+        try:
+            while True:
+                batch: List[_Request] = []
+                with self._cond:
+                    while not self._queue and not self._stopping:
+                        self._cond.wait()
+                    if not self._queue and self._stopping:
+                        return
+                    # fill-or-window: dispatch early only when the
+                    # LARGEST bucket's worth of rows is queued (any
+                    # smaller fill threshold would defeat batching —
+                    # one row "fills" bucket 1), else when the oldest
+                    # request's window lapses; window_ms is therefore
+                    # the latency bound a partially-filled batch pays
+                    while (self._queue
+                           and self._queued_rows < cfg.max_bucket
+                           and not self._stopping):
+                        remaining = (self._queue[0].t_enqueue + window_s
+                                     - time.perf_counter())
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(timeout=remaining)
+                    if not self._queue:
+                        continue
+                    total = 0
+                    while (self._queue
+                           and total + self._queue[0].n
+                           <= cfg.max_bucket):
+                        req = self._queue.popleft()
+                        total += req.n
+                        batch.append(req)
+                    if not batch:
+                        # head request alone exceeds the cap (unbucketed
+                        # mode — bucketed admission already rejected it)
+                        req = self._queue.popleft()
+                        total = req.n
+                        self._reject(req, "too-large")
+                    else:
+                        self._inflight_rows += total
+                    self._queued_rows -= total
+                    self._group.gauge("queueDepth", self._queued_rows)
                 if not batch:
-                    # head request alone exceeds the cap (unbucketed
-                    # mode — bucketed admission already rejected it)
-                    req = self._queue.popleft()
-                    total = req.n
-                    self._reject(req, "too-large")
-                self._queued_rows -= total
-                self._group.gauge("queueDepth", self._queued_rows)
-            if batch:
+                    continue
+                tick = self._tick_seq
+                self._tick_seq += 1
                 try:
-                    self._dispatch(batch)
-                except Exception as e:  # noqa: BLE001 — a dispatch bug
+                    prepared = self._prepare(batch, tick)
+                except Exception as e:  # noqa: BLE001 — a pad-stage bug
                     # must fail ITS batch, never kill the loop
                     for req in batch:
                         if not req.future.done():
                             req.future.set_exception(e)
+                    self._release_inflight(total)
+                    continue
+                if prepared is None:
+                    self._release_inflight(total)
+                    continue
+                prepared.total_rows = total
+                if self._handoff is not None:
+                    # depth-bounded, blocking: while the device stage
+                    # computes tick N, at most ``pipeline_depth``
+                    # prepared ticks wait here — backpressure, not an
+                    # unbounded prepared-batch backlog
+                    self._handoff.put(prepared)
+                else:
+                    self._dispatch_guarded(prepared)
+        finally:
+            if self._handoff is not None:
+                self._handoff.put(None)  # sentinel: pad stage is done
 
-    def _dispatch(self, batch: List[_Request]) -> None:
+    def _prepare(self, batch: List[_Request],
+                 tick: int) -> Optional[_Prepared]:
+        """Pad stage: deadline/schema vetting + bucket padding — all
+        host work, no device touch, so it overlaps the device stage's
+        compute of the previous tick. Rejections resolve immediately
+        from here; accepted requests travel in the returned
+        :class:`_Prepared`."""
         cfg = self.config
         now = time.perf_counter()
         live: List[_Request] = []
         for req in batch:
+            if req.deadline_s is not None and now > req.deadline_s:
+                self._reject(req, "deadline")
+            else:
+                live.append(req)
+        if not live:
+            return None
+        schema = live[0].schema
+        rows: List = []
+        kept: List[_Request] = []
+        for req in live:
+            if req.schema != schema:
+                self._reject(req, "schema")
+                continue
+            kept.append(req)
+            rows.extend(req.rows)
+        if not kept:
+            return None
+        n_real = len(rows)
+        bucket = cfg.bucket_for(n_real)
+        # pad by duplicating a row: same shapes, discarded output. An
+        # exact bucket fit (and every unbucketed tick, where the
+        # "bucket" IS the drained row count) pads nothing — pinned by
+        # the tick-drain boundary tests.
+        pad = bucket - n_real
+        reused = 0
+        with tracing.tracer.span("serving.pad", tick=tick,
+                                 bucket=bucket, rows=n_real,
+                                 requests=len(kept), pad=pad):
+            if pad:
+                types = kept[0].df.data_types
+                # the value-shape signature rides the key: the declared
+                # DataType carries no dimension ("vector" is dim-less),
+                # so a hot-swap changing the feature dim must MISS —
+                # a stale different-dim template would fail every
+                # padded tick after the swap
+                key = (schema,
+                       tuple((t.basic, t.shape) for t in types),
+                       _row_signature(rows[-1]), bucket)
+                template = self._pad_templates.get(key)
+                if template is None:
+                    if len(self._pad_templates) >= 32:
+                        self._pad_templates.clear()
+                    template = (type(rows[-1]), list(rows[-1].values))
+                    self._pad_templates[key] = template
+                else:
+                    reused = pad
+                row_cls, values = template
+                for _ in range(pad):
+                    rows.append(row_cls(list(values)))
+            else:
+                types = kept[0].df.data_types
+            batch_df = DataFrame(list(schema), list(types), rows)
+        # drift seam (observability/drift.py): pad rows are DUPLICATES
+        # appended at the tail — sketching them would overweight one
+        # row and inflate the sample floor with dependent copies; the
+        # _served wrapper slices features/predictions to this count
+        batch_df.drift_real_rows = n_real
+        fill = n_real / bucket if bucket else 1.0
+        waste = pad / bucket if bucket else 0.0
+        return _Prepared(kept, batch_df, bucket, n_real, pad, fill,
+                         waste, tick, reused)
+
+    def _release_inflight(self, rows: int) -> None:
+        # called the moment the device stage takes a batch over: rows
+        # actively dispatching stop counting against max_queue_rows
+        # (matching the single-thread dispatcher, where drained rows
+        # left the admission window at drain) — only rows queued,
+        # padding, or waiting in the handoff occupy it
+        with self._cond:
+            self._inflight_rows = max(0, self._inflight_rows - rows)
+
+    def _dispatch_guarded(self, prepared: _Prepared) -> None:
+        """One device tick, from either stage layout: release the
+        admission window (the batch is actively dispatching now) and
+        run the dispatch — a dispatch bug fails ITS batch's futures,
+        never the loop that called it."""
+        self._release_inflight(prepared.total_rows)
+        try:
+            self._dispatch_device(prepared)
+        except Exception as e:  # noqa: BLE001 — see docstring
+            for req in prepared.requests:
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    # -- device stage --------------------------------------------------------
+    def _run_device(self) -> None:
+        while True:
+            prepared = self._handoff.get()
+            if prepared is None:
+                return
+            self._dispatch_guarded(prepared)
+
+    def _dispatch_device(self, prep: _Prepared) -> None:
+        kept = prep.requests
+        now = time.perf_counter()
+        # deadlines re-checked HERE, not just at pad time: a request
+        # whose deadline lapsed while its tick waited in the pipeline
+        # handoff was never dispatched in time — the accounting stays
+        # honest even though its rows ride the padded batch (the
+        # shapes are fixed; only its result assignment is skipped)
+        live: List[_Request] = []
+        for req in kept:
             if req.deadline_s is not None and now > req.deadline_s:
                 self._reject(req, "deadline")
             else:
@@ -366,69 +605,63 @@ class MicroBatcher:
             for req in live:
                 self._reject(req, "no-model")
             return
+        if self._mesh is not None and hasattr(servable, "set_mesh"):
+            # idempotent per tick: a hot-swapped candidate gets the
+            # mesh before its first sharded batch, the steady state
+            # pays one identity check
+            servable.set_mesh(self._mesh)
         name = serving_name(servable)
         labels = {"servable": name}
-        rows: List = []
-        schema = live[0].df.column_names
-        kept: List[_Request] = []
         for req in live:
-            if req.df.column_names != schema:
-                self._reject(req, "schema")
-                continue
-            kept.append(req)
-            rows.extend(req.rows)
-        if not kept:
-            return
-        n_real = len(rows)
-        bucket = cfg.bucket_for(n_real)
-        # pad by duplicating the last row: same shapes, discarded output
-        pad = bucket - n_real
-        for _ in range(pad):
-            rows.append(type(rows[-1])(list(rows[-1].values)))
-        batch_df = DataFrame(list(schema),
-                             list(kept[0].df.data_types), rows)
-        # drift seam (observability/drift.py): pad rows are DUPLICATES
-        # appended at the tail — sketching them would overweight one
-        # row and inflate the sample floor with dependent copies; the
-        # _served wrapper slices features/predictions to this count
-        batch_df.drift_real_rows = n_real
-        fill = n_real / bucket if bucket else 1.0
-        waste = pad / bucket if bucket else 0.0
-        for req in kept:
+            # queue time runs to DEVICE dispatch, not to pad time —
+            # a tick waiting in the pipeline handoff is still queueing
             self._group.windowed_histogram(
                 "queueMs", horizon_s=SERVING_HORIZON_S,
                 slices=SERVING_SLICES, labels=labels).observe(
                     (now - req.t_enqueue) * 1000.0)
         t0 = time.perf_counter()
         with tracing.tracer.span("serving.batch", servable=name,
-                                 bucket=bucket, rows=n_real,
-                                 requests=len(kept)):
+                                 bucket=prep.bucket, rows=prep.n_real,
+                                 requests=len(kept), tick=prep.tick,
+                                 pipeline_depth=self.config
+                                 .pipeline_depth):
             try:
-                out = servable.transform(batch_df)
+                out = servable.transform(prep.batch_df)
             except Exception as e:  # noqa: BLE001 — the batch fails,
                 # per-request; the _served seam already counted it once
-                for req in kept:
-                    req.future.set_exception(e)
+                for req in live:
+                    if not req.future.done():
+                        req.future.set_exception(e)
                 return
         batch_ms = (time.perf_counter() - t0) * 1000.0
-        self._record_tick(labels, bucket, n_real, pad, fill, waste,
-                          batch_ms, len(kept))
+        self._record_tick(labels, prep.bucket, prep.n_real, prep.pad,
+                          prep.fill, prep.waste, batch_ms, len(live),
+                          prep.reused)
+        # futures resolve from the fetch side: the results are on host
+        # before any caller's latency clock stops. Offsets walk ALL of
+        # the tick's requests — a deadline-rejected one still occupies
+        # its row slice of the padded batch
         out_rows = out.collect()
         names, types = out.column_names, out.data_types
         offset = 0
         for req in kept:
-            req.future.set_result(DataFrame(
-                names, types, out_rows[offset:offset + req.n]))
+            if not req.future.done():
+                req.future.set_result(DataFrame(
+                    names, types, out_rows[offset:offset + req.n]))
             offset += req.n
 
     def _record_tick(self, labels, bucket, n_real, pad, fill, waste,
-                     batch_ms, n_requests) -> None:
+                     batch_ms, n_requests, reused: int = 0) -> None:
         grp = self._group
         self._ticks += 1
         self._served_requests += n_requests
         grp.counter("batches", labels={**labels, "bucket": str(bucket)})
         if pad:
             grp.counter("padRows", pad, labels=labels)
+        if reused:
+            # pad rows built from the cached per-(schema, bucket)
+            # template instead of re-extracting the tail request's row
+            grp.counter("paddingReuse", reused, labels=labels)
         grp.gauge("batchFill", round(fill, 4), labels=labels)
         grp.gauge("paddingWaste", round(waste, 4), labels=labels)
         grp.histogram("batchFillFrac", buckets=RATIO_BUCKETS,
@@ -447,10 +680,12 @@ class MicroBatcher:
         with self._cond:
             depth_rows = self._queued_rows
             depth_requests = len(self._queue)
+            inflight = self._inflight_rows
         cfg = self.config
         return {
             "servable": self._label(),
             "queue": {"rows": depth_rows, "requests": depth_requests,
+                      "pipeline_rows": inflight,
                       "max_rows": cfg.max_queue_rows},
             "buckets": (list(cfg.buckets) if cfg.buckets is not None
                         else None),
@@ -459,4 +694,26 @@ class MicroBatcher:
             "ticks": self._ticks,
             "served_requests": self._served_requests,
             "running": self._thread is not None,
+            "pipeline_depth": cfg.pipeline_depth,
+            "mesh_devices": self.mesh_device_count(),
+            "sharded_dispatch": self.sharded_dispatch(),
         }
+
+    def mesh_device_count(self) -> int:
+        """Devices of the dispatch mesh (1 without one) — provenance
+        for the ``/serving`` route and BENCH_serving.json rows."""
+        return (int(self._mesh.devices.size)
+                if self._mesh is not None else 1)
+
+    def sharded_dispatch(self) -> bool:
+        """True when ticks can shard — the DATA-shard count decides,
+        exactly as the servable's routing does (on a (data, model)
+        mesh the device count alone would misreport)."""
+        if self._mesh is None:
+            return False
+        try:
+            from flink_ml_tpu.parallel.mesh import data_shard_count
+
+            return data_shard_count(self._mesh) > 1
+        except Exception:  # noqa: BLE001 — status must never raise
+            return self.mesh_device_count() > 1

@@ -92,19 +92,29 @@ class ModelRegistry:
     DataFrame) gates every candidate behind one real transform plus the
     prediction-distribution finite check. ``health_check`` (optional,
     ``servable -> bool``) adds a custom gate — return falsy or raise to
-    reject."""
+    reject. ``mesh`` (optional) is asserted on every candidate before
+    its probe, so a mesh-sharded dispatcher's candidates are probed
+    through the same sharded executable they will serve with
+    (docs/serving.md "Mesh-sharded dispatch")."""
 
     def __init__(self, watch_dir: str,
                  loader: Callable[[List[np.ndarray], int], object],
                  model: str = "model",
                  probe: Optional[Callable[[], object]] = None,
                  health_check: Optional[Callable[[object], bool]] = None,
-                 poll_interval_s: float = 1.0):
+                 poll_interval_s: float = 1.0,
+                 mesh=None):
         self.watch_dir = watch_dir
         self.model = model
         self._loader = loader
         self._probe = probe
         self._health_check = health_check
+        #: dispatch mesh asserted on every candidate BEFORE its probe
+        #: (docs/serving.md "Mesh-sharded dispatch"): the probe
+        #: transform must route through the same sharded executable the
+        #: dispatcher will use, or it would compile — and serve — the
+        #: single-device path the warmup never warmed
+        self._mesh = mesh
         self.poll_interval_s = float(poll_interval_s)
         self._lock = threading.Lock()
         self._active = None
@@ -187,6 +197,8 @@ class ModelRegistry:
             # rejected candidate, never a crashed server
             raise CandidateRejected(self.model, version, "load-error",
                                     f"{type(e).__name__}: {e}") from e
+        if self._mesh is not None and hasattr(candidate, "set_mesh"):
+            candidate.set_mesh(self._mesh)
         candidate.serving_name = f"{self.model}@v{version}"
         # install the baseline BEFORE the probe: the probe's transform
         # runs through the _served seam, which creates the candidate's

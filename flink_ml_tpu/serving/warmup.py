@@ -46,15 +46,24 @@ def compile_count() -> int:
 def warm(target,
          frame_factory: Optional[Callable[[int], "object"]] = None,
          buckets: Optional[Sequence[int]] = None,
-         gate: bool = True) -> dict:
+         gate: bool = True, mesh=None) -> dict:
     """Warm every bucket shape; returns a report dict.
 
     ``target`` is a :class:`~flink_ml_tpu.serving.batcher.MicroBatcher`
-    (buckets and servable are taken from it) or a servable (pass
-    ``buckets`` explicitly). Per bucket the servable's ``aot_warm`` is
-    preferred; ``frame_factory(rows)`` (a synthetic request frame of
-    that many rows) is the generic fallback — pure-host servables warm
-    trivially through it.
+    (buckets, servable AND dispatch mesh are taken from it) or a
+    servable (pass ``buckets`` — and ``mesh`` for sharded dispatch —
+    explicitly). Per bucket the servable's ``aot_warm`` is preferred;
+    ``frame_factory(rows)`` (a synthetic request frame of that many
+    rows) is the generic fallback — pure-host servables warm trivially
+    through it.
+
+    With a mesh, the warm matrix is every bucket x THIS mesh shape:
+    the mesh is asserted on the servable first (``set_mesh``), so each
+    ``aot_warm(rows)`` compiles exactly the executable the dispatcher
+    will route that bucket to — the row-sharded twin for buckets the
+    shard count divides, the single-device kernel for the rest — and
+    steady state still compiles zero times (the PR 8 probe,
+    :func:`compile_count`, keeps gating it).
 
     With ``gate`` (default) the ``serving-warmup`` readiness gate is
     held closed while compiling and released on success; a warmup
@@ -68,16 +77,33 @@ def warm(target,
         servable = target._provider()
         if buckets is None:
             buckets = target.config.buckets
+        if mesh is None:
+            mesh = target._mesh
     else:
         servable = target
     if servable is None:
         raise ValueError("cannot warm: no active servable "
                          "(publish a model to the registry first)")
+    if mesh is not None and hasattr(servable, "set_mesh"):
+        servable.set_mesh(mesh)
     bucket_list = [int(b) for b in (buckets or (1,))]
     if gate:
         server.set_gate(WARMUP_GATE, False,
                         f"warming {len(bucket_list)} bucket shape(s)")
-    report = {"buckets": {}, "total_ms": 0.0, "compiles": 0}
+    n_devices = int(mesh.devices.size) if mesh is not None else 1
+    # the DATA-shard count decides which buckets route sharded (the
+    # servable's own rule) — on a (data, model) mesh the raw device
+    # count would mispredict the matrix
+    n_shards = 1
+    if mesh is not None:
+        from flink_ml_tpu.parallel.mesh import data_shard_count
+
+        n_shards = data_shard_count(mesh)
+    report = {"buckets": {}, "total_ms": 0.0, "compiles": 0,
+              "mesh_devices": n_devices,
+              "sharded_buckets": [b for b in bucket_list
+                                  if n_shards > 1
+                                  and b % n_shards == 0]}
     before = compile_count()
     t_start = time.perf_counter()
     try:
@@ -107,7 +133,8 @@ def warm(target,
     tracing.tracer.event("serving.warmup",
                          buckets=",".join(str(b) for b in bucket_list),
                          ms=report["total_ms"],
-                         compiles=report["compiles"])
+                         compiles=report["compiles"],
+                         mesh_devices=n_devices)
     if gate:
         server.set_gate(WARMUP_GATE, True)
     return report

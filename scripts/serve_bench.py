@@ -19,10 +19,23 @@ and a live hot-swap mid-run (the registry watcher adopts a
 freshly-published version while requests are in flight). A small
 window/bucket sweep rides along unless ``--smoke``.
 
+``--mesh`` adds the **mesh-sharded dispatch sweep** (docs/serving.md
+"Mesh-sharded dispatch"): one subprocess cell per simulated device
+count (1/2/4/8 — smoke keeps the endpoints), each measuring the SAME
+large-bucket closed-loop workload through an unsharded and a
+mesh-sharded (``map_rows``) runtime, self-gated on (a) sharded >=
+unsharded throughput at the max device count (enforced on >= 4-core
+hosts, recorded skipped on fewer; always-on 0.5x collapse floor), (b)
+zero steady-state compiles after the bucket x mesh warmup matrix, (c)
+sharded-vs-unsharded prediction parity — plus the pipelined
+dispatcher's pad/compute span-overlap proof and ``mltrace shards
+--check`` over the traced max-device cell.
+
 Gates (exit codes follow the repo convention): 0 ok; 1 an acceptance
 gate failed (ratio < --min-ratio, steady compiles > 0, errors, p99 over
-budget, hot-swap missed); 2 broken environment; 4 the
-``flink-ml-tpu-trace slo --check`` artifact gate found a violated SLO.
+budget, hot-swap missed, a mesh-sweep gate); 2 broken environment; 4
+the ``flink-ml-tpu-trace slo --check`` artifact gate found a violated
+SLO.
 """
 
 from __future__ import annotations
@@ -128,6 +141,273 @@ def lr_loader(leaves, version):
     return servable
 
 
+# ---------------------------------------------------------------------------
+# --mesh sweep: sharded vs unsharded dispatch per simulated device count
+# ---------------------------------------------------------------------------
+
+#: full-sweep device counts (PR 6 xla_force_host_platform_device_count
+#: precedent); --smoke keeps the endpoints
+MESH_DEVICE_COUNTS = (1, 2, 4, 8)
+MESH_SMOKE_COUNTS = (1, 8)
+
+#: the mesh cells' large-bucket workload: row counts sized so every
+#: request lands in a bucket the 8-way mesh divides, with enough
+#: per-row compute (dim) that the device leg is worth sharding
+MESH_BUCKETS = (64, 256)
+MESH_REQUEST_SIZES = (64, 256)
+MESH_DIM = 512
+
+
+def run_mesh_cell(args) -> int:
+    """One sweep cell (a subprocess with its own XLA_FLAGS): measure
+    the SAME large-bucket closed-loop workload through an unsharded and
+    a mesh-sharded serving runtime, check prediction parity between the
+    two dispatch paths, and print one JSON row."""
+    import jax
+
+    from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+    from flink_ml_tpu.observability import tracing
+    from flink_ml_tpu.observability.exporters import dump_metrics
+    from flink_ml_tpu.parallel import create_mesh
+
+    n_dev = jax.device_count()
+    rng = np.random.default_rng(11)
+    dim = MESH_DIM
+    coef = rng.normal(size=dim)
+    watch_dir = os.path.join(tempfile.mkdtemp(prefix="serve-mesh-"),
+                             "models")
+    publish_model(watch_dir, [coef], 1)
+    n_requests = args.requests or (120 if args.smoke else 400)
+
+    counter = [0]
+
+    def frame(rows: int) -> DataFrame:
+        counter[0] += 1
+        r = np.random.default_rng(counter[0])
+        return DataFrame(
+            ["features"], [DataTypes.vector()],
+            [Row([DenseVector(r.normal(size=dim))])
+             for _ in range(rows)])
+
+    def request_frame(i: int) -> DataFrame:
+        return frame(MESH_REQUEST_SIZES[i % len(MESH_REQUEST_SIZES)])
+
+    def measure(mesh) -> dict:
+        registry = ModelRegistry(watch_dir, lr_loader, model="lr",
+                                 probe=lambda: frame(MESH_BUCKETS[0]),
+                                 mesh=mesh)
+        if not registry.poll():
+            raise SystemExit(2)
+        batcher = MicroBatcher(registry, BatcherConfig(
+            buckets=MESH_BUCKETS, window_ms=1.0,
+            max_queue_rows=16384), mesh=mesh).start()
+        warm(batcher, frame_factory=frame, gate=False)
+        steady_base = compile_count()
+        best = None
+        for _ in range(2):
+            r = run_loadgen(batcher.submit, request_frame,
+                            LoadGenConfig(mode="closed",
+                                          requests=n_requests,
+                                          concurrency=16))
+            if best is None or r["throughput_rps"] > best["throughput_rps"]:
+                best = r
+        steady = compile_count() - steady_base
+        batcher.stop()
+        return {"throughput_rps": best["throughput_rps"],
+                "rows_per_s": best["rows_per_s"],
+                "p50_ms": best["latency_ms"]["p50"],
+                "p99_ms": best["latency_ms"]["p99"],
+                "errors": best["errors"],
+                "steadyCompiles": steady,
+                "pipelineDepth": batcher.config.pipeline_depth,
+                "shardedDispatch": batcher.sharded_dispatch()}
+
+    unsharded = measure(None)
+    mesh = create_mesh()
+    sharded = measure(mesh)
+
+    # parity: the same frames through both dispatch paths — the
+    # thresholded prediction column must be byte-identical; the raw
+    # probabilities may differ in the last float32 ulp when the
+    # per-device matmul shape changes, so they carry a measured maxdiff
+    sv_plain = lr_loader([coef], 1)
+    sv_mesh = lr_loader([coef], 1).set_mesh(mesh)
+    parity_ok, raw_maxdiff = True, 0.0
+    for rows in MESH_BUCKETS:
+        base = frame(rows)
+        vals = [list(r.values) for r in base.collect()]
+
+        def clone():
+            return DataFrame(base.column_names, base.data_types,
+                             [Row(list(v)) for v in vals])
+
+        a, b = sv_plain.transform(clone()), sv_mesh.transform(clone())
+        if a.get("prediction").values != b.get("prediction").values:
+            parity_ok = False
+        ra = np.asarray([v.to_array() for v in
+                         a.get("rawPrediction").values])
+        rb = np.asarray([v.to_array() for v in
+                         b.get("rawPrediction").values])
+        raw_maxdiff = max(raw_maxdiff, float(np.max(np.abs(ra - rb))))
+
+    snap = metrics.snapshot().get(f"{ML_GROUP}.serving", {})
+    gauges = snap.get("gauges", {})
+    imbalance = [v for k, v in gauges.items()
+                 if k.startswith("shardImbalance")]
+    reuse = sum(int(v) for k, v in snap.get("counters", {}).items()
+                if k.startswith("paddingReuse"))
+    row = {
+        "deviceCount": n_dev,
+        "meshShape": ",".join(f"{a}={int(mesh.shape[a])}"
+                              for a in mesh.axis_names),
+        "buckets": list(MESH_BUCKETS),
+        "dim": dim,
+        "requests": n_requests,
+        "unsharded": unsharded,
+        "sharded": sharded,
+        "parity": parity_ok,
+        "rawPredictionMaxDiff": raw_maxdiff,
+        "shardImbalance": (max(imbalance) if imbalance else None),
+        "paddingReuse": reuse,
+    }
+    if os.environ.get("FLINK_ML_TPU_TRACE_DIR"):
+        tracing.tracer.shutdown()
+        dump_metrics(os.environ["FLINK_ML_TPU_TRACE_DIR"])
+    print(json.dumps(row), flush=True)
+    return 0
+
+
+def _spawn_mesh_cell(args, n_dev: int, trace_dir=None,
+                     timeout=900) -> dict:
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_dev}")
+    env.pop("FLINK_ML_TPU_TRACE_DIR", None)
+    if trace_dir:
+        env["FLINK_ML_TPU_TRACE_DIR"] = trace_dir
+    argv = [sys.executable, os.path.abspath(__file__), "--mesh-cell"]
+    if args.smoke:
+        argv.append("--smoke")
+    if args.requests:
+        argv += ["--requests", str(args.requests)]
+    proc = subprocess.run(argv, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"mesh cell devices={n_dev} failed "
+            f"(rc={proc.returncode}):\n{proc.stdout}\n{proc.stderr}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _pipeline_overlap(trace_dir: str) -> dict:
+    """Scan the trace for pad/compute overlap: a ``serving.pad`` span
+    of tick N+1 starting before the ``serving.batch`` span of tick N
+    ends proves the pipelined dispatcher really overlaps host padding
+    with device compute."""
+    from flink_ml_tpu.observability.exporters import read_spans
+
+    pads, batches = {}, {}
+    for sp in read_spans(trace_dir):
+        tick = sp.get("attrs", {}).get("tick")
+        if tick is None:
+            continue
+        if sp.get("name") == "serving.pad":
+            pads.setdefault(int(tick), sp)
+        elif sp.get("name") == "serving.batch":
+            batches.setdefault(int(tick), sp)
+    overlaps = 0
+    for tick, batch in batches.items():
+        nxt = pads.get(tick + 1)
+        if nxt is None or not batch.get("dur_us"):
+            continue
+        if nxt["ts_us"] < batch["ts_us"] + batch["dur_us"]:
+            overlaps += 1
+    return {"ticks": len(batches), "overlappingTicks": overlaps,
+            "overlap": overlaps > 0}
+
+
+def run_mesh_sweep(args, root: str) -> dict:
+    """The parent side: spawn one cell per device count, gate, and
+    return the ``mesh_sweep`` record for BENCH_serving.json."""
+    import subprocess
+
+    counts = MESH_SMOKE_COUNTS if args.smoke else MESH_DEVICE_COUNTS
+    trace_dir = os.path.join(root, "mesh-trace")
+    record = {"deviceCounts": list(counts), "cells": [], "gates": {}}
+    for n_dev in counts:
+        print(f"serve_bench: mesh cell devices={n_dev}",
+              file=sys.stderr, flush=True)
+        record["cells"].append(_spawn_mesh_cell(
+            args, n_dev,
+            trace_dir=trace_dir if n_dev == max(counts) else None))
+
+    failures = []
+    hi = max(counts)
+    top = next(c for c in record["cells"] if c["deviceCount"] == hi)
+
+    # gate (a): sharded >= unsharded throughput at the max device count
+    # on the large buckets. Parallel speedup needs parallel hardware:
+    # enforced on >= 4-core hosts, recorded skipped on fewer (the PR 11
+    # native-threading precedent); a 0.5x sanity floor (sharding must
+    # not collapse throughput) enforces everywhere.
+    cores = os.cpu_count() or 1
+    ratio = (top["sharded"]["throughput_rps"]
+             / max(top["unsharded"]["throughput_rps"], 1e-9))
+    enforced = cores >= 4
+    record["gates"]["shardedThroughput"] = {
+        "deviceCount": hi, "ratio": round(ratio, 3),
+        "minRatio": args.mesh_min_ratio, "hostCores": cores,
+        "enforced": enforced,
+        "skipped": None if enforced else f"host has {cores} core(s)"}
+    if enforced and ratio < args.mesh_min_ratio:
+        failures.append(
+            f"sharded/unsharded throughput ratio {ratio:.2f} at "
+            f"{hi} devices below {args.mesh_min_ratio}")
+    if ratio < 0.5:
+        failures.append(
+            f"sharded dispatch collapsed throughput ({ratio:.2f}x)")
+
+    # gate (b): zero steady-state compiles in EVERY cell, both paths —
+    # the expanded bucket x mesh warmup matrix really covers the
+    # closed shape set
+    compiles = {f'{c["deviceCount"]}': [c["unsharded"]["steadyCompiles"],
+                                        c["sharded"]["steadyCompiles"]]
+                for c in record["cells"]}
+    record["gates"]["steadyCompiles"] = compiles
+    if any(v != [0, 0] for v in compiles.values()):
+        failures.append(f"steady-state compiles after warmup: {compiles}")
+
+    # gate (c): sharded-vs-unsharded prediction parity in every cell
+    parity = {str(c["deviceCount"]): c["parity"]
+              for c in record["cells"]}
+    record["gates"]["parity"] = parity
+    if not all(parity.values()):
+        failures.append(f"prediction parity broken: {parity}")
+
+    # pipeline overlap + multi-device telemetry over the traced cell
+    record["gates"]["pipelineOverlap"] = _pipeline_overlap(trace_dir)
+    if not record["gates"]["pipelineOverlap"]["overlap"]:
+        failures.append("no pad/compute overlap in the traced mesh "
+                        "cell — the pipelined dispatcher is not "
+                        "pipelining")
+    shards = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "mltrace.py"), "shards", trace_dir, "--check"],
+        capture_output=True, text=True, timeout=300)
+    record["gates"]["shardsCheck"] = {"exit": shards.returncode}
+    if shards.returncode != 0:
+        failures.append("mltrace shards --check rejected the traced "
+                        f"mesh cell: {shards.stdout}{shards.stderr}")
+
+    record["gates"]["ok"] = not failures
+    record["failures"] = failures
+    return record
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--smoke", action="store_true",
@@ -153,7 +433,19 @@ def main(argv=None) -> int:
     parser.add_argument("--trace-dir", default=None,
                         help="artifact dir (default: a temp dir; CI "
                              "points this at an uploadable path)")
+    parser.add_argument("--mesh", action="store_true",
+                        help="run the mesh-sharded dispatch sweep "
+                             "(1/2/4/8 simulated devices, sharded vs "
+                             "unsharded, self-gated)")
+    parser.add_argument("--mesh-cell", action="store_true",
+                        help="(internal) one sweep cell; prints JSON")
+    parser.add_argument("--mesh-min-ratio", type=float, default=1.0,
+                        help="sharded/unsharded throughput gate at the "
+                             "max device count (>= 4-core hosts)")
     args = parser.parse_args(argv)
+
+    if args.mesh_cell:
+        return run_mesh_cell(args)
 
     n_requests = args.requests or (400 if args.smoke else 1200)
     buckets = tuple(int(b) for b in args.buckets.split(","))
@@ -264,6 +556,15 @@ def main(argv=None) -> int:
                       f"p99 {r['latency_ms']['p99']} ms")
     batcher.stop()
 
+    # -- optional mesh-sharded dispatch sweep (subprocess cells) -------------
+    mesh_sweep = None
+    if args.mesh:
+        try:
+            mesh_sweep = run_mesh_sweep(args, root)
+        except Exception as e:  # noqa: BLE001 — a cell that cannot run
+            # is a broken environment, not a failed gate
+            fail(2, f"mesh sweep environment broken: {e}")
+
     # -- record + gates ------------------------------------------------------
     ratio = (batched["throughput_rps"]
              / max(per_request["throughput_rps"], 1e-9))
@@ -276,6 +577,12 @@ def main(argv=None) -> int:
                      if jax.default_backend() == "cpu"
                      else jax.default_backend()),
         "device_count": jax.device_count(),
+        # dispatch provenance: the measured runtime above runs the
+        # pipelined dispatcher but no mesh (the mesh cells below are
+        # subprocesses with their own simulated device counts)
+        "meshShape": None,
+        "shardedDispatch": batcher.sharded_dispatch(),
+        "pipelineDepth": batcher.config.pipeline_depth,
         "requests": n_requests,
         "concurrency": args.concurrency,
         "request_sizes": list(REQUEST_SIZES),
@@ -290,6 +597,7 @@ def main(argv=None) -> int:
                      "swapped_mid_run": swapped_version == 2},
         "ftrl_train_ms": round(train_ms, 1),
         "sweep": sweep,
+        "mesh_sweep": mesh_sweep,
     }
     # drift provenance (observability/drift.py): the benchmark's own
     # traffic is drawn from the training distribution, so a non-null
@@ -328,6 +636,9 @@ def main(argv=None) -> int:
     if ratio < args.min_ratio:
         fail(1, f"batched/per-request ratio {ratio:.2f} below "
                 f"{args.min_ratio}")
+    if mesh_sweep is not None and not mesh_sweep["gates"]["ok"]:
+        fail(1, "mesh sweep gates failed: "
+                + "; ".join(mesh_sweep["failures"]))
     print(f"serve_bench: OK — {ratio:.2f}x over per-request, p99 "
           f"{batched['latency_ms']['p99']} ms, 0 steady compiles, "
           f"hot-swap v{swapped_version}")

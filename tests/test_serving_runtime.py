@@ -692,3 +692,312 @@ def test_loadgen_config_validation():
         LoadGenConfig(mode="open", rps=0)
     with pytest.raises(ValueError):
         LoadGenConfig(requests=0)
+
+
+# -- mesh-sharded dispatch + pipelined ticks (ISSUE 12) -----------------------
+
+def mesh_of(n):
+    import jax
+
+    from flink_ml_tpu.parallel import create_mesh
+
+    return create_mesh(devices=jax.devices()[:n])
+
+
+def clone_frame(df: DataFrame) -> DataFrame:
+    return DataFrame(df.column_names, df.data_types,
+                     [Row(list(r.values)) for r in df.collect()])
+
+
+def test_sharded_vs_unsharded_prediction_parity_mesh_1_8():
+    """The parity satellite: the same request stream through a mesh-1
+    and a mesh-8 batcher produces byte-identical prediction columns
+    (the raw probabilities may differ in the last float32 ulp when the
+    per-device matmul shape changes — bounded here at 1e-6)."""
+    dim = 12
+    sizes = (8, 32, 3, 16, 8, 1)
+    frames = [feature_frame(n, dim=dim, seed=97 + i)
+              for i, n in enumerate(sizes)]
+    outs = {}
+    for n_dev in (1, 8):
+        mesh = mesh_of(n_dev)
+        sv = lr_servable(dim).set_mesh(mesh)
+        sv.serving_name = f"lr@mesh{n_dev}"
+        cfg = BatcherConfig(buckets=(8, 32), window_ms=1.0)
+        with MicroBatcher(sv, cfg, mesh=mesh) as b:
+            warm(b, frame_factory=lambda r: feature_frame(r, dim=dim),
+                 gate=False)
+            # one request per tick: identical batch shapes on both runs
+            outs[n_dev] = [b.submit(clone_frame(f)).result(timeout=10)
+                           for f in frames]
+    for a, b_ in zip(outs[1], outs[8]):
+        assert (a.get("prediction").values
+                == b_.get("prediction").values)
+        ra = np.asarray([v.to_array()
+                         for v in a.get("rawPrediction").values])
+        rb = np.asarray([v.to_array()
+                         for v in b_.get("rawPrediction").values])
+        np.testing.assert_allclose(ra, rb, atol=1e-6)
+    # the mesh-8 run really sharded: per-device rows were recorded
+    grp = metrics.group(ML_GROUP, "serving")
+    assert grp.snapshot()["gauges"].get(
+        'shardRows{device="7",servable="lr@mesh8"}') is not None
+
+
+def test_warmup_mesh_matrix_zero_steady_compiles_sharded():
+    """The expanded warmup matrix: with a mesh, each bucket warms the
+    executable the dispatcher will route it to (sharded for divisible
+    buckets, single-device otherwise) and mixed traffic still pays
+    ZERO steady-state compiles."""
+    compile_stats.reset()
+    dim = 10
+    mesh = mesh_of(8)
+    sv = lr_servable(dim)
+    sv.serving_name = "lr@meshwarm"
+    cfg = BatcherConfig(buckets=(4, 8, 32), window_ms=1.0)
+    with MicroBatcher(sv, cfg, mesh=mesh) as b:
+        report = warm(b, frame_factory=lambda r: feature_frame(r,
+                                                               dim=dim))
+        assert report["mesh_devices"] == 8
+        assert report["sharded_buckets"] == [8, 32]  # 4 % 8 != 0
+        assert report["compiles"] == 3
+        steady = compile_count()
+        res = run_loadgen(
+            b.submit,
+            lambda i: feature_frame(1 + i % 32, dim=dim, seed=i),
+            LoadGenConfig(mode="closed", requests=200, concurrency=8))
+    assert res["ok"] == 200 and res["errors"] == 0
+    assert compile_count() - steady == 0, \
+        "sharded steady-state serving recompiled despite the warm matrix"
+
+
+def test_hot_swap_lands_between_sharded_ticks(tmp_path):
+    """Hot-swap under sharded dispatch behaves exactly as unsharded: a
+    batch in flight on v1 completes on v1 while the registry swaps to
+    v2 (mesh asserted on the candidate BEFORE its probe), and the next
+    sharded tick serves v2."""
+    entered = threading.Event()
+    release = threading.Event()
+    dim = 8
+
+    class BlockingLR(LogisticRegressionModelServable):
+        def transform(self, df):
+            out = LogisticRegressionModelServable.transform.__wrapped__(
+                self, df)
+            if self.model_data.model_version == 1:
+                entered.set()
+                release.wait(timeout=10)
+            out.add_column("servedVersion", DataTypes.INT,
+                           [self.model_data.model_version]
+                           * out.num_rows())
+            return out
+
+    def loader(leaves, version):
+        sv = BlockingLR().set_device_predict(True)
+        sv.model_data = LogisticRegressionModelData(
+            np.asarray(leaves[0]), version)
+        return sv
+
+    mesh = mesh_of(8)
+    reg = ModelRegistry(str(tmp_path / "models"), loader, model="lr",
+                        mesh=mesh)
+    publish_model(reg.watch_dir, [np.arange(1.0, dim + 1)], 1)
+    assert reg.poll()
+    assert reg.active._mesh is mesh  # set before any probe/dispatch
+    with MicroBatcher(reg, BatcherConfig(buckets=(8,),
+                                         window_ms=0.0),
+                      mesh=mesh) as b:
+        inflight = b.submit(feature_frame(8, dim=dim))
+        assert entered.wait(timeout=10)  # v1 sharded tick mid-flight
+        publish_model(reg.watch_dir, [np.arange(2.0, dim + 2)], 2)
+        assert reg.poll() and reg.version == 2  # swap DURING dispatch
+        release.set()
+        out = inflight.result(timeout=10)
+        assert set(out.get("servedVersion").values) == {1}
+        after = b.submit(feature_frame(8, dim=dim)).result(timeout=10)
+        assert set(after.get("servedVersion").values) == {2}
+    # both versions' ticks were sharded: per-device rows per version
+    gauges = metrics.group(ML_GROUP, "serving").snapshot()["gauges"]
+    assert 'shardRows{device="0",servable="lr@v1"}' in gauges
+    assert 'shardRows{device="0",servable="lr@v2"}' in gauges
+
+
+def test_pipelined_dispatcher_pad_overlaps_device(tmp_path):
+    """The pipelining proof from the trace: under sustained load the
+    ``serving.pad`` span of tick N+1 starts before the
+    ``serving.batch`` span of tick N ends."""
+    from flink_ml_tpu.observability import tracing
+    from flink_ml_tpu.observability.exporters import read_spans
+
+    class SlowishServable(SumServable):
+        def transform(self, df):
+            time.sleep(0.002)  # a visible device leg per tick
+            return SumServable.transform.__wrapped__(self, df)
+
+    sv = SlowishServable()
+    sv.serving_name = "sum@pipe"
+    tracing.tracer.configure(str(tmp_path))
+    try:
+        cfg = BatcherConfig(buckets=(8,), window_ms=0.5)
+        with MicroBatcher(sv, cfg) as b:
+            run_loadgen(b.submit,
+                        lambda i: feature_frame(1 + i % 4, seed=i),
+                        LoadGenConfig(mode="closed", requests=120,
+                                      concurrency=8))
+    finally:
+        tracing.tracer.configure(None)
+    pads, batches = {}, {}
+    for sp in read_spans(str(tmp_path)):
+        tick = sp.get("attrs", {}).get("tick")
+        if tick is None:
+            continue
+        if sp["name"] == "serving.pad":
+            pads.setdefault(int(tick), sp)
+        elif sp["name"] == "serving.batch":
+            batches.setdefault(int(tick), sp)
+    assert batches, "no serving.batch spans traced"
+    assert all(sp["attrs"].get("pipeline_depth") == 1
+               for sp in batches.values())
+    overlaps = sum(
+        1 for tick, sp in batches.items()
+        if tick + 1 in pads and sp.get("dur_us")
+        and pads[tick + 1]["ts_us"] < sp["ts_us"] + sp["dur_us"])
+    assert overlaps > 0, \
+        "pad of tick N+1 never overlapped device compute of tick N"
+
+
+def test_pipeline_depth_zero_is_single_thread_dispatch():
+    sv = SumServable()
+    sv.serving_name = "sum@depth0"
+    cfg = BatcherConfig(buckets=(8,), window_ms=1.0, pipeline_depth=0)
+    with MicroBatcher(sv, cfg) as b:
+        assert b._device_thread is None  # both stages on one thread
+        outs = [b.submit(feature_frame(n, seed=n)).result(timeout=10)
+                for n in (3, 8, 1)]
+    assert [o.num_rows() for o in outs] == [3, 8, 1]
+    assert b.status()["pipeline_depth"] == 0
+
+
+# -- tick-drain boundary conditions (the ISSUE 12 audit) ----------------------
+
+class RecordingServable(SumServable):
+    """Captures what each tick's transform really received."""
+
+    def __init__(self):
+        self.batches = []
+
+    def transform(self, df):
+        self.batches.append((df.num_rows(),
+                             [len(r.values) for r in df.collect()]))
+        return SumServable.transform.__wrapped__(self, df)
+
+
+def test_exact_bucket_fit_pads_nothing():
+    sv = RecordingServable()
+    sv.serving_name = "sum@exactfit"
+    with MicroBatcher(sv, BatcherConfig(buckets=(8,),
+                                        window_ms=50.0)) as b:
+        assert b.submit(feature_frame(8)).result(
+            timeout=10).num_rows() == 8
+    assert sv.batches[0][0] == 8  # exactly the bucket, zero pad rows
+    assert metrics.group(ML_GROUP, "serving").get_counter(
+        "padRows", labels={"servable": "sum@exactfit"}) == 0
+
+
+def test_unbucketed_exact_drain_pads_nothing():
+    """The no-bucketing path dispatches the exact drained row count —
+    including at the max_batch_rows row-cap boundary."""
+    sv = RecordingServable()
+    sv.serving_name = "sum@unbucketed"
+    cfg = BatcherConfig(buckets=None, window_ms=50.0, max_batch_rows=6)
+    with MicroBatcher(sv, cfg) as b:
+        futs = [b.submit(feature_frame(3, seed=s)) for s in (1, 2)]
+        for f in futs:
+            assert f.result(timeout=10).num_rows() == 3
+    # 3 + 3 drained to exactly the row cap: one tick of exactly 6 rows
+    assert (6, [1] * 6) == (sv.batches[0][0], sv.batches[0][1])
+    assert metrics.group(ML_GROUP, "serving").get_counter(
+        "padRows", labels={"servable": "sum@unbucketed"}) == 0
+
+
+def test_unbucketed_single_oversized_request_rejected_loop_survives():
+    sv = SumServable()
+    sv.serving_name = "sum@oversize"
+    cfg = BatcherConfig(buckets=None, window_ms=0.0, max_batch_rows=4)
+    with MicroBatcher(sv, cfg) as b:
+        with pytest.raises(RejectedRequest) as exc:
+            b.submit(feature_frame(5)).result(timeout=10)
+        assert exc.value.reason == "too-large"
+        # the dispatcher survived the rejected head
+        assert b.submit(feature_frame(2)).result(
+            timeout=10).num_rows() == 2
+
+
+def test_deadline_expired_head_rejected_same_tick_others_dispatch():
+    sv = SumServable()
+    sv.serving_name = "sum@deadhead"
+    with MicroBatcher(sv, BatcherConfig(buckets=(8,),
+                                        window_ms=80.0)) as b:
+        doomed = b.submit(feature_frame(2), deadline_ms=1.0)
+        time.sleep(0.02)  # head expires while waiting for fill
+        good = b.submit(feature_frame(3, seed=5))
+        with pytest.raises(RejectedRequest) as exc:
+            doomed.result(timeout=10)
+        assert exc.value.reason == "deadline"
+        assert good.result(timeout=10).num_rows() == 3
+
+
+def test_pad_template_cache_counts_reuse_and_stays_isolated():
+    sv = RecordingServable()
+    sv.serving_name = "sum@padreuse"
+    grp = metrics.group(ML_GROUP, "serving")
+    labels = {"servable": "sum@padreuse"}
+    with MicroBatcher(sv, BatcherConfig(buckets=(8,),
+                                        window_ms=20.0)) as b:
+        b.submit(feature_frame(3, seed=1)).result(timeout=10)
+        first = grp.get_counter("paddingReuse", labels=labels)
+        b.submit(feature_frame(3, seed=2)).result(timeout=10)
+        second = grp.get_counter("paddingReuse", labels=labels)
+    assert first == 0          # first tick built the template
+    assert second == 5         # second tick reused it for its 5 pads
+    # isolation: transform mutates rows in place (add_column) — cached
+    # template values must not accumulate across ticks: every row of
+    # every tick arrived with the input arity (1 column)
+    for _, arities in sv.batches:
+        assert arities == [1] * 8
+
+
+def test_batcher_config_pipeline_env(monkeypatch):
+    from flink_ml_tpu.serving import PIPELINE_ENV
+
+    monkeypatch.setenv(PIPELINE_ENV, "2")
+    assert BatcherConfig.from_env().pipeline_depth == 2
+    monkeypatch.setenv(PIPELINE_ENV, "-1")
+    with pytest.raises(ValueError):
+        BatcherConfig.from_env()
+    monkeypatch.setenv(PIPELINE_ENV, "deep")
+    with pytest.raises(ValueError, match=PIPELINE_ENV):
+        BatcherConfig.from_env()
+
+
+def test_pad_template_cache_misses_on_feature_dim_change():
+    """The cache key must include the value shapes: the declared
+    DataType ('vector') carries no dimension, so after the served
+    feature dim changes (a hot-swap republish), a stale template would
+    pad wrong-dim rows and fail every padded tick."""
+    class DimRecorder(SumServable):
+        def __init__(self):
+            self.dims = []
+
+        def transform(self, df):
+            self.dims.append([r.get(0).size for r in df.collect()])
+            return SumServable.transform.__wrapped__(self, df)
+
+    sv = DimRecorder()
+    sv.serving_name = "sum@dimswap"
+    with MicroBatcher(sv, BatcherConfig(buckets=(8,),
+                                        window_ms=20.0)) as b:
+        b.submit(feature_frame(3, dim=4)).result(timeout=10)
+        b.submit(feature_frame(3, dim=12)).result(timeout=10)
+    assert sv.dims[0] == [4] * 8
+    assert sv.dims[1] == [12] * 8  # no stale dim-4 pad rows
