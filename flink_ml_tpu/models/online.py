@@ -509,6 +509,34 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
         self._initial_model_data = model_data
         return self
 
+    def warm_start(self, model, model_version: Optional[int] = None):
+        """Seed the next fit from an already-serving model — THE
+        incremental-refit seam the ops controller uses
+        (serving/controller.py): a drift-triggered retrain continues
+        FTRL from the live coefficients over recent traffic instead of
+        re-learning from zeros.
+
+        ``model`` is a fitted :class:`OnlineLogisticRegressionModel`
+        (its coefficients + model_version seed the fit) or a bare
+        coefficient vector; ``model_version`` overrides the seed
+        version (e.g. the registry's published version, which is the
+        authoritative counter once serving owns the model)."""
+        if hasattr(model, "coefficients"):
+            coeffs = np.asarray(model.coefficients, np.float64)
+            version = int(getattr(model, "model_version", 0))
+        else:
+            coeffs = np.asarray(model, np.float64)
+            version = 0
+        if coeffs.ndim != 1:
+            raise ValueError(
+                f"warm_start expects a 1-D coefficient vector, got "
+                f"shape {coeffs.shape}")
+        if model_version is not None:
+            version = int(model_version)
+        return self.set_initial_model_data(Table.from_columns(
+            coefficient=as_dense_vector_column(coeffs[None, :]),
+            modelVersion=np.asarray([version], np.int64)))
+
     def fit(self, data: Union[Table, StreamTable]
             ) -> OnlineLogisticRegressionModel:
         if self._initial_model_data is None:

@@ -16,6 +16,7 @@ rerunning anything:
     flink-ml-tpu-trace shards TRACE_DIR --check  # per-device mesh view
     flink-ml-tpu-trace slo TRACE_DIR --check     # SLO verdicts (exit 4)
     flink-ml-tpu-trace drift TRACE_DIR --check   # drift verdicts (exit 4)
+    flink-ml-tpu-trace controller TRACE_DIR --check  # ops loop (exit 4)
     flink-ml-tpu-trace ROOT --latest             # newest trace dir under ROOT
 
 Sections: top spans by self-time (time in a span minus its children —
@@ -45,7 +46,12 @@ their training-time baselines (PSI / Jensen-Shannon distance / KS per
 feature and for predictions) and with ``--check`` exits 4 when any
 servable drifted, 2 on missing/broken artifacts — a servable published
 without a baseline reports ``source: missing`` and never fails the
-gate; the live verdicts come from the ``/drift`` endpoint. Every
+gate; the live verdicts come from the ``/drift`` endpoint. The
+``controller`` subcommand (serving/controller.py, docs/ops.md) renders
+the ops-controller timeline — triggers, state transitions, cycle
+outcomes, rollbacks — and with ``--check`` exits 4 unless every
+controller ended healthy (no failed cycles, final state ``watching``),
+2 on missing telemetry: the gate of the chaos-armed ops smoke. Every
 subcommand accepts ``--latest``:
 treat the positional dir as a root and resolve the newest trace dir
 under it (exporters.resolve_trace_dir) — no more hand-globbing.
@@ -213,6 +219,14 @@ def main(argv=None) -> int:
         from flink_ml_tpu.observability.drift import main as drift_main
 
         return drift_main(argv[1:])
+    if argv and argv[0] == "controller":
+        # ops-controller timeline (serving/controller.py); same
+        # dispatch rule — ./controller summarizes such a directory
+        from flink_ml_tpu.serving.controller import (
+            main as controller_main,
+        )
+
+        return controller_main(argv[1:])
     if argv and argv[0] == "summary":
         # explicit subcommand spelling for the default view, so
         # unattended consumers can write `summary --json` without

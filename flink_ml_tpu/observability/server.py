@@ -37,6 +37,10 @@ route             serves                                      response with no d
                   training-time baselines; evaluating emits   without a baseline reports
                   the events/gauges, so scraping doubles as   ``source: "missing"``
                   the drift alerter
+``/controller``   the ops controller's live state             200 ``{"controller": null}``
+                  (serving/controller.py): state machine      — no controller registered
+                  position, cycle, canary version/fraction,   a provider
+                  cycle outcomes, recent transitions
 ``/spans/recent`` the tracer's in-memory ring of recently     200 ``{"spans": []}``
                   closed spans (tracing.RECENT_SPANS;
                   arming the endpoint flips
@@ -72,7 +76,9 @@ __all__ = ["METRICS_PORT_ENV", "METRICS_HOST_ENV", "ROUTE_TABLE",
            "ROUTES", "TelemetryServer",
            "maybe_start", "stop", "reseed_child", "set_gate",
            "clear_gate", "readiness", "set_serving_status",
-           "get_serving_status", "clear_serving_status"]
+           "get_serving_status", "clear_serving_status",
+           "set_controller_status", "get_controller_status",
+           "clear_controller_status"]
 
 #: env var holding the port to serve on; unset → no endpoint, ``0`` →
 #: an ephemeral port (tests, the serve smoke)
@@ -95,6 +101,9 @@ ROUTE_TABLE = {
     "/drift": ("_route_drift",
                '200 with an empty "servables" map; no baseline → '
                'source: "missing"'),
+    "/controller": ("_route_controller",
+                    '200 {"controller": null} — no ops controller '
+                    'registered a provider (serving/controller.py)'),
     "/spans/recent": ("_route_spans_recent", '200 {"spans": []}'),
 }
 
@@ -126,6 +135,12 @@ _gates_lock = threading.Lock()
 # depth, bucket table, active model version); None → route answers with
 # ``{"serving": null}``.
 _serving_status = None
+
+# ``/controller`` status provider: the ops controller
+# (serving/controller.py) registers a zero-arg callable returning its
+# live state dict (state machine position, cycle, canary, outcomes);
+# None → route answers with ``{"controller": null}``.
+_controller_status = None
 
 
 def set_gate(name: str, ready: bool, reason: str = "") -> None:
@@ -170,6 +185,28 @@ def clear_serving_status(provider=None, restore=None) -> None:
     global _serving_status
     if provider is None or _serving_status == provider:
         _serving_status = restore
+
+
+def set_controller_status(provider) -> None:
+    """Register the ``/controller`` route's status provider (a zero-arg
+    callable returning a JSON-serializable dict), or None to
+    unregister."""
+    global _controller_status
+    _controller_status = provider
+
+
+def get_controller_status():
+    """The currently registered ``/controller`` provider (or None)."""
+    return _controller_status
+
+
+def clear_controller_status(provider=None) -> None:
+    """Unregister the ``/controller`` provider — with ``provider``
+    given, only if it is still the registered one (the /serving
+    contract: a stopping controller must not clobber a later one)."""
+    global _controller_status
+    if provider is None or _controller_status == provider:
+        _controller_status = None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -236,6 +273,14 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, json.dumps(
             _json_safe(drift.drift_report(emit=True)),
             default=str), _JSON_CTYPE)
+
+    def _route_controller(self) -> None:
+        from flink_ml_tpu.observability.health import _json_safe
+
+        provider = _controller_status
+        status = provider() if provider is not None else None
+        self._send(200, json.dumps(_json_safe({"controller": status}),
+                                   default=str), _JSON_CTYPE)
 
     def _route_spans_recent(self) -> None:
         # deque.append is thread-safe but ITERATION is not: serving
@@ -348,7 +393,7 @@ def stop() -> None:
     un-latches a failed start so a new port can be tried). Readiness
     gates and the /serving provider reset too — they belong to the
     runtime that registered them, which is gone."""
-    global _server, _serving_status
+    global _server, _serving_status, _controller_status
     with _lock:
         srv, _server = _server, None
     if isinstance(srv, TelemetryServer):
@@ -357,6 +402,7 @@ def stop() -> None:
     with _gates_lock:
         _gates.clear()
     _serving_status = None
+    _controller_status = None
 
 
 def reseed_child() -> None:

@@ -33,6 +33,20 @@ Known injection sites:
 - ``hostpool-child``     a forked worker raises (worker-failure path)
 - ``hostpool-hang``      a forked worker wedges (deadline/SIGKILL path)
 - ``native-kernel``      entry of the native (C++) kernel wrappers
+- ``controller-retrain`` entry of the ops controller's retrain step
+                         (serving/controller.py; retried under its
+                         RetryPolicy)
+- ``controller-publish`` entry of the controller's publish step, before
+                         publish_model writes anything
+- ``canary-probe``       entry of the registry's candidate probe
+                         (serving/registry.py; transient — the
+                         candidate is NOT condemned)
+- ``model-swap``         the registry's swap commit, before the atomic
+                         assignment (watcher retries next poll; the
+                         controller retries the promote)
+- ``model-rollback``     entry of ModelRegistry.rollback, before any
+                         mutation (the controller re-enters until the
+                         prior version serves again)
 """
 
 from __future__ import annotations
@@ -46,7 +60,14 @@ from typing import Dict, Iterable, Optional, Sequence
 from flink_ml_tpu.resilience.policy import InjectedFault
 
 SITES = ("checkpoint-save", "checkpoint-publish", "epoch-boundary",
-         "hostpool-child", "hostpool-hang", "native-kernel")
+         "hostpool-child", "hostpool-hang", "native-kernel",
+         "controller-retrain", "controller-publish", "canary-probe",
+         "model-swap", "model-rollback")
+
+#: the ops-loop subset (serving/controller.py + registry canary/swap/
+#: rollback seams) — what scripts/ops_loop_smoke.py arms
+CONTROLLER_SITES = ("controller-retrain", "controller-publish",
+                    "canary-probe", "model-swap", "model-rollback")
 
 _ENV_FLAG = "FLINK_ML_TPU_CHAOS"
 _ENV_SEED = "FLINK_ML_TPU_CHAOS_SEED"

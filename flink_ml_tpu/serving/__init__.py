@@ -12,7 +12,12 @@ The servable tier (flink_ml_tpu/servable/) answers ONE caller's
   at start and gate ``/healthz`` readiness on completion;
 - :mod:`registry` — versioned model hot-swap from checkpointed model
   data: manifest-validated, health-probed, atomic, rolled back on any
-  failure — the online-learning (FTRL) → serving handoff;
+  failure — the online-learning (FTRL) → serving handoff — plus canary
+  fraction routing and first-class rollback to v(N-1);
+- :mod:`controller` — the self-healing ops loop (docs/ops.md):
+  drift/SLO violation → warm-start retrain → publish with a fresh
+  baseline → canary → staged ramp → swap, with automatic rollback when
+  the canary's error/drift/latency gauges regress;
 - :mod:`loadgen` — closed/open-loop load generation with exact latency
   percentiles, the one request-driving path for benchmarks, smokes and
   tests.
@@ -31,6 +36,10 @@ from flink_ml_tpu.serving.batcher import (  # noqa: F401
     WINDOW_ENV,
     BatcherConfig,
     MicroBatcher,
+)
+from flink_ml_tpu.serving.controller import (  # noqa: F401
+    ControllerConfig,
+    OpsController,
 )
 from flink_ml_tpu.serving.loadgen import (  # noqa: F401
     LoadGenConfig,
@@ -56,6 +65,8 @@ __all__ = [
     "WINDOW_ENV",
     "BatcherConfig",
     "MicroBatcher",
+    "ControllerConfig",
+    "OpsController",
     "LoadGenConfig",
     "percentiles",
     "run_loadgen",

@@ -264,8 +264,14 @@ class MicroBatcher:
                  mesh=None):
         self.config = config or BatcherConfig()
         self._mesh = mesh
+        self._target = target  # for /serving status (version/canary)
         if isinstance(target, TransformerServable):
             self._provider = lambda: target
+        elif hasattr(target, "resolve"):
+            # the registry's per-tick routing seam: active, or the
+            # canary for its traffic fraction (docs/ops.md) — resolving
+            # once per tick keeps in-flight batches on one version
+            self._provider = target.resolve
         elif hasattr(target, "active"):
             self._provider = lambda: target.active
         elif callable(target):
@@ -697,7 +703,20 @@ class MicroBatcher:
             "pipeline_depth": cfg.pipeline_depth,
             "mesh_devices": self.mesh_device_count(),
             "sharded_dispatch": self.sharded_dispatch(),
+            "model_version": getattr(self._target, "version", None),
+            "canary": self._canary_status(),
         }
+
+    def _canary_status(self):
+        """Canary version/fraction from a registry target (None when
+        the target has no canary seam or no canary is live) — the
+        rollout's live surface on the ``/serving`` route."""
+        version = getattr(self._target, "canary_version", None)
+        if version is None:
+            return None
+        return {"version": version,
+                "fraction": getattr(self._target, "canary_fraction",
+                                    None)}
 
     def mesh_device_count(self) -> int:
         """Devices of the dispatch mesh (1 without one) — provenance

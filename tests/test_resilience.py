@@ -147,6 +147,78 @@ def test_supervisor_deadline_budget():
                        sleep=lambda s: None)
 
 
+class _FakeClock:
+    """Deterministic monotonic clock advanced ONLY by the supervisor's
+    injected sleep — the deadline-vs-backoff race, replayed exactly."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def monotonic(self):
+        return self.now
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+
+
+def test_supervisor_final_sleep_clipped_to_deadline_budget(monkeypatch):
+    """The backoff sleep racing deadline exhaustion: the last sleep
+    must be CLIPPED to the remaining budget, never overshoot it — a
+    30s backoff against 0.3s of remaining deadline must not hold the
+    recovery loop 29.7s past its own budget."""
+    from flink_ml_tpu.resilience import supervisor as sup
+
+    clock = _FakeClock()
+    monkeypatch.setattr(sup.time, "monotonic", clock.monotonic)
+
+    def always():
+        raise OSError("down")
+
+    # backoff schedule 0.7, 1.4, ... against a 1.0s deadline:
+    # restart 1 sleeps its full 0.7; restart 2's 1.4s backoff must be
+    # clipped to the remaining 0.3; the next failure exhausts
+    with pytest.raises(RestartsExhausted) as ei:
+        run_supervised(always,
+                       policy=RetryPolicy(max_restarts=100,
+                                          backoff_s=0.7,
+                                          backoff_multiplier=2.0,
+                                          deadline_s=1.0),
+                       sleep=clock.sleep)
+    assert clock.sleeps == [0.7, pytest.approx(0.3)], \
+        "the final sleep must be min(backoff, remaining budget)"
+    assert sum(clock.sleeps) <= 1.0 + 1e-9
+    # ...and the raised exhaustion names the bound that tripped
+    assert "deadline budget" in str(ei.value)
+    assert "1s" in str(ei.value)
+    assert ei.value.attempts == 2
+
+
+def test_restarts_exhausted_names_which_bound_tripped():
+    """attempts-bound vs deadline-bound exhaustion must be
+    distinguishable from the exception text alone — an operator reading
+    a failed cycle needs to know whether to raise max_restarts or
+    deadline_s."""
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(RestartsExhausted) as attempts_ei:
+        run_supervised(always,
+                       policy=RetryPolicy(max_restarts=1, backoff_s=0.0),
+                       sleep=lambda s: None)
+    assert "restart budget" in str(attempts_ei.value)
+    assert "deadline" not in str(attempts_ei.value)
+
+    with pytest.raises(RestartsExhausted) as deadline_ei:
+        run_supervised(always,
+                       policy=RetryPolicy(max_restarts=5, backoff_s=0.0,
+                                          deadline_s=0.0),
+                       sleep=lambda s: None)
+    assert "deadline budget" in str(deadline_ei.value)
+    assert "restart budget" not in str(deadline_ei.value)
+
+
 def test_supervisor_emits_restart_and_recovery_events():
     events = []
 
