@@ -207,6 +207,9 @@ def _worker(role: str) -> int:
                         # mesh provenance: 1-device fallback vs real mesh
                         "deviceCount": best.get("deviceCount"),
                         "meshShape": best.get("meshShape"),
+                        # multi-process provenance (jax.distributed)
+                        "processCount": best.get("processCount"),
+                        "processIndex": best.get("processIndex"),
                         # serving-dispatch provenance (null on plain
                         # fits — no micro-batcher ran beside this row)
                         "shardedDispatch": best.get("shardedDispatch"),
@@ -247,6 +250,12 @@ def _worker(role: str) -> int:
         # number actually measured
         "device_count": best.get("deviceCount"),
         "mesh_shape": best.get("meshShape"),
+        # multi-process provenance (parallel/distributed.py): how many
+        # jax.distributed processes formed the mesh this number ran on
+        # (1 = the classic single-process runtime) and which process
+        # this one-liner was written from
+        "process_count": best.get("processCount"),
+        "process_index": best.get("processIndex"),
         # serving-dispatch provenance (serving/batcher.py): whether a
         # mesh-sharded, pipelined micro-batcher served beside this row
         # (null on plain fit benches)

@@ -199,22 +199,30 @@ def _native_provenance() -> dict:
 
 def _mesh_provenance() -> dict:
     """``deviceCount`` + ``meshShape`` of the default mesh the benchmark
-    actually ran on (``"data=8"`` style), plus ``updateSharding``
-    (whether the cross-replica sharded update was armed —
-    parallel/update_sharding.py) and ``optStateBytesPerReplica`` (the
-    per-replica update-state bytes the fit recorded; shrinks ~1/N when
-    sharding is on) — benchmark rows must say whether their number is a
-    1-device cpu fallback or a real mesh, and whether optimizer state
-    was replicated or sharded. Never fails a finished measurement: if
-    the mesh is somehow unavailable the keys are simply absent."""
+    actually ran on (``"data=8"`` style), ``processCount`` /
+    ``processIndex`` of the runtime that measured it (a row from one
+    process of a jax.distributed mesh is a different machine state than
+    a single-process one — parallel/distributed.py), plus
+    ``updateSharding`` (whether the cross-replica sharded update was
+    armed — parallel/update_sharding.py) and ``optStateBytesPerReplica``
+    (the per-replica update-state bytes the fit recorded; shrinks ~1/N
+    when sharding is on) — benchmark rows must say whether their number
+    is a 1-device cpu fallback or a real mesh, and whether optimizer
+    state was replicated or sharded. Never fails a finished
+    measurement: if the mesh is somehow unavailable the keys are simply
+    absent."""
     try:
         from flink_ml_tpu.parallel import update_sharding
+        from flink_ml_tpu.parallel.distributed import (
+            process_count, process_index)
         from flink_ml_tpu.parallel.mesh import default_mesh
 
         mesh = default_mesh()
         return {"deviceCount": int(mesh.devices.size),
                 "meshShape": ",".join(f"{a}={int(mesh.shape[a])}"
                                       for a in mesh.axis_names),
+                "processCount": process_count(),
+                "processIndex": process_index(),
                 **update_sharding.provenance(),
                 **_serving_provenance()}
     except Exception:  # noqa: BLE001 — provenance only

@@ -26,6 +26,7 @@ from flink_ml_tpu.params.shared import (
     HasLabelCol,
     HasLearningRate,
     HasMaxIter,
+    HasOptimizerMethod,
     HasPredictionCol,
     HasRawPredictionCol,
     HasReg,
@@ -96,7 +97,8 @@ class LinearModelParams(HasFeaturesCol, HasPredictionCol):
 
 class LinearTrainParams(LinearModelParams, HasLabelCol, HasWeightCol,
                         HasMaxIter, HasReg, HasElasticNet, HasLearningRate,
-                        HasGlobalBatchSize, HasTol, HasRawPredictionCol):
+                        HasGlobalBatchSize, HasTol, HasRawPredictionCol,
+                        HasOptimizerMethod):
     pass
 
 
@@ -226,7 +228,12 @@ class LinearEstimatorBase(Estimator, LinearTrainParams,
             learning_rate=self.learning_rate,
             global_batch_size=self.global_batch_size,
             max_iter=self.max_iter, tol=self.tol, reg=self.reg,
-            elastic_net=self.elastic_net)
+            elastic_net=self.elastic_net,
+            # stateful update rules (HasOptimizerMethod): momentum/adam
+            # moment state rides the fit carry, sharded 1/N per replica
+            # under FLINK_ML_TPU_UPDATE_SHARDING
+            method=self.optimizer, momentum=self.momentum,
+            beta1=self.beta1, beta2=self.beta2, eps=self.epsilon)
         init = np.zeros(x.shape[1], np.float32)
         sgd = SGD(params)
         # the estimator class name labels this fit's model-health

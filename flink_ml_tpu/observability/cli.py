@@ -138,18 +138,37 @@ def summarize(spans: List[dict]) -> dict:
     timeline.sort(key=lambda r: r["ts_us"])
 
     roots = [sp for sp in spans if sp.get("parent") not in by_id]
+    # multi-process attribution: span records from a jax.distributed run
+    # carry a ``process`` label (tracing.py) because pids alone collide
+    # across hosts — count spans per process so a merged trace says who
+    # ran what
+    per_process: Dict[str, int] = {}
+    for sp in spans:
+        if "process" in sp:
+            key = str(sp["process"])
+            per_process[key] = per_process.get(key, 0) + 1
     return {"spans": len(spans),
             "traces": len({sp.get("trace") for sp in spans}),
             "roots": [{"name": sp.get("name"),
                        "ms": _ms(sp.get("dur_us"))} for sp in roots],
             "top_self_time": top,
             "epochs": epochs,
-            "timeline": timeline}
+            "timeline": timeline,
+            **({"processes": per_process} if per_process else {})}
 
 
 def render_summary(summary: dict, top_n: int = 15) -> str:
     out = [f"{summary['spans']} span(s) across "
            f"{summary['traces']} trace(s)"]
+    if summary.get("processes"):
+        # numeric order: the keys are stringified process indices, and
+        # p10 must not sort before p2
+        parts = ", ".join(
+            f"p{k}: {v}" for k, v in sorted(
+                summary["processes"].items(),
+                key=lambda kv: (not kv[0].isdigit(), int(kv[0])
+                                if kv[0].isdigit() else 0, kv[0])))
+        out.append(f"  processes: {parts} span(s)")
     for root in summary["roots"]:
         out.append(f"  root: {root['name']}  {root['ms']} ms")
 

@@ -1073,10 +1073,11 @@ def clear() -> None:
 
 
 def dump_state(trace_dir: str) -> Optional[str]:
-    """Write this process's drift state as ``drift-<pid>.json`` beside
-    the metrics snapshots (exporters.dump_metrics calls this when the
-    module is loaded); returns the path, or None when there is nothing
-    to write."""
+    """Write this process's drift state as ``drift-<pid>.json``
+    (``drift-p<k>-<pid>.json`` in a multi-process runtime —
+    exporters.artifact_suffix) beside the metrics snapshots
+    (exporters.dump_metrics calls this when the module is loaded);
+    returns the path, or None when there is nothing to write."""
     with _lock:
         names = sorted(set(_windows) | set(_baselines) | set(_missing))
         if not names:
@@ -1089,8 +1090,10 @@ def dump_state(trace_dir: str) -> Optional[str]:
                 "live": win.window_json() if win is not None else {},
                 "baseline": base.to_json() if base is not None else None,
                 "results": _last_results.get(name)}
+    from flink_ml_tpu.observability.exporters import artifact_suffix
+
     os.makedirs(trace_dir, exist_ok=True)
-    path = os.path.join(trace_dir, f"drift-{os.getpid()}.json")
+    path = os.path.join(trace_dir, f"drift-{artifact_suffix()}.json")
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(doc, f, default=str)

@@ -171,16 +171,48 @@ def write_chrome_trace(trace_dir: str, out_path: str) -> int:
 
 
 # -- metrics snapshots in the trace dir --------------------------------------
+def safe_process_label() -> Optional[int]:
+    """``distributed.process_label()`` that never raises — THE wrapper
+    every artifact writer (span sink, metrics/drift dumps, span-record
+    attribution) shares: labeling must never sink a write. Recomputed
+    per call rather than cached: cheap (two env lookups) next to the
+    disk write it accompanies, and tests re-point the env mid-process."""
+    try:
+        from flink_ml_tpu.parallel.distributed import process_label
+
+        return process_label()
+    except Exception:
+        return None
+
+
+def artifact_suffix() -> str:
+    """The per-process artifact name suffix: the pid alone in a
+    single-process runtime, ``p<index>-<pid>`` when the runtime spans
+    processes (``jax.process_count() > 1`` or the launcher env —
+    parallel/distributed.py). Two hosts routinely hand out the same pid,
+    so pid-only names silently collide when a multi-process run shares
+    one trace dir: one process's ``metrics-<pid>.json`` overwrites
+    another's and their spans interleave under one pid. Shared by the
+    span sink (tracing.py), the metrics snapshots below and the drift
+    state dump — every writer into a trace dir names files through this
+    one seam."""
+    k = safe_process_label()
+    pid = os.getpid()
+    return f"p{k}-{pid}" if k is not None else str(pid)
+
+
 def dump_metrics(trace_dir: str,
                  registry: MetricsRegistry = metrics) -> str:
-    """Write the registry snapshot as ``metrics-<pid>.json`` (overwrite:
-    the newest snapshot per process supersedes earlier ones). When the
-    drift module is loaded (observability/drift.py — the package import
-    chain loads it; the sys.modules gate only protects embeddings that
-    strip it), its live-sketch state dumps alongside as
-    ``drift-<pid>.json`` — a no-op for processes that never sketched."""
+    """Write the registry snapshot as ``metrics-<pid>.json``
+    (``metrics-p<k>-<pid>.json`` in a multi-process runtime — see
+    :func:`artifact_suffix`; overwrite: the newest snapshot per process
+    supersedes earlier ones). When the drift module is loaded
+    (observability/drift.py — the package import chain loads it; the
+    sys.modules gate only protects embeddings that strip it), its
+    live-sketch state dumps alongside as ``drift-<pid>.json`` — a no-op
+    for processes that never sketched."""
     os.makedirs(trace_dir, exist_ok=True)
-    path = os.path.join(trace_dir, f"metrics-{os.getpid()}.json")
+    path = os.path.join(trace_dir, f"metrics-{artifact_suffix()}.json")
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(registry.snapshot(), f, default=str)

@@ -272,6 +272,23 @@ def record_shard_rows(mesh, n: int, axis_name=None,
     return counts
 
 
+def _global_shard_ordinal(shard, local_i: int) -> int:
+    """The GLOBAL dim-0 shard index of one addressable shard: on a
+    multi-process mesh each process enumerates only its own shards, so
+    the local ordinal would collide across processes in a merged trace
+    (process 0's shard "1" vs process 1's shard "1" are different
+    replicas). Derived from the shard's global slice start / chunk
+    length; falls back to the local ordinal for replicated leaves."""
+    try:
+        sl = shard.index[0]
+        chunk = shard.data.shape[0]
+        if sl.start is not None and chunk:
+            return int(sl.start) // int(chunk)
+    except Exception:
+        pass
+    return local_i
+
+
 def observe_shard_ready(tree, span=None, phase: str = "epoch"
                         ) -> Optional[List[float]]:
     """Per-shard time-to-ready of the first sharded device array in
@@ -300,7 +317,8 @@ def observe_shard_ready(tree, span=None, phase: str = "epoch"
         ms = (time.perf_counter() - t0) * 1000.0
         times.append(ms)
         group.histogram("readyMs", labels={
-            "shard": str(i), "device": str(int(shard.device.id)),
+            "shard": str(_global_shard_ordinal(shard, i)),
+            "device": str(int(shard.device.id)),
             "phase": phase}).observe(ms)
     spread = detect_skew("readyMs", times, floor=_skew_floor_ms(),
                          phase=phase)
@@ -318,23 +336,29 @@ def observe_shard_ready(tree, span=None, phase: str = "epoch"
 def _nonfinite_program(mesh, ndim: int):
     """Per-shard non-finite element counts of a dim-0-sharded array as
     ONE ``(n_shards,)`` output — the count folds inside the shard_map
-    body (JL107-clean), the host fetches one tiny vector."""
+    body (JL107-clean), then all-gathers so the tiny vector comes back
+    REPLICATED: on a multi-process mesh the host can only materialize
+    fully-replicated outputs (a P(data)-sharded result would strand
+    other processes' shards), and single-process the gather of one
+    scalar per shard costs nothing."""
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from flink_ml_tpu.parallel import mapreduce as mr
-    from flink_ml_tpu.parallel.mesh import data_pspec
+    from flink_ml_tpu.parallel.mesh import data_axes, data_pspec
 
     spec0 = data_pspec(mesh)
+    axes = data_axes(mesh)
+    ax = axes[0] if len(axes) == 1 else axes
 
     def per_shard(xl):
         bad = jnp.sum(jnp.logical_not(jnp.isfinite(xl)))
-        return bad.astype(jnp.int32)[None]
+        return mr.all_gather(bad.astype(jnp.int32)[None], ax)
 
     return mr.map_shards(
         per_shard, mesh,
         in_specs=P(spec0, *([None] * (ndim - 1))),
-        out_specs=P(spec0))
+        out_specs=P())
 
 
 def record_input_health(algo: str, mesh, array) -> Optional[List[int]]:

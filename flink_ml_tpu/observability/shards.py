@@ -133,37 +133,52 @@ def shards_summary(spans: List[dict], snapshot: Dict[str, dict],
         e.get("payloadBytes_total", 0) for e in collectives
         if e["op"] in ("psum", "pmean", "pmax", "broadcast",
                        "termination_vote"))
+    # process attribution: mesh.json records each device's owning
+    # process (meshstats.mesh_snapshot), so a merged multi-process trace
+    # resolves every shard row to the host that ran it — same-pid
+    # artifact collisions across hosts are prevented by the file naming
+    # (exporters.artifact_suffix); this is the read-side half
+    dev_proc = {str(d.get("id")): int(d.get("process", 0))
+                for d in (mesh or {}).get("devices", [])}
+    n_procs = len(set(dev_proc.values())) if dev_proc else 1
+
     shard_rows = sorted(rows.values(), key=lambda r: r["shard"])
     for r in shard_rows:
         r.setdefault("rows", None)
         r.setdefault("nonFinite", 0)
         r["bytesReduced"] = reduce_bytes
         r.setdefault("skewFlagged", False)
+        r["process"] = dev_proc.get(str(r.get("device")), 0)
 
     return {"mesh": mesh, "shards": shard_rows, "skew": skew,
             "skew_events": events, "collectives": collectives,
-            "host_ops": host_ops}
+            "host_ops": host_ops, "process_count": n_procs}
 
 
 def render_shards(summary: dict) -> str:
     out = []
     mesh = summary["mesh"]
+    multiproc = summary.get("process_count", 1) > 1
     if mesh:
         axes = ",".join(f"{k}={v}" for k, v in mesh["shape"].items())
+        procs = (f" processes={summary['process_count']}"
+                 if multiproc else "")
         out.append(f"mesh: {mesh['device_count']} device(s) "
-                   f"[{axes}] platform={mesh.get('platform')}")
+                   f"[{axes}] platform={mesh.get('platform')}{procs}")
     else:
         out.append("mesh: no mesh.json artifact (single-device run, or "
                    "trace predates mesh telemetry)")
 
     if summary["shards"]:
         out.append("")
-        out.append(f"  {'shard':>5} {'device':>6} {'rows':>10} "
+        proc_hdr = f" {'proc':>5}" if multiproc else ""
+        out.append(f"  {'shard':>5} {'device':>6}{proc_hdr} {'rows':>10} "
                    f"{'non-finite':>10} {'ready p50':>10} "
                    f"{'ready max':>10} {'bytes reduced':>13} {'skew':>5}")
         for r in summary["shards"]:
+            proc_col = f" {r.get('process', 0):>5}" if multiproc else ""
             out.append(
-                f"  {r['shard']:>5} {r['device']:>6} "
+                f"  {r['shard']:>5} {r['device']:>6}{proc_col} "
                 f"{('-' if r['rows'] is None else r['rows']):>10} "
                 f"{r['nonFinite']:>10} "
                 f"{r.get('readyMs_p50', '-'):>10} "

@@ -248,6 +248,14 @@ class Tracer:
             except ValueError:
                 pass
         record = sp.to_record(os.getpid(), threading.get_ident())
+        from flink_ml_tpu.observability.exporters import (
+            safe_process_label)
+
+        proc = safe_process_label()
+        if proc is not None:
+            # attribution for multi-process trace merges: same-pid span
+            # records from different hosts must not fold into one process
+            record["process"] = proc
         if self.keep_recent:
             self.recent.append(record)  # deque.append is thread-safe
         self._write(record)
@@ -257,7 +265,12 @@ class Tracer:
         d = self.trace_dir
         if not d:
             return None
-        return os.path.join(d, f"spans-{os.getpid()}.jsonl")
+        # multi-process runtimes prefix the process index
+        # (spans-p<k>-<pid>.jsonl): two hosts can share a pid, and the
+        # shared trace dir must keep their streams apart
+        from flink_ml_tpu.observability.exporters import artifact_suffix
+
+        return os.path.join(d, f"spans-{artifact_suffix()}.jsonl")
 
     def _write(self, record: dict) -> None:
         path = self.span_file()

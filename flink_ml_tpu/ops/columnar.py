@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from flink_ml_tpu.parallel.mesh import data_pspec, default_mesh
+from flink_ml_tpu.parallel.mesh import data_pspec, local_mesh
 
 
 def is_device_array(x) -> bool:
@@ -50,7 +50,7 @@ def to_device(x, mesh=None) -> jax.Array:
     """
     if is_device_array(x):
         return x
-    mesh = mesh or default_mesh()
+    mesh = mesh or local_mesh()
     x = np.asarray(x)
     if x.dtype.kind == "f" and x.dtype != np.float32:
         x = x.astype(np.float32)
@@ -72,7 +72,7 @@ def to_device(x, mesh=None) -> jax.Array:
 
 def replicated(c, mesh=None) -> jax.Array:
     """Model statistics / constants: replicated on every device."""
-    mesh = mesh or default_mesh()
+    mesh = mesh or local_mesh()
     c = np.asarray(c)
     if c.dtype.kind == "f" and c.dtype != np.float32:
         c = c.astype(np.float32)
@@ -99,7 +99,7 @@ def apply(fn, x, consts: Sequence = (), static: Tuple = ()):
 def apply_multi(fn, xs: Sequence, consts: Sequence = (), static: Tuple = ()):
     """Like :func:`apply` but with several row-sharded inputs (e.g. the
     Interaction op's input columns): ``fn(*xs, *consts, *static)``."""
-    mesh = default_mesh()
+    mesh = local_mesh()
     xs_d = tuple(to_device(x, mesh) for x in xs)
     consts_d = tuple(replicated(c, mesh) for c in consts)
     n_args = len(xs_d) + len(consts_d) + len(static)

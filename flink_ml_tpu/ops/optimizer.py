@@ -61,13 +61,91 @@ from flink_ml_tpu.parallel.collective import (
 
 @dataclasses.dataclass(frozen=True)
 class SGDParams:
-    """Ref: the SGDParams POJO consumed by SGD (SGD.java:67)."""
+    """Ref: the SGDParams POJO consumed by SGD (SGD.java:67), extended
+    with the stateful update rules (``method``): the reference's SGD is
+    the stateless ``w -= lr/totalW · grad``; ``momentum`` and ``adam``
+    carry per-coordinate moment accumulators through the fit — and
+    under the cross-replica sharded update (update_sharding.py,
+    arXiv:2004.13336) those accumulators live as ``1/N`` per-replica
+    slices, which is the whole point: optimizer-state memory that
+    scales DOWN with the mesh."""
     learning_rate: float = 0.1
     global_batch_size: int = 32
     max_iter: int = 20
     tol: float = 1e-6
     reg: float = 0.0
     elastic_net: float = 0.0
+    #: update rule: "sgd" (stateless), "momentum", "adam"
+    method: str = "sgd"
+    momentum: float = 0.9
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+
+
+#: moment VECTORS each rule carries (adam additionally carries the
+#: scalar step counter for bias correction — see _opt_init)
+_OPT_VECTORS = {"sgd": 0, "momentum": 1, "adam": 2}
+
+
+def _check_method(prm: SGDParams) -> None:
+    if prm.method not in _OPT_VECTORS:
+        raise ValueError(
+            f"SGDParams.method must be one of {sorted(_OPT_VECTORS)}, "
+            f"got {prm.method!r}")
+
+
+def _update_rule(prm: SGDParams, xp=jnp):
+    """The per-coordinate update rule ``rule(grad_sum, total_w, w, opt)
+    -> (w_new, opt_new)`` — elementwise along dim 0, so the SAME
+    callable applies to the full replicated vector and to a replica's
+    ``1/N`` slice under the sharded update, and (with ``xp=np``) to the
+    host CSR path, keeping dense/sparse/sharded fits numerically
+    aligned by construction. ``opt`` is the rule's moment state: ``()``
+    for sgd, ``(m,)`` for momentum, ``(m, v, t)`` for adam (t is the
+    replicated bias-correction step counter — never sliced).
+    Regularization is applied by the caller AFTER the rule
+    (SGD.java:231-243 order, shared by every method)."""
+    _check_method(prm)
+    lr = prm.learning_rate
+    if prm.method == "sgd":
+        def rule(grad, total_w, w, opt):
+            # the exact historical expression — the replicated sgd path
+            # must stay bit-identical to the pre-stateful programs
+            return w - (lr / xp.maximum(total_w, 1e-30)) * grad, opt
+    elif prm.method == "momentum":
+        mu = prm.momentum
+
+        def rule(grad, total_w, w, opt):
+            g = grad / xp.maximum(total_w, 1e-30)
+            m = mu * opt[0] + g
+            return w - lr * m, (m,)
+    else:  # adam
+        b1, b2, eps = prm.beta1, prm.beta2, prm.eps
+
+        def rule(grad, total_w, w, opt):
+            g = grad / xp.maximum(total_w, 1e-30)
+            m, v, t = opt
+            t = t + 1.0
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * (g * g)
+            m_hat = m / (1.0 - b1 ** t)
+            v_hat = v / (1.0 - b2 ** t)
+            return w - lr * m_hat / (xp.sqrt(v_hat) + eps), (m, v, t)
+    return rule
+
+
+def _opt_specs(prm: SGDParams, wspec, spec0, sharded: bool):
+    """shard_map in/out specs for the opt-state tuple: moment vectors
+    follow the coefficient placement — replicated (or model-sharded
+    under TP) normally, dim-0-sharded ``1/N`` slices under the sharded
+    update (they never all-gather: this is the 1/N memory) — and adam's
+    step counter is always a replicated scalar."""
+    vec = P(spec0) if sharded else wspec
+    specs = (vec,) * _OPT_VECTORS[prm.method]
+    if prm.method == "adam":
+        specs = specs + (P(),)
+    return specs
 
 
 def _sgd_update_math(loss_func, prm: SGDParams, axes, model_axis=None,
@@ -79,52 +157,59 @@ def _sgd_update_math(loss_func, prm: SGDParams, axes, model_axis=None,
     unrolled and host-driven programs so a change here propagates to
     every fit path.
 
-    Returns ``(update, apply_packed)``: ``update(coeffs, xb, yb, wb) ->
-    (new_coeffs, mean_loss)`` for the slice-based rounds, and
-    ``apply_packed(coeffs, packed_local) -> (new_coeffs, mean_loss)`` for
-    rounds whose local [grad | weight | loss] partials come from the
-    fused pallas kernel — the cross-shard reduction and the model update
-    are this one shared tail either way. Must be called inside a
+    Returns ``(update, apply_packed)``: ``update(coeffs, opt, xb, yb,
+    wb) -> (new_coeffs, new_opt, mean_loss)`` for the slice-based
+    rounds, and ``apply_packed(coeffs, opt, packed_local) ->
+    (new_coeffs, new_opt, mean_loss)`` for rounds whose local
+    [grad | weight | loss] partials come from the fused pallas kernel —
+    the cross-shard reduction and the model update are this one shared
+    tail either way. ``opt`` is the stateful rule's moment tuple
+    (:func:`_update_rule`): ``()`` for plain sgd, so the stateless
+    programs carry nothing. Must be called inside a
     ``mapreduce.map_shards`` body over the mesh's data ``axes``.
 
     With ``sharded`` (update_sharding.py, DP meshes only) the tail is
     the cross-replica sharded update: the gradient reduce-scatters so
     each replica updates only its own ``1/N`` coefficient slice
     (regularization included — it is elementwise), then the fresh
-    coefficients all-gather; the scalar [weight | loss] tail still
+    coefficients all-gather — while the moment slices (momentum's m,
+    adam's m/v) STAY sharded across rounds, the 1/N optimizer memory of
+    arXiv:2004.13336; the scalar [weight | loss] tail still
     all-reduces. The coefficient carry must be padded to the shard
     multiple (``optimize`` does). Results match the replicated tail up
     to float reassociation in the reduction order."""
+    rule = _update_rule(prm)
 
-    def apply_packed(coeffs, packed_local):
+    def apply_packed(coeffs, opt, packed_local):
         if sharded:
             tail = mr.reduce_sum(packed_local[-2:], axes)
             total_w, total_loss = tail[0], tail[1]
             grad_pad = _upd.pad_leading(packed_local[:-2], coeffs.shape[0])
 
-            def apply_fn(g_slice, c_slice, _state):
-                upd = c_slice - (prm.learning_rate
-                                 / jnp.maximum(total_w, 1e-30)) * g_slice
+            def apply_fn(g_slice, c_slice, opt_state):
+                upd, new_opt = rule(g_slice, total_w, c_slice, opt_state)
                 upd, _ = regularize(upd, prm.reg, prm.elastic_net,
                                     prm.learning_rate)
-                return upd, None
+                return upd, new_opt
 
-            updated, _ = _upd.sharded_apply(axes, grad_pad, coeffs, None,
-                                            apply_fn)
+            updated, new_opt = _upd.sharded_apply(axes, grad_pad, coeffs,
+                                                  opt, apply_fn)
         else:
             packed = mr.reduce_sum(packed_local, axes)
             grad, total_w, total_loss = packed[:-2], packed[-2], packed[-1]
 
             # ref updateModel (SGD.java:231-243); skip when no weight
-            updated = coeffs - (prm.learning_rate
-                                / jnp.maximum(total_w, 1e-30)) * grad
+            updated, new_opt = rule(grad, total_w, coeffs, opt)
             updated, _ = regularize(updated, prm.reg, prm.elastic_net,
                                     prm.learning_rate)
         coeffs_out = jnp.where(total_w > 0, updated, coeffs)
+        # a zero-weight round must leave the moments untouched too
+        opt_out = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(total_w > 0, n, o), new_opt, opt)
         mean_loss = total_loss / jnp.maximum(total_w, 1e-30)
-        return coeffs_out, mean_loss
+        return coeffs_out, opt_out, mean_loss
 
-    def update(coeffs, xb, yb, wb):
+    def update(coeffs, opt, xb, yb, wb):
         if model_axis is None:
             d = xb.shape[1]  # == coeffs length unless sharded padding
             loss_sum, grad_sum = loss_func.loss_and_gradient(coeffs[:d],
@@ -136,7 +221,7 @@ def _sgd_update_math(loss_func, prm: SGDParams, axes, model_axis=None,
         packed = jnp.concatenate([
             grad_sum, jnp.sum(wb)[None].astype(grad_sum.dtype),
             loss_sum[None]])
-        return apply_packed(coeffs, packed)
+        return apply_packed(coeffs, opt, packed)
 
     return update, apply_packed
 
@@ -147,10 +232,10 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
     all-device while_loop program and the host-driven round program so the
     two modes stay numerically identical by construction.
 
-    Returns ``round(xl, yl, wl, coeffs, offset) ->
-    (coeffs, new_offset, mean_loss)`` operating on this shard's slice;
-    must be called inside shard_map over the mesh's data axes (``axes`` —
-    a flat ("data",) mesh or a ("dcn", "data") hybrid).
+    Returns ``round(xl, yl, wl, coeffs, opt, offset) ->
+    (coeffs, opt, new_offset, mean_loss)`` operating on this shard's
+    slice; must be called inside shard_map over the mesh's data axes
+    (``axes`` — a flat ("data",) mesh or a ("dcn", "data") hybrid).
 
     With ``model_axis`` (tensor parallelism for wide models — a TPU-native
     capability beyond the reference's DP-only design), the feature
@@ -164,7 +249,7 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
     update, _ = _sgd_update_math(loss_func, prm, axes, model_axis,
                                  sharded=sharded)
 
-    def round_step(xl, yl, wl, coeffs, offset):
+    def round_step(xl, yl, wl, coeffs, opt, offset):
         local_n = xl.shape[0]  # static at trace time
         lb_max = min(lb_base + (1 if lb_rem else 0), local_n)
         task_id = mr.shard_index(axes)
@@ -189,9 +274,9 @@ def _sgd_round_math(loss_func, prm: SGDParams, p: int, axes,
         valid = jnp.logical_and(src >= offset, src < offset + lb)
         wb = ws * valid.astype(xl.dtype)
 
-        coeffs, mean_loss = update(coeffs, xb, yb, wb)
+        coeffs, opt, mean_loss = update(coeffs, opt, xb, yb, wb)
         new_offset = jnp.where(offset + lb >= local_n, 0, offset + lb)
-        return coeffs, new_offset, mean_loss
+        return coeffs, opt, new_offset, mean_loss
 
     return round_step
 
@@ -202,13 +287,20 @@ def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams,
                                sharded: bool = False,
                                fused: bool = False):
     """A K-round slice of the training loop as ONE compiled SPMD program:
-    ``segment(xs, ys, ws, coeffs, offsets, epoch0, limit, hist, fin) ->
-    (coeffs, offsets, mean_loss, epoch, stop, hist, fin)``.  The epoch
-    bounds are device scalars, so every segment of a checkpointed fit
-    reuses a single compilation; between segments the host snapshots the
-    carry (iteration.run_segmented) — fault tolerance at fast-path
-    speed, the composition the reference gets from checkpointing
-    *through* the iteration (Checkpoints.java:43).
+    ``segment(xs, ys, ws, coeffs, offsets, opt, epoch0, limit, hist,
+    fin) -> (coeffs, offsets, opt, mean_loss, epoch, stop, hist, fin)``.
+    The epoch bounds are device scalars, so every segment of a
+    checkpointed fit reuses a single compilation; between segments the
+    host snapshots the carry (iteration.run_segmented) — fault tolerance
+    at fast-path speed, the composition the reference gets from
+    checkpointing *through* the iteration (Checkpoints.java:43).
+
+    ``opt`` is the stateful rule's moment tuple (:func:`_update_rule`):
+    ``()`` for plain sgd — the stateless signature carries nothing — and
+    (m,) / (m, v, t) for momentum / adam, donated with the carry; under
+    the sharded update the moment vectors are dim-0-sharded ``1/N``
+    slices that never leave their replicas between rounds
+    (arXiv:2004.13336 — the 1/N optimizer memory).
 
     The plain (uncheckpointed) fit is the degenerate call
     ``segment(..., epoch0=0, limit=max_iter)`` — ONE program serves both,
@@ -220,20 +312,18 @@ def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams,
     ``(max_iter, 3)`` carry — the DrJAX-style first-class numeric
     output) and folds ONE non-finite sentinel scalar into ``fin``; the
     host reads both only at segment boundaries, so telemetry adds zero
-    extra device syncs. Without ``health`` the signature is EXACTLY the
-    pre-health 7-in/5-out contract (external callers — the TPU
-    profiling scripts — build with the default flag).
+    extra device syncs.
 
     With ``fused`` (iteration.segment_fusion_enabled) the per-boundary
     scalars come back STACKED as one int32 vector — ``[epoch, stop]``,
     or ``[epoch, stop, fin]`` with health — so the host pays ONE
     device→host transfer per segment boundary instead of one per
-    scalar; the outputs become ``(coeffs, offsets, mean_loss, bundle)``
-    (+ ``hist`` with health). The (coeffs, offsets) carry — and the
-    hist buffer with health — is DONATED in every build (the in-place
-    update of the raw-speed ladder); sharded builds additionally route
-    through ``instrumented_jit`` via their name for per-function
-    compile accounting."""
+    scalar; the outputs become ``(coeffs, offsets, opt, mean_loss,
+    bundle)`` (+ ``hist`` with health). The (coeffs, offsets, opt)
+    carry — and the hist buffer with health — is DONATED in every build
+    (the in-place update of the raw-speed ladder); sharded builds
+    additionally route through ``instrumented_jit`` via their name for
+    per-function compile accounting."""
     axes = data_axes(mesh)
     spec0 = data_pspec(mesh)
     p = data_shard_count(mesh)
@@ -241,66 +331,70 @@ def _build_sgd_segment_program(loss_cls, mesh: Mesh, prm: SGDParams,
     wspec = P(model_axis) if model_axis else P()
     round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis,
                                  sharded=sharded)
+    opt_specs = _opt_specs(prm, wspec, spec0, sharded)
 
-    def run(xl, yl, wl, coeffs, offsets, epoch0, limit, hist, fin):
+    def run(xl, yl, wl, coeffs, offsets, opt, epoch0, limit, hist, fin):
         def cond(state):
-            _, _, _, epoch, stop, _, _ = state
+            epoch, stop = state[4], state[5]
             return jnp.logical_and(epoch < limit, jnp.logical_not(stop))
 
         def step(state):
-            coeffs, offset, _, epoch, _, hist, fin = state
-            new_coeffs, new_offset, mean_loss = round_step(
-                xl, yl, wl, coeffs, offset)
+            coeffs, offset, opt, _, epoch, _, hist, fin = state
+            new_coeffs, new_opt, new_offset, mean_loss = round_step(
+                xl, yl, wl, coeffs, opt, offset)
             if health:
                 row, row_fin = _health.convergence_row(
                     mean_loss, coeffs, new_coeffs, model_axis)
                 hist = jax.lax.dynamic_update_slice(
                     hist, row[None], (epoch, jnp.int32(0)))
                 fin = jnp.logical_and(fin, row_fin)
-            return (new_coeffs, new_offset, mean_loss, epoch + 1,
-                    mean_loss < prm.tol, hist, fin)
+            return (new_coeffs, new_offset, new_opt, mean_loss,
+                    epoch + 1, mean_loss < prm.tol, hist, fin)
 
-        init = (coeffs, offsets[0], jnp.asarray(jnp.inf, coeffs.dtype),
+        init = (coeffs, offsets[0], opt,
+                jnp.asarray(jnp.inf, coeffs.dtype),
                 epoch0, jnp.asarray(False), hist, fin)
-        coeffs, offset, mean_loss, epoch, stop, hist, fin = \
+        coeffs, offset, opt, mean_loss, epoch, stop, hist, fin = \
             jax.lax.while_loop(cond, step, init)
-        return coeffs, offset[None], mean_loss, epoch, stop, hist, fin
+        return (coeffs, offset[None], opt, mean_loss, epoch, stop, hist,
+                fin)
 
     if health:
-        def per_shard(xl, yl, wl, coeffs, offsets, epoch0, limit, hist,
-                      fin):
-            out = run(xl, yl, wl, coeffs, offsets, epoch0, limit, hist,
-                      fin)
+        def per_shard(xl, yl, wl, coeffs, offsets, opt, epoch0, limit,
+                      hist, fin):
+            out = run(xl, yl, wl, coeffs, offsets, opt, epoch0, limit,
+                      hist, fin)
             if not fused:
                 return out
-            coeffs, offsets, mean_loss, epoch, stop, hist, fin = out
+            coeffs, offsets, opt, mean_loss, epoch, stop, hist, fin = out
             bundle = jnp.stack([epoch, stop.astype(jnp.int32),
                                 fin.astype(jnp.int32)])
-            return coeffs, offsets, mean_loss, bundle, hist
+            return coeffs, offsets, opt, mean_loss, bundle, hist
 
         extra_in = (P(), P())
         extra_out = (P(),) if fused else (P(), P())
-        donate = (3, 4, 7)
+        donate = (3, 4, 5, 8)
     else:
-        def per_shard(xl, yl, wl, coeffs, offsets, epoch0, limit):
-            out = run(xl, yl, wl, coeffs, offsets, epoch0, limit,
+        def per_shard(xl, yl, wl, coeffs, offsets, opt, epoch0, limit):
+            out = run(xl, yl, wl, coeffs, offsets, opt, epoch0, limit,
                       jnp.zeros((0, 3), jnp.float32),
-                      jnp.asarray(True))[:5]
+                      jnp.asarray(True))[:6]
             if not fused:
                 return out
-            coeffs, offsets, mean_loss, epoch, stop = out
+            coeffs, offsets, opt, mean_loss, epoch, stop = out
             bundle = jnp.stack([epoch, stop.astype(jnp.int32)])
-            return coeffs, offsets, mean_loss, bundle
+            return coeffs, offsets, opt, mean_loss, bundle
 
         extra_in, extra_out = (), ()
-        donate = (3, 4)
+        donate = (3, 4, 5)
 
     scalar_out = (P(),) if fused else (P(), P())
     return mr.map_shards(
         per_shard, mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
-                  P(spec0), P(), P()) + extra_in,
-        out_specs=(wspec, P(spec0), P()) + scalar_out + extra_out,
+                  P(spec0), opt_specs, P(), P()) + extra_in,
+        out_specs=(wspec, P(spec0), opt_specs, P()) + scalar_out
+        + extra_out,
         donate_argnums=donate,
         name="sgd.segment" if sharded else None)
 
@@ -339,18 +433,19 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
                                 health: bool = False,
                                 sharded: bool = False):
     """The plain (uncheckpointed, fresh-offset) fit as ONE fully-unrolled
-    SPMD program: ``fit(xs, ys, ws, coeffs, offsets) -> (coeffs, offsets,
-    mean_loss, epoch, stop)`` — the same carry as the segment program.
-    The tol early-exit becomes masking (rounds after the stop compute
-    and are discarded by ``where``), so the result — coeffs, final
-    offsets, the loss AT the stopping round, the executed-round count —
-    is identical to the while program's by construction. Only valid for
-    offsets == 0 and gb %% p == 0 (the dispatch in ``optimize``
-    guarantees both). With ``health`` the outputs grow ``(..., hist,
-    fin)``: the stacked per-round ``(max_iter, 3)`` convergence rows
-    (NaN past the stopping round) and the single non-finite sentinel
-    folded over the executed rounds (observability/health.py); without
-    it the pre-health 5-output contract is unchanged.
+    SPMD program: ``fit(xs, ys, ws, coeffs, offsets, opt) -> (coeffs,
+    offsets, opt, mean_loss, epoch, stop)`` — the same carry as the
+    segment program (``opt`` = the stateful rule's moment tuple, ``()``
+    for plain sgd). The tol early-exit becomes masking (rounds after
+    the stop compute and are discarded by ``where`` — moments
+    included), so the result — coeffs, final offsets, the loss AT the
+    stopping round, the executed-round count — is identical to the
+    while program's by construction. Only valid for offsets == 0 and
+    gb %% p == 0 (the dispatch in ``optimize`` guarantees both). With
+    ``health`` the outputs grow ``(..., hist, fin)``: the stacked
+    per-round ``(max_iter, 3)`` convergence rows (NaN past the stopping
+    round) and the single non-finite sentinel folded over the executed
+    rounds (observability/health.py).
 
     With ``use_kernel`` (TPU, DP-only mesh), rounds whose window aligns
     to a shared tile run the fused pallas batch-terms kernel — one pass
@@ -367,8 +462,9 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
     assert prm.global_batch_size % p == 0
     update, apply_packed = _sgd_update_math(loss_cls(), prm, axes,
                                             model_axis, sharded=sharded)
+    opt_specs = _opt_specs(prm, wspec, spec0, sharded)
 
-    def per_shard(xl, yl, wl, coeffs, offsets):
+    def per_shard(xl, yl, wl, coeffs, offsets, opt):
         local_n = xl.shape[0]
         lb = min(lb_base, local_n)
         tile = 0
@@ -391,14 +487,16 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
                 packed = sgd_batch_terms(xl, yl, wl,
                                          coeffs[:xl.shape[1]], start,
                                          clip, lb, tile, loss_cls.NAME)
-                updated, new_loss = apply_packed(coeffs, packed)
+                updated, new_opt, new_loss = apply_packed(coeffs, opt,
+                                                          packed)
             else:
                 xb = jax.lax.slice_in_dim(xl, start, start + lb, axis=0)
                 yb = jax.lax.slice_in_dim(yl, start, start + lb, axis=0)
                 wb = jax.lax.slice_in_dim(wl, start, start + lb, axis=0)
                 if clip:  # short batch at the end: clipped rows weigh 0
                     wb = wb * (np.arange(lb) >= clip).astype(xl.dtype)
-                updated, new_loss = update(coeffs, xb, yb, wb)
+                updated, new_opt, new_loss = update(coeffs, opt, xb, yb,
+                                                    wb)
             new_off = jnp.int32(0 if start + clip + lb >= local_n
                                 else start + clip + lb)
             active = jnp.logical_not(stop)
@@ -414,26 +512,28 @@ def _build_sgd_unrolled_program(loss_cls, mesh: Mesh, prm: SGDParams,
                 fin = jnp.logical_and(fin, jnp.logical_or(
                     jnp.logical_not(active), row_fin))
             coeffs = jnp.where(active, updated, coeffs)
+            opt = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(active, n, o), new_opt, opt)
             offset = jnp.where(active, new_off, offset)
             mean_loss = jnp.where(active, new_loss, mean_loss)
             epoch = epoch + active.astype(jnp.int32)
             stop = jnp.logical_or(stop, jnp.logical_and(
                 active, new_loss < prm.tol))
         if health:
-            return (coeffs, offset[None], mean_loss, epoch, stop,
+            return (coeffs, offset[None], opt, mean_loss, epoch, stop,
                     jnp.stack(rows), fin)
-        return coeffs, offset[None], mean_loss, epoch, stop
+        return coeffs, offset[None], opt, mean_loss, epoch, stop
 
-    # the (coeffs, offsets) carry donates in EVERY build — the update
-    # happens in place in the donated buffers; callers rebuild the carry
-    # on the pallas-fallback retry (make_init in optimize)
+    # the (coeffs, offsets, opt) carry donates in EVERY build — the
+    # update happens in place in the donated buffers; callers rebuild
+    # the carry on the pallas-fallback retry (make_init in optimize)
     return mr.map_shards(
         per_shard, mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
-                  P(spec0)),
-        out_specs=(wspec, P(spec0), P(), P(), P())
+                  P(spec0), opt_specs),
+        out_specs=(wspec, P(spec0), opt_specs, P(), P(), P())
         + ((P(), P()) if health else ()),
-        donate_argnums=(3, 4),
+        donate_argnums=(3, 4, 5),
         name="sgd.unrolled" if sharded else None)
 
 
@@ -452,17 +552,18 @@ def _build_sgd_round_program(loss_cls, mesh: Mesh, prm: SGDParams,
     wspec = P(model_axis) if model_axis else P()
     round_step = _sgd_round_math(loss_cls(), prm, p, axes, model_axis,
                                  sharded=sharded)
+    opt_specs = _opt_specs(prm, wspec, spec0, sharded)
 
-    def per_shard(xl, yl, wl, coeffs, offsets):
-        coeffs, new_offset, mean_loss = round_step(xl, yl, wl, coeffs,
-                                                   offsets[0])
-        return coeffs, new_offset[None], mean_loss
+    def per_shard(xl, yl, wl, coeffs, offsets, opt):
+        coeffs, opt, new_offset, mean_loss = round_step(
+            xl, yl, wl, coeffs, opt, offsets[0])
+        return coeffs, new_offset[None], mean_loss, opt
 
     return mr.map_shards(
         per_shard, mesh,
         in_specs=(P(spec0, model_axis), P(spec0), P(spec0), wspec,
-                  P(spec0)),
-        out_specs=(wspec, P(spec0), P()), jit=False)
+                  P(spec0), opt_specs),
+        out_specs=(wspec, P(spec0), P(), opt_specs), jit=False)
 
 
 @functools.lru_cache(maxsize=128)
@@ -553,8 +654,11 @@ class SGD:
              else np.asarray(weights, np.float64))
         X = features_csr.tocsr()
 
+        _check_method(prm)
+        rule = _update_rule(prm, xp=np)
+
         def round_body(carry, epoch):
-            coeffs, offsets, _ = carry
+            coeffs, offsets, _, opt = carry
             offsets = offsets.copy()  # carry is functional (checkpointable)
             row_parts = []
             for s in range(p):
@@ -572,13 +676,13 @@ class SGD:
             grad = Xb.T @ np.asarray(multipliers, np.float64)
             total_w = float(wb.sum())
             if total_w > 0:
-                updated = coeffs - (prm.learning_rate
-                                    / max(total_w, 1e-30)) * grad
+                updated, opt = rule(grad, np.float64(total_w), coeffs,
+                                    opt)
                 updated, _ = regularize(updated, prm.reg, prm.elastic_net,
                                         prm.learning_rate, xp=np)
                 coeffs = np.asarray(updated, np.float64)
             mean_loss = loss_sum / max(total_w, 1e-30)
-            return coeffs, offsets, np.float64(mean_loss)
+            return coeffs, offsets, np.float64(mean_loss), opt
 
         from flink_ml_tpu.iteration.iteration import iterate_bounded
 
@@ -590,9 +694,13 @@ class SGD:
             listeners = tuple(listeners) + (
                 _health.ConvergenceListener.for_params(algo, init_coeffs),)
 
+        opt0 = tuple(np.zeros(d, np.float64)
+                     for _ in range(_OPT_VECTORS[prm.method]))
+        if prm.method == "adam":
+            opt0 = opt0 + (np.float64(0.0),)
         init = (np.asarray(init_coeffs, np.float64).copy(),
-                np.zeros(p, np.int64), np.float64(np.inf))
-        coeffs, _, mean_loss = iterate_bounded(
+                np.zeros(p, np.int64), np.float64(np.inf), opt0)
+        coeffs, _, mean_loss, _ = iterate_bounded(
             init, round_body, max_iter=prm.max_iter,
             terminate=lambda carry, epoch: carry[2] < prm.tol,
             config=config, listeners=listeners, jit_round=False)
@@ -687,13 +795,25 @@ class SGD:
         spec0 = data_pspec(mesh)
 
         # carry leaves must live on the full mesh (replicated or
-        # model-sharded coeffs, per-task offsets) — both for the
-        # mapped round/segment and so that checkpoint restore
-        # re-places leaves onto the right shardings. A closure, not a
+        # model-sharded coeffs, per-task offsets, moment vectors sharded
+        # 1/N under the sharded update) — both for the mapped
+        # round/segment and so that checkpoint restore re-places leaves
+        # onto the right shardings (a sharded-adam resume puts each
+        # moment slice back on its owning replica). A closure, not a
         # tuple: the compiled programs DONATE the carry, so the pallas
         # fallback retry must rebuild it rather than re-pass consumed
-        # buffers.
+        # buffers. The opt tuple rides at the END of the carry so a
+        # method="sgd" checkpoint keeps the stateless-era leaf order.
         def make_init():
+            opt_sharding = (NamedSharding(mesh, P(spec0)) if sharded
+                            else w_sharding)
+            opt = tuple(
+                jax.device_put(jnp.zeros(init_coeffs.shape[0], dtype),
+                               opt_sharding)
+                for _ in range(_OPT_VECTORS[self.params.method]))
+            if self.params.method == "adam":
+                opt = opt + (jax.device_put(jnp.asarray(0.0, dtype),
+                                            NamedSharding(mesh, P())),)
             return (
                 jax.device_put(jnp.asarray(init_coeffs, dtype),
                                w_sharding),
@@ -701,16 +821,25 @@ class SGD:
                                NamedSharding(mesh, P(spec0))),
                 jax.device_put(jnp.asarray(jnp.inf, dtype),
                                NamedSharding(mesh, P())),
+                opt,
             )
 
+        _check_method(self.params)
         init = make_init()
         w0 = init[0]
         # per-replica update-state accounting (benchmark provenance):
         # measured from the carry's real buffers — SGD's coefficients
         # all-gather back to replicated every round, so this honestly
-        # reports full size even under the sharded update (only
-        # persistent sharded state like FTRL's z/n shrinks 1/N)
-        _upd.record_state_bytes(algo, (w0,), p, sharded)
+        # reports full size even under the sharded update; the moment
+        # vectors are the state that genuinely shrinks 1/N (their
+        # slices never all-gather), recorded both folded into the algo
+        # total and as a standalone ".moments" record so the multihost
+        # bench can gate on the moment bytes alone
+        opt_leaves = list(jax.tree_util.tree_leaves(init[3]))
+        if opt_leaves:
+            _upd.record_state_bytes(f"{algo}.moments", opt_leaves, p,
+                                    sharded)
+        _upd.record_state_bytes(algo, [w0] + opt_leaves, p, sharded)
 
         seg_k = device_checkpoint_segment(config, listeners)
         if seg_k or not needs_host_loop(config, listeners):
@@ -736,9 +865,9 @@ class SGD:
                         sharded=sharded)
                     # materialize INSIDE the try: async dispatch surfaces
                     # kernel-execution failures only here
-                    res = prog(xs, ys, ws, init[0], init[1])
-                    coeffs, _, mean_loss, epoch, _ = res[:5]
-                    hist, fin = (res[5:] if health_on else (None, True))
+                    res = prog(xs, ys, ws, init[0], init[1], init[3])
+                    coeffs, _, _, mean_loss, epoch, _ = res[:6]
+                    hist, fin = (res[6:] if health_on else (None, True))
                     self.last_execution_path = (
                         "pallas-unrolled" if use_kernel else "xla-unrolled")
                     out = np.asarray(coeffs, np.float64)[:d]
@@ -759,11 +888,11 @@ class SGD:
                         use_kernel=False, health=health_on,
                         sharded=sharded)
                     # the failed attempt may have consumed the donated
-                    # carry (sharded programs donate it) — rebuild
+                    # carry (the programs donate it) — rebuild
                     init = make_init()
-                    res = prog(xs, ys, ws, init[0], init[1])
-                    coeffs, _, mean_loss, epoch, _ = res[:5]
-                    hist, fin = (res[5:] if health_on else (None, True))
+                    res = prog(xs, ys, ws, init[0], init[1], init[3])
+                    coeffs, _, _, mean_loss, epoch, _ = res[:6]
+                    hist, fin = (res[6:] if health_on else (None, True))
                 self.last_execution_path = "xla-unrolled"
                 out = np.asarray(coeffs, np.float64)[:d]
                 _finish_fit_health(algo, health_on, hist, fin, epoch,
@@ -790,24 +919,24 @@ class SGD:
             }
 
             def run_segment(carry, epoch0, limit):
-                coeffs, offsets, _ = carry
+                coeffs, offsets, _, opt = carry
                 if hstate["first"] is None:
                     hstate["first"] = int(epoch0)
                 if health_on:
                     out = seg_prog(
-                        xs, ys, ws, coeffs, offsets,
+                        xs, ys, ws, coeffs, offsets, opt,
                         jnp.int32(epoch0), jnp.int32(limit),
                         hstate["hist"], jnp.asarray(bool(hstate["fin"])))
                     if fused:
                         # ONE stacked [epoch, stop, fin] transfer per
                         # boundary instead of three scalar fetches
-                        (coeffs, offsets, mean_loss, bundle,
+                        (coeffs, offsets, opt, mean_loss, bundle,
                          hstate["hist"]) = out
                         vals = read_boundary(bundle)
                         epoch, stop = int(vals[0]), bool(vals[1])
                         hstate["fin"] = bool(vals[2])
                     else:
-                        (coeffs, offsets, mean_loss, epoch, stop,
+                        (coeffs, offsets, opt, mean_loss, epoch, stop,
                          hstate["hist"], fin) = out
                         vals = read_boundary((epoch, stop, fin))
                         epoch, stop = int(vals[0]), bool(vals[1])
@@ -825,23 +954,24 @@ class SGD:
                             epoch0=hstate["first"])
                 else:
                     out = seg_prog(
-                        xs, ys, ws, coeffs, offsets,
+                        xs, ys, ws, coeffs, offsets, opt,
                         jnp.int32(epoch0), jnp.int32(limit))
                     if fused:
-                        coeffs, offsets, mean_loss, bundle = out
+                        coeffs, offsets, opt, mean_loss, bundle = out
                         vals = read_boundary(bundle)
                     else:
-                        coeffs, offsets, mean_loss, epoch, stop = out
+                        (coeffs, offsets, opt, mean_loss, epoch,
+                         stop) = out
                         vals = read_boundary((epoch, stop))
                     epoch, stop = int(vals[0]), bool(vals[1])
-                return (coeffs, offsets, mean_loss), epoch, stop
+                return (coeffs, offsets, mean_loss, opt), epoch, stop
 
             if seg_k:
-                coeffs, _, mean_loss = run_segmented(
+                coeffs, _, mean_loss, _ = run_segmented(
                     run_segment, init, self.params.max_iter, seg_k,
                     config.checkpoint_manager)
             else:
-                (coeffs, _, mean_loss), _, _ = run_segment(
+                (coeffs, _, mean_loss, _), _, _ = run_segment(
                     init, 0, self.params.max_iter)
             self.last_execution_path = ("xla-while-segments" if seg_k
                                         else "xla-while")
@@ -858,10 +988,11 @@ class SGD:
                                             self.params, sharded=sharded)
 
         def body(carry, epoch):
-            coeffs, offsets, _ = carry
-            coeffs, offsets, mean_loss = round_fn(xs, ys, ws, coeffs,
-                                                  offsets)
-            return coeffs, offsets, mean_loss
+            coeffs, offsets, _, opt = carry
+            coeffs, offsets, mean_loss, opt = round_fn(xs, ys, ws,
+                                                       coeffs, offsets,
+                                                       opt)
+            return coeffs, offsets, mean_loss, opt
 
         if health_on:
             # host-driven rounds: the health series rides an extra
@@ -876,7 +1007,7 @@ class SGD:
             init, body, max_iter=self.params.max_iter,
             terminate=lambda carry, epoch: carry[2] < self.params.tol,
             config=config, listeners=listeners)
-        coeffs, _, mean_loss = final
+        coeffs, _, mean_loss, _ = final
         self.last_execution_path = "host-rounds"
         out = np.asarray(coeffs, np.float64)[:d]
         if not health_on:

@@ -41,3 +41,5 @@ from flink_ml_tpu.parallel.mapreduce import (  # noqa: F401
     map_shards,
 )
 from flink_ml_tpu.parallel import update_sharding  # noqa: F401
+from flink_ml_tpu.parallel import distributed  # noqa: F401
+from flink_ml_tpu.parallel.distributed import build_mesh  # noqa: F401

@@ -30,7 +30,9 @@ opMs{op=,devices=}`` histogram and, when tracing is armed, a
 from __future__ import annotations
 
 import functools
+import os
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -45,6 +47,14 @@ from flink_ml_tpu.parallel.shardmap import axis_size  # noqa: F401 — re-export
 #: buckets are latency-shaped)
 PAYLOAD_BUCKETS = (256.0, 4096.0, 65536.0, 1048576.0, 16777216.0,
                    268435456.0, 4294967296.0)
+
+#: env var: force the hierarchical two-level reduce on ("1") or off
+#: ("0"); unset/other = auto (on when the runtime spans processes).
+#: Read at program TRACE time: already-compiled (lru-cached) fit
+#: programs keep the structure they were traced with, so set it before
+#: the first fit — the multihost bench runs each mode in its own
+#: process for exactly this reason.
+HIER_ENV = "FLINK_ML_TPU_HIER_REDUCE"
 
 
 def _collective_group():
@@ -87,16 +97,111 @@ def _note_traced(op: str, x, axis_name) -> None:
         pass
 
 
+def _note_level(op: str, level: str, x, axes) -> None:
+    """Trace-time per-LEVEL payload accounting of the two-level reduce
+    topology (``ml.collective levelPayloadBytes{op=,level=,axis=}``):
+    ``level="inter"`` bytes cross the slow outer fabric (DCN / the
+    inter-process network), ``level="intra"`` bytes stay on the fast
+    local axis. The multihost bench gates on the inter sum — the
+    hierarchical decomposition must record strictly fewer inter bytes
+    than the flat psum it replaces. Never raises."""
+    try:
+        labels = {"op": op, "level": level,
+                  "axis": ",".join(str(a) for a in axes)}
+        group = _collective_group()
+        group.counter("levelOps", labels=labels)
+        group.histogram("levelPayloadBytes", buckets=PAYLOAD_BUCKETS,
+                        labels=labels).observe(_payload_bytes(x))
+    except Exception:
+        pass
+
+
+def hier_reduce_forced() -> Optional[bool]:
+    """The ``FLINK_ML_TPU_HIER_REDUCE`` override: True/False when the
+    env forces the hierarchical or flat path, None for auto."""
+    raw = os.environ.get(HIER_ENV, "").strip().lower()
+    if raw in ("1", "true", "on", "yes"):
+        return True
+    if raw in ("0", "false", "off", "no"):
+        return False
+    return None
+
+
+def _hier_active(axes) -> bool:
+    """Whether :func:`all_reduce_sum` over these axes decomposes into
+    the two-level reduce: needs a (slow, fast) axis split to exploit,
+    then the env override decides, else auto — hierarchical exactly when
+    the runtime spans processes (a single-process hybrid mesh's "dcn"
+    axis rides the same ICI as its data axis, so the flat psum is
+    already optimal there; tests force the path via the env)."""
+    if len(axes) < 2:
+        return False
+    forced = hier_reduce_forced()
+    if forced is not None:
+        return forced
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def _hier_psum(x, axes):
+    """The two-level tree reduce (arXiv:1903.06701 — reduce near the
+    data, cross the slow fabric at 1/N width): reduce_scatter over the
+    fast inner axes (each local shard owns a ``1/local_N`` slice of the
+    local sum), all-reduce the slices over the slow outer axis — the
+    ONLY inter-level traffic, ``1/local_N`` of the flat psum's payload —
+    then all_gather the fresh slices back over the fast axes. Equals the
+    flat psum up to float reassociation (pinned in
+    tests/test_multiprocess.py)."""
+    outer, inner = axes[0], axes[1:]
+    inner_ax = inner[0] if len(inner) == 1 else inner
+    local_n = int(np.prod([axis_size(a) for a in inner]))
+    if local_n <= 1 or jnp.ndim(x) == 0:
+        # no fast axis to scatter over / a scalar: the split degenerates
+        _note_traced("psum", x, axes)
+        _note_level("psum", "inter", x, axes)
+        return jax.lax.psum(x, axes)
+    n0 = x.shape[0]
+    pad = (-n0) % local_n
+    xp = (jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+          if pad else x)
+    _note_traced("psum_scatter", xp, inner_ax)
+    _note_level("reduce_scatter", "intra", xp, axes)
+    part = jax.lax.psum_scatter(xp, inner_ax, scatter_dimension=0,
+                                tiled=True)
+    _note_traced("psum", part, outer)
+    _note_level("psum", "inter", part, axes)
+    part = jax.lax.psum(part, outer)
+    _note_traced("all_gather", part, inner_ax)
+    _note_level("all_gather", "intra", part, axes)
+    full = jax.lax.all_gather(part, inner_ax, axis=0, tiled=True)
+    return full[:n0] if pad else full
+
+
 # -- in-axis collectives (inside shard_map / with named axes) ---------------
 
 def all_reduce_sum(x, axis_name=DATA_AXIS):
     """Sum across the mesh axis (ref: AllReduceImpl.java:54 allReduceSum).
 
     ``axis_name`` may be a tuple of axes — e.g. ``("dcn", "data")`` on a
-    hybrid multi-slice mesh — in which case XLA emits the hierarchical
-    all-reduce (in-slice over ICI, one cross-slice DCN exchange).
+    hybrid multi-slice or multi-process mesh. When the runtime spans
+    processes (or ``FLINK_ML_TPU_HIER_REDUCE=1`` forces it), the tuple
+    form lowers through the explicit two-level tree reduce
+    (:func:`_hier_psum`) so the inter-process fabric carries
+    ``1/local_N`` of the payload; otherwise one fused ``psum`` (XLA
+    decomposes it over ICI/DCN on real hardware).
     """
+    axes = ((axis_name,) if isinstance(axis_name, str)
+            else tuple(axis_name))
+    if _hier_active(axes):
+        return _hier_psum(x, axes)
     _note_traced("psum", x, axis_name)
+    if len(axes) > 1:
+        # flat reduce over a mesh with a slow outer axis: the FULL
+        # payload crosses the inter level — the comparison baseline the
+        # hierarchical path's accounting is gated against
+        _note_level("psum", "inter", x, axes)
     return jax.lax.psum(x, axis_name)
 
 

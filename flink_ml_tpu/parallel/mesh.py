@@ -265,5 +265,27 @@ def default_mesh() -> Mesh:
 
 
 def set_default_mesh(mesh: Optional[Mesh]) -> None:
-    global _default_mesh
+    global _default_mesh, _local_mesh
     _default_mesh = mesh
+    _local_mesh = None
+
+
+_local_mesh: Optional[Mesh] = None
+
+
+def local_mesh() -> Mesh:
+    """The mesh the *transform/predict* tier places batches on: the
+    default mesh single-process, a data mesh over THIS process's
+    addressable devices when the runtime spans processes
+    (jax.distributed — docs/distributed.md "Multi-process meshes").
+    Training is SPMD across every process, but prediction is a
+    per-process operation — each process scores its own traffic, and a
+    prediction column sharded over a multi-process mesh could never be
+    fetched by its local caller (jax refuses to materialize
+    non-addressable shards)."""
+    global _local_mesh
+    if jax.process_count() <= 1:
+        return default_mesh()
+    if _local_mesh is None:
+        _local_mesh = create_mesh(devices=jax.local_devices())
+    return _local_mesh

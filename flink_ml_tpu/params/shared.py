@@ -26,9 +26,9 @@ __all__ = [
     "HasGlobalBatchSize", "HasHandleInvalid", "HasInputCol", "HasInputCols",
     "HasLabelCol", "HasLearningRate", "HasMaxAllowedModelDelayMs",
     "HasMaxIter", "HasModelVersionCol", "HasMultiClass", "HasNumFeatures",
-    "HasOutputCol", "HasOutputCols", "HasPredictionCol",
-    "HasRawPredictionCol", "HasReg", "HasRelativeError", "HasSeed", "HasTol",
-    "HasWeightCol", "HasWindows",
+    "HasOptimizerMethod", "HasOutputCol", "HasOutputCols",
+    "HasPredictionCol", "HasRawPredictionCol", "HasReg",
+    "HasRelativeError", "HasSeed", "HasTol", "HasWeightCol", "HasWindows",
 ]
 
 
@@ -140,6 +140,32 @@ class HasNumFeatures(WithParams):
         "numFeatures",
         "The number of features. It will be the length of the output vector.",
         262144, ParamValidators.gt(0))
+
+
+class HasOptimizerMethod(WithParams):
+    """The gradient update rule of the SGD family (ops/optimizer.py):
+    the reference's stateless "sgd", heavy-ball "momentum", or "adam" —
+    the stateful rules carry per-coordinate moment accumulators through
+    the fit, and under ``FLINK_ML_TPU_UPDATE_SHARDING`` those
+    accumulators live as 1/N per-replica slices
+    (docs/distributed.md). Beyond reference parity: flink-ml's
+    Optimizer interface ships SGD only."""
+
+    OPTIMIZER = StringParam(
+        "optimizer", "Gradient update rule: sgd, momentum or adam.",
+        "sgd", ParamValidators.in_array("sgd", "momentum", "adam"))
+    MOMENTUM = FloatParam(
+        "momentum", "Heavy-ball decay of the momentum rule.", 0.9,
+        ParamValidators.in_range(0.0, 1.0))
+    BETA1 = FloatParam(
+        "beta1", "Adam first-moment decay.", 0.9,
+        ParamValidators.in_range(0.0, 1.0))
+    BETA2 = FloatParam(
+        "beta2", "Adam second-moment decay.", 0.999,
+        ParamValidators.in_range(0.0, 1.0))
+    EPSILON = FloatParam(
+        "epsilon", "Adam denominator fuzz term.", 1e-8,
+        ParamValidators.gt(0))
 
 
 class HasOutputCol(WithParams):

@@ -1,0 +1,477 @@
+#!/usr/bin/env python
+"""Multi-process training benchmark: jax.distributed cells + gates.
+
+Proves the multi-process runtime (``parallel/distributed.py``) end to
+end and writes ``BENCH_multihost.json``. Cells hold the TOTAL data-shard
+count fixed (default 4) while splitting it over 1/2/4 coordinated CPU
+processes (``jax.distributed`` over a localhost coordinator, each
+process contributing ``xla_force_host_platform_device_count`` simulated
+local devices to ONE global ``(dcn, data)`` mesh), so every cell runs
+the IDENTICAL SPMD program — SGD (adam), KMeans and FTRL fit with zero
+algorithm changes — and results are comparable up to float
+reassociation.
+
+Self-gating (the acceptance bars of the multi-process runtime):
+
+1. **Cross-cell parity** — every multi-process cell's results
+   (coefficients / centroids / FTRL state) must match the
+   single-process cell within float tolerance.
+2. **Hierarchical reduce is cheaper on the wire** — the 2-process cell
+   run with the two-level reduce (``FLINK_ML_TPU_HIER_REDUCE=1``:
+   intra-process reduce_scatter → inter-process all-reduce over the 1/N
+   slices → local all-gather, arXiv:1903.06701) must record STRICTLY
+   fewer inter-level payload bytes (``ml.collective
+   levelPayloadBytes{level="inter"}``) than the same cell forced flat —
+   the explicit decomposition provably shrinks the traffic crossing the
+   slow inter-process fabric by ~1/local_N.
+3. **Zero donation warnings** — every cell's donated carries (the
+   (coeffs, offsets, opt) fit carries, FTRL's z/n) must consume
+   cleanly.
+4. **1/N sharded optimizer moments** — a sharded adam fit's per-replica
+   moment-state bytes at N=8 must be <= 0.2x the N=1 size (the m/v
+   slices of arXiv:2004.13336 measured from real device buffers).
+5. **Merged multi-process telemetry** — a traced 2-process cell's
+   shared trace dir (per-process ``spans-p<k>-*``/``metrics-p<k>-*``
+   artifacts) must satisfy ``mltrace shards --check`` and attribute
+   spans per process in ``mltrace summary --json``.
+
+Structure mirrors mapreduce_bench.py: the PARENT NEVER IMPORTS JAX —
+every cell is a group of subprocesses with its own env, so a wedged
+distributed runtime cannot take the sweep down.
+
+Exit codes: 0 ok / 1 gate failed / 2 environment broken.
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # run from a checkout without installing
+MLTRACE = os.path.join(REPO, "scripts", "mltrace.py")
+
+#: total data shards held fixed while processes split them
+TOTAL_DEVICES = 4
+#: process counts; --smoke keeps (1, 2)
+PROC_COUNTS = (1, 2, 4)
+SMOKE_PROCS = (1, 2)
+
+
+# ---------------------------------------------------------------------------
+# worker: one process of one cell (imports jax; the parent never does)
+# ---------------------------------------------------------------------------
+
+def _level_bytes():
+    """Summed ml.collective levelPayloadBytes by level label from the
+    live registry — the two-level-reduce accounting (collective.py)."""
+    from flink_ml_tpu.common.metrics import metrics
+
+    snap = metrics.snapshot().get("ml.collective", {})
+    out = {"intra": 0.0, "inter": 0.0}
+    for key, hist in snap.get("histograms", {}).items():
+        if not key.startswith("levelPayloadBytes"):
+            continue
+        for level in out:
+            if f'level="{level}"' in key:
+                out[level] += float(hist.get("sum", 0.0))
+    return {k: int(v) for k, v in out.items()}
+
+
+def run_worker(smoke: bool) -> int:
+    import warnings
+
+    donation_warnings = []
+
+    def note(message, *a, **k):
+        if "donat" in str(message).lower():
+            donation_warnings.append(str(message))
+
+    warnings.simplefilter("always")
+    warnings.showwarning = lambda m, c, *a, **k: note(m)
+
+    from flink_ml_tpu.parallel import distributed as dist
+
+    dist.init_from_env()
+
+    import numpy as np
+
+    import jax
+
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.iteration.streaming import StreamTable
+    from flink_ml_tpu.models.clustering import KMeans
+    from flink_ml_tpu.models.online import OnlineLogisticRegression
+    from flink_ml_tpu.ops.losses import BinaryLogisticLoss
+    from flink_ml_tpu.ops.optimizer import SGD, SGDParams
+    from flink_ml_tpu.parallel.mesh import set_default_mesh
+
+    mesh = dist.build_mesh()
+    set_default_mesh(mesh)
+
+    rng = np.random.default_rng(7)
+    n, d = (1024, 16) if smoke else (8192, 32)
+    iters = 4 if smoke else 8
+    out = {"processCount": jax.process_count(),
+           "deviceCount": jax.device_count(),
+           "localDevices": jax.local_device_count(),
+           "meshShape": ",".join(f"{a}={int(mesh.shape[a])}"
+                                 for a in mesh.axis_names),
+           "hierReduce": os.environ.get("FLINK_ML_TPU_HIER_REDUCE",
+                                        "auto"),
+           "workloads": {}}
+
+    def timed(fit):
+        fit()                     # warmup: compile excluded, like bench.py
+        t0 = time.perf_counter()
+        result = fit()
+        return (time.perf_counter() - t0) * 1000.0, result
+
+    def summarize(arr):
+        arr = np.asarray(arr, np.float64).ravel()
+        return {"norm": float(np.linalg.norm(arr)),
+                "head": [float(v) for v in arr[:8]]}
+
+    # -- SGD with adam moments (the stateful-optimizer workload) -----------
+    x = rng.normal(size=(n, d))
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    prm = SGDParams(learning_rate=0.05, global_batch_size=256,
+                    max_iter=iters, tol=0.0, reg=0.01, elastic_net=0.3,
+                    method="adam")
+    fit_ms, (coeffs, loss) = timed(lambda: SGD(prm).optimize(
+        BinaryLogisticLoss(), np.zeros(d), x, y, mesh=mesh,
+        tag="sgd-bench"))
+    out["workloads"]["sgd_adam"] = {
+        "fitMs": round(fit_ms, 3), "loss": float(loss),
+        "result": summarize(coeffs)}
+
+    # -- KMeans lloyd ------------------------------------------------------
+    t = Table.from_columns(
+        features=rng.normal(size=(n // 2, d // 2)).astype(np.float32))
+    fit_ms, model = timed(
+        lambda: KMeans(k=4, seed=3, max_iter=iters).fit(t))
+    out["workloads"]["kmeans"] = {
+        "fitMs": round(fit_ms, 3),
+        "result": summarize(np.sort(model.centroids.ravel()))}
+
+    # -- FTRL dense --------------------------------------------------------
+    batches, bs = (4, 256) if smoke else (10, 512)
+    xf = rng.normal(size=(batches * bs, d)).astype(np.float32)
+    yf = (xf @ rng.normal(size=d) > 0).astype(float)
+    tf = Table.from_columns(features=xf, label=yf)
+    init = Table.from_columns(coefficient=np.zeros((1, d)),
+                              modelVersion=np.asarray([0]))
+
+    def ftrl_fit():
+        est = OnlineLogisticRegression(global_batch_size=bs, reg=0.01,
+                                       elastic_net=0.3)
+        est.set_initial_model_data(init)
+        return est.fit(StreamTable.from_table(tf, bs))
+
+    fit_ms, model = timed(ftrl_fit)
+    out["workloads"]["ftrl"] = {
+        "fitMs": round(fit_ms, 3),
+        "result": summarize(model.coefficients)}
+
+    out["levelPayloadBytes"] = _level_bytes()
+    out["donationWarnings"] = len(donation_warnings)
+    out["donationWarningSamples"] = donation_warnings[:3]
+
+    from flink_ml_tpu.observability import tracing
+
+    tracing.maybe_dump_root_metrics()
+    if jax.process_index() == 0:
+        print(json.dumps(out), flush=True)
+    return 0
+
+
+def run_adam_cell() -> int:
+    """Single-process sharded-adam cell: the 1/N moment-bytes probe
+    (``.moments`` record from update_sharding.record_state_bytes)."""
+    import numpy as np
+
+    import jax
+
+    from flink_ml_tpu.ops.losses import BinaryLogisticLoss
+    from flink_ml_tpu.ops.optimizer import SGD, SGDParams
+    from flink_ml_tpu.parallel import update_sharding as upd
+
+    rng = np.random.default_rng(3)
+    d = 64  # divisible by 8: the moment slices carry no padding
+    x = rng.normal(size=(512, d))
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    prm = SGDParams(learning_rate=0.05, global_batch_size=128,
+                    max_iter=4, tol=0.0, method="adam")
+    coeffs, _ = SGD(prm).optimize(BinaryLogisticLoss(), np.zeros(d), x,
+                                  y, tag="adam-bench")
+    print(json.dumps({
+        "deviceCount": len(jax.devices()),
+        "updateSharding": upd.enabled(),
+        "momentBytesPerReplica": upd.last_state_bytes(
+            "adam-bench.moments"),
+        "resultNorm": float(np.linalg.norm(coeffs))}), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: spawn cells + gates (never imports jax)
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _cell_env(local_devices: int, extra=None) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count="
+        f"{local_devices}").strip()
+    env.pop("FLINK_ML_TPU_TRACE_DIR", None)
+    env.pop("FLINK_ML_TPU_HIER_REDUCE", None)
+    env.update(extra or {})
+    return env
+
+
+def _spawn_cell(n_procs: int, smoke: bool, hier=None, trace_dir=None,
+                timeout=1800) -> dict:
+    """One multi-process cell: n_procs coordinated workers splitting
+    TOTAL_DEVICES shards; returns process 0's JSON record."""
+    local = TOTAL_DEVICES // n_procs
+    extra = {
+        "FLINK_ML_TPU_NUM_PROCESSES": str(n_procs),
+        "FLINK_ML_TPU_LOCAL_DEVICES": str(local),
+    }
+    if n_procs > 1:
+        extra["FLINK_ML_TPU_COORDINATOR"] = f"127.0.0.1:{_free_port()}"
+    if hier is not None:
+        extra["FLINK_ML_TPU_HIER_REDUCE"] = hier
+    if trace_dir:
+        extra["FLINK_ML_TPU_TRACE_DIR"] = trace_dir
+    argv = [sys.executable, os.path.abspath(__file__), "--worker"]
+    if smoke:
+        argv.append("--smoke")
+    import threading
+
+    procs = []
+    for pid in range(n_procs):
+        env = _cell_env(local, extra)
+        env["FLINK_ML_TPU_PROCESS_ID"] = str(pid)
+        procs.append(subprocess.Popen(
+            argv, env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    # drain every worker concurrently: the cell runs one collective
+    # program in lockstep, so one worker blocked on a full pipe would
+    # stall the whole group (same recipe as distributed.launch)
+    collected = [None] * n_procs
+
+    def drain(i, proc):
+        collected[i] = proc.communicate()
+
+    threads = [threading.Thread(target=drain, args=(i, p), daemon=True)
+               for i, p in enumerate(procs)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + timeout
+    for t in threads:
+        t.join(max(deadline - time.monotonic(), 0.0))
+    if any(t.is_alive() for t in threads):
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for t in threads:
+            t.join(10.0)
+        raise subprocess.TimeoutExpired(argv, timeout)
+    outs = [(proc.returncode, out, err)
+            for proc, (out, err) in zip(procs, collected)]
+    for pid, (rc, out, err) in enumerate(outs):
+        if rc != 0:
+            raise RuntimeError(
+                f"cell procs={n_procs} worker {pid} failed (rc={rc}):\n"
+                f"{out}\n{err}")
+    return json.loads(outs[0][1].strip().splitlines()[-1])
+
+
+def _spawn_adam(n_dev: int, timeout=900) -> dict:
+    env = _cell_env(n_dev, {"FLINK_ML_TPU_UPDATE_SHARDING": "1"})
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--adam-cell"],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"adam cell devices={n_dev} failed (rc={proc.returncode}):\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _close(a: dict, b: dict, rtol: float) -> bool:
+    import math
+
+    if not math.isclose(a["norm"], b["norm"], rel_tol=rtol,
+                        abs_tol=1e-6):
+        return False
+    return all(math.isclose(x, y, rel_tol=rtol, abs_tol=1e-4)
+               for x, y in zip(a["head"], b["head"]))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="multihost_bench")
+    parser.add_argument("--smoke", action="store_true",
+                        help="small workloads, process counts 1 and 2")
+    parser.add_argument("--worker", action="store_true",
+                        help="(internal) run one cell worker")
+    parser.add_argument("--adam-cell", action="store_true",
+                        help="(internal) run the sharded-adam probe")
+    parser.add_argument("--output", default=os.path.join(
+        REPO, "BENCH_multihost.json"))
+    args = parser.parse_args(argv)
+
+    if args.worker:
+        return run_worker(args.smoke)
+    if args.adam_cell:
+        return run_adam_cell()
+
+    counts = SMOKE_PROCS if args.smoke else PROC_COUNTS
+    out_dir = os.path.dirname(os.path.abspath(args.output)) or REPO
+    os.makedirs(out_dir, exist_ok=True)
+    trace_dir = os.path.join(out_dir, "multihost-bench-trace")
+
+    record = {"smoke": bool(args.smoke),
+              "totalDevices": TOTAL_DEVICES,
+              "processCounts": list(counts),
+              "cells": [], "gates": {}}
+    failures = []
+
+    # -- parity cells (auto hier), plus the traced + flat 2-proc cells ------
+    try:
+        for n_procs in counts:
+            print(f"[cell] procs={n_procs} "
+                  f"local={TOTAL_DEVICES // n_procs}",
+                  file=sys.stderr, flush=True)
+            record["cells"].append(_spawn_cell(
+                n_procs, args.smoke,
+                trace_dir=trace_dir if n_procs == 2 else None))
+        print("[cell] procs=2 hier=forced-flat", file=sys.stderr,
+              flush=True)
+        flat_cell = _spawn_cell(2, args.smoke, hier="0")
+        flat_cell["cellRole"] = "hier-comparison-flat"
+        record["cells"].append(flat_cell)
+    except (RuntimeError, subprocess.TimeoutExpired) as e:
+        print(f"environment broken: {e}", file=sys.stderr)
+        return 2
+
+    def cell(n_procs):
+        return next(c for c in record["cells"]
+                    if c["processCount"] == n_procs
+                    and "cellRole" not in c)
+
+    # gate 1: cross-cell parity at the fixed total shard count
+    parity = {}
+    base = cell(1)
+    for n_procs in counts[1:]:
+        for wl in ("sgd_adam", "kmeans", "ftrl"):
+            ok = _close(base["workloads"][wl]["result"],
+                        cell(n_procs)["workloads"][wl]["result"],
+                        rtol=1e-3)
+            parity[f"{wl}@{n_procs}proc"] = ok
+            if not ok:
+                failures.append(
+                    f"{wl} diverges between 1 and {n_procs} processes "
+                    f"at equal total shards")
+    record["gates"]["parity"] = parity
+
+    # gate 2: hierarchical reduce crosses the inter-process fabric with
+    # strictly fewer bytes than the flat psum (trace-time accounting of
+    # the 2-process cell, hier auto=on vs forced flat)
+    hier_cell = cell(2)
+    hier_inter = hier_cell["levelPayloadBytes"]["inter"]
+    flat_inter = flat_cell["levelPayloadBytes"]["inter"]
+    record["gates"]["hierInterBytes"] = {
+        "hier": hier_inter, "flat": flat_inter,
+        "ratio": (round(hier_inter / flat_inter, 4)
+                  if flat_inter else None),
+        "localDevices": hier_cell["localDevices"]}
+    if not flat_inter:
+        failures.append("flat 2-process cell recorded no inter-level "
+                        "payload bytes — the accounting is broken")
+    elif hier_inter >= flat_inter:
+        failures.append(
+            f"hierarchical inter-level bytes ({hier_inter}) not below "
+            f"flat ({flat_inter})")
+
+    # gate 3: donation clean everywhere
+    warn = sum(c["donationWarnings"] for c in record["cells"])
+    record["gates"]["donationWarnings"] = warn
+    if warn:
+        failures.append(f"{warn} donation warnings across cells")
+
+    # gate 4: sharded adam moment state measures ~1/N per replica at N=8
+    try:
+        a1 = _spawn_adam(1)
+        a8 = _spawn_adam(8)
+    except (RuntimeError, subprocess.TimeoutExpired) as e:
+        print(f"environment broken (adam cells): {e}", file=sys.stderr)
+        return 2
+    b1 = a1["momentBytesPerReplica"]
+    b8 = a8["momentBytesPerReplica"]
+    ratio = round(b8 / max(b1, 1), 4) if b1 and b8 else None
+    record["gates"]["adamMomentShrink"] = {
+        "bytesAt1": b1, "bytesAt8": b8, "ratio": ratio, "bound": 0.2}
+    if ratio is None:
+        failures.append("sharded adam recorded no moment bytes")
+    elif ratio > 0.2:
+        failures.append(
+            f"adam moment bytes/replica at N=8 is {ratio:.2f}x N=1 "
+            f"(must be <= 0.2x)")
+
+    # gate 5: the merged multi-process trace reads back — shards --check
+    # accepts it and the span summary attributes per process
+    shards = subprocess.run(
+        [sys.executable, MLTRACE, "shards", trace_dir, "--check"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    record["gates"]["shardsCheck"] = {"exit": shards.returncode}
+    if shards.returncode != 0:
+        failures.append("mltrace shards --check rejected the merged "
+                        "multi-process trace")
+        print(shards.stdout + shards.stderr, file=sys.stderr)
+    summary = subprocess.run(
+        [sys.executable, MLTRACE, "summary", trace_dir, "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    procs_seen = {}
+    try:
+        procs_seen = json.loads(summary.stdout).get("processes", {})
+    except (json.JSONDecodeError, AttributeError):
+        pass
+    record["gates"]["processAttribution"] = {
+        "processes": procs_seen,
+        "spanFiles": sorted(
+            f for f in os.listdir(trace_dir)
+            if f.startswith("spans-")) if os.path.isdir(trace_dir)
+        else []}
+    if len(procs_seen) < 2:
+        failures.append(
+            f"merged trace attributes spans to {len(procs_seen)} "
+            f"process(es), wanted 2 (process labels missing?)")
+
+    record["gates"]["ok"] = not failures
+    record["failures"] = failures
+    with open(args.output, "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps({
+        "output": args.output, "ok": record["gates"]["ok"],
+        "hierInterRatio": record["gates"]["hierInterBytes"]["ratio"],
+        "adamMomentRatio": record["gates"]["adamMomentShrink"]["ratio"],
+        "failures": failures}, indent=2))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,13 +119,13 @@ def _profile_programs() -> int:
     from flink_ml_tpu.parallel.collective import ones_on_mesh
     ws = ones_on_mesh(mesh, n, axes, jnp.float32)
 
-    # the fit programs DONATE their (coeffs, offsets) carry — every
+    # the fit programs DONATE their (coeffs, offsets, opt) carry — every
     # invocation (the AOT compile's example args included) needs fresh
-    # carry buffers
+    # carry buffers; opt is () for method="sgd"
     def sgd_args(label):
         args = (xs, ys, ws,
                 jax.device_put(jnp.zeros((d,), jnp.float32)),
-                jax.device_put(jnp.zeros((1,), jnp.int32)))
+                jax.device_put(jnp.zeros((1,), jnp.int32)), ())
         if label == "while-segment":
             args = args + (jnp.int32(0), jnp.int32(prm.max_iter))
         return args

@@ -1,0 +1,548 @@
+"""The multi-process training runtime (parallel/distributed.py), the
+hierarchical two-level reduce (parallel/collective.py) and the stateful
+optimizers on sharded accumulators (ops/optimizer.py).
+
+Pins the ISSUE 14 contracts: the env-mapped ``init_distributed`` seam
+and its process helpers, ``build_mesh``'s (dcn, data) topology
+convention, hierarchical-vs-flat reduce numerics (reassociation
+tolerance pinned) and per-level payload accounting, momentum/adam
+convergence + sharded-vs-replicated parity at mesh sizes {1, 2, 8}, a
+mid-fit chaos restart of a sharded-adam segment fit resuming
+bit-identical through the v2 manifest, and the process-labeled trace
+artifacts (``spans-p<k>-*`` naming, ``process=`` span records) that a
+merged multi-process trace dir depends on. The real cross-process cells
+run in the launcher round-trip test (slow-marked; the CI
+``multiprocess`` job and scripts/multihost_bench.py run them at scale).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from flink_ml_tpu.parallel import (
+    DATA_AXIS,
+    DCN_AXIS,
+    create_hybrid_mesh,
+    create_mesh,
+    distributed as dist,
+    mapreduce as mr,
+    update_sharding as upd,
+)
+from flink_ml_tpu.parallel import collective as coll
+
+MESH_SIZES = (1, 2, 8)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def submesh(n):
+    return create_mesh(devices=jax.devices()[:n])
+
+
+# -- distributed.py: env mapping, process helpers, build_mesh -----------------
+
+def test_process_helpers_default_single_process(monkeypatch):
+    monkeypatch.delenv(dist.NUM_PROCESSES_ENV, raising=False)
+    monkeypatch.delenv(dist.PROCESS_ID_ENV, raising=False)
+    assert dist.process_count() == 1
+    assert dist.process_index() == 0
+    assert dist.process_label() is None
+
+
+def test_process_helpers_read_launcher_env(monkeypatch):
+    monkeypatch.setenv(dist.NUM_PROCESSES_ENV, "4")
+    monkeypatch.setenv(dist.PROCESS_ID_ENV, "2")
+    assert dist.process_count() == 4
+    assert dist.process_index() == 2
+    assert dist.process_label() == 2
+
+
+def test_process_helpers_garbage_env_ignored(monkeypatch):
+    monkeypatch.setenv(dist.NUM_PROCESSES_ENV, "banana")
+    monkeypatch.setenv(dist.PROCESS_ID_ENV, "")
+    assert dist.process_count() == 1
+    assert dist.process_label() is None
+
+
+def test_init_distributed_unconfigured_is_noop(monkeypatch):
+    """No coordinator, no env: stays single-process without touching
+    the cluster auto-detection probe."""
+    for var in (dist.COORDINATOR_ENV, dist.NUM_PROCESSES_ENV,
+                dist.PROCESS_ID_ENV, dist.LOCAL_DEVICES_ENV):
+        monkeypatch.delenv(var, raising=False)
+    assert dist.init_distributed() is False
+    assert dist.init_from_env() is False  # idempotent
+
+
+def test_init_distributed_single_process_explicit():
+    assert dist.init_distributed(num_processes=1) is False
+
+
+def test_build_mesh_single_process_is_flat_data_mesh():
+    mesh = dist.build_mesh()
+    assert mesh.axis_names == (DATA_AXIS,)
+    assert mesh.devices.size == len(jax.devices())
+
+
+def test_launch_strips_inherited_device_count_flag(monkeypatch):
+    """The child env must carry the launcher's device count, not the
+    parent test env's 8-device flag."""
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8 --foo")
+    results = dist.launch(
+        [sys.executable, "-c",
+         "import os; print(os.environ['XLA_FLAGS']); "
+         "print(os.environ['FLINK_ML_TPU_PROCESS_ID'])"],
+        num_processes=2, local_devices=3, timeout=120)
+    assert [r["returncode"] for r in results] == [0, 0]
+    for pid, rec in enumerate(results):
+        flags, proc_id = rec["stdout"].strip().splitlines()
+        assert flags.count("xla_force_host_platform_device_count") == 1
+        assert "device_count=3" in flags and "--foo" in flags
+        assert int(proc_id) == pid
+
+
+def test_local_mesh_is_default_mesh_single_process():
+    """Single-process the transform tier's local_mesh IS the default
+    mesh — the multi-process split (prediction placed on local devices,
+    training on the global mesh) costs nothing here."""
+    from flink_ml_tpu.parallel.mesh import default_mesh, local_mesh
+
+    assert local_mesh() is default_mesh()
+
+
+@pytest.mark.slow
+def test_multiprocess_fit_then_local_transform():
+    """A model fitted over the global multi-process mesh must score on
+    ITS OWN process afterwards: prediction columns place on local
+    devices (mesh.local_mesh via the columnar on-ramp) — a
+    globally-sharded prediction column could never be fetched by the
+    local caller."""
+    worker = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from flink_ml_tpu.parallel import distributed as dist\n"
+        "assert dist.init_from_env()\n"
+        "import numpy as np\n"
+        "from flink_ml_tpu.parallel.mesh import set_default_mesh\n"
+        "set_default_mesh(dist.build_mesh())\n"
+        "from flink_ml_tpu.common.table import Table\n"
+        "from flink_ml_tpu.models.classification import "
+        "LogisticRegression\n"
+        "rng = np.random.default_rng(0)\n"
+        "x = rng.normal(size=(256, 8)).astype(np.float32)\n"
+        "y = (x @ rng.normal(size=8) > 0).astype(np.float64)\n"
+        "t = Table.from_columns(features=x, label=y)\n"
+        "m = LogisticRegression(max_iter=6, optimizer='adam').fit(t)\n"
+        "pred = m.transform(Table.from_columns(features=x))[0]\n"
+        "acc = float(np.mean(\n"
+        "    np.asarray(pred.column('prediction')) == y))\n"
+        "assert acc > 0.85, acc\n"
+        "print('ACC', acc)\n" % REPO)
+    results = dist.launch([sys.executable, "-c", worker],
+                          num_processes=2, local_devices=2, timeout=420)
+    for rec in results:
+        assert rec["returncode"] == 0, rec["stderr"]
+        assert "ACC" in rec["stdout"]
+
+
+@pytest.mark.slow
+def test_launcher_forms_one_global_mesh():
+    """The real thing: 2 coordinated CPU processes x 2 simulated local
+    devices form ONE 4-device (dcn, data) mesh and agree on a
+    cross-process reduction through the existing map_shards seam."""
+    worker = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from flink_ml_tpu.parallel import distributed as dist\n"
+        "assert dist.init_from_env()\n"
+        "import jax, numpy as np\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from flink_ml_tpu.parallel import mapreduce as mr\n"
+        "from flink_ml_tpu.parallel.mesh import data_axes\n"
+        "mesh = dist.build_mesh()\n"
+        "assert mesh.axis_names == ('dcn', 'data'), mesh.axis_names\n"
+        "assert jax.device_count() == 4 and jax.process_count() == 2\n"
+        "axes = data_axes(mesh)\n"
+        "prog = mr.map_shards(lambda a: mr.reduce_sum(a, axes), mesh,\n"
+        "                     in_specs=P(), out_specs=P())\n"
+        "out = np.asarray(prog(np.arange(4, dtype=np.float32)))\n"
+        "np.testing.assert_allclose(out, 4.0 * np.arange(4))\n"
+        "print('OK', jax.process_index())\n" % REPO)
+    results = dist.launch([sys.executable, "-c", worker],
+                          num_processes=2, local_devices=2, timeout=420)
+    for rec in results:
+        assert rec["returncode"] == 0, rec["stderr"]
+        assert "OK" in rec["stdout"]
+
+
+# -- hierarchical two-level reduce -------------------------------------------
+
+def _hybrid_mesh():
+    return create_hybrid_mesh(ici_shape=(4,), dcn_shape=(2,))
+
+
+def test_hier_reduce_matches_flat_within_reassociation(monkeypatch):
+    """The tolerance pin: the two-level reduce equals the flat psum up
+    to float reassociation — and on these integer-valued inputs,
+    exactly."""
+    mesh = _hybrid_mesh()
+    axes = (DCN_AXIS, DATA_AXIS)
+    g = np.arange(17, dtype=np.float32)  # odd length exercises the pad
+
+    monkeypatch.setenv(coll.HIER_ENV, "0")
+    flat = np.asarray(mr.map_shards(
+        lambda a: mr.reduce_sum(a, axes), mesh,
+        in_specs=P(), out_specs=P())(g))
+    monkeypatch.setenv(coll.HIER_ENV, "1")
+    hier = np.asarray(mr.map_shards(
+        lambda a: mr.reduce_sum(a, axes), mesh,
+        in_specs=P(), out_specs=P())(g))
+    np.testing.assert_allclose(hier, flat, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(hier, 8.0 * g, rtol=1e-6)
+
+
+def test_hier_reduce_random_values_tolerance(monkeypatch, rng):
+    """Non-integer values: the reassociated sum agrees within the
+    pinned float32 tolerance."""
+    mesh = _hybrid_mesh()
+    axes = (DCN_AXIS, DATA_AXIS)
+    g = rng.normal(size=(33, 3)).astype(np.float32)
+
+    def per_mode(mode):
+        monkeypatch.setenv(coll.HIER_ENV, mode)
+        return np.asarray(mr.map_shards(
+            lambda a: mr.reduce_sum(a, axes), mesh,
+            in_specs=P(), out_specs=P())(g))
+
+    np.testing.assert_allclose(per_mode("1"), per_mode("0"),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_hier_reduce_scalar_degenerates_to_flat(monkeypatch):
+    """A scalar has no dim 0 to scatter: the split degenerates to one
+    psum with the full payload on the inter level."""
+    mesh = _hybrid_mesh()
+    axes = (DCN_AXIS, DATA_AXIS)
+    monkeypatch.setenv(coll.HIER_ENV, "1")
+    out = mr.map_shards(
+        lambda: mr.reduce_sum(jnp.float32(1.5), axes)[None], mesh,
+        in_specs=(), out_specs=P())()
+    np.testing.assert_allclose(np.asarray(out), [12.0])
+
+
+def test_hier_single_axis_never_decomposes(monkeypatch):
+    """A flat one-axis mesh has no (slow, fast) split — forcing the env
+    on must not change the program."""
+    monkeypatch.setenv(coll.HIER_ENV, "1")
+    mesh8 = create_mesh()
+    out = mr.map_shards(
+        lambda a: mr.reduce_sum(a), mesh8, in_specs=P(), out_specs=P())(
+        np.arange(4, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(out), 8.0 * np.arange(4))
+
+
+def test_hier_level_accounting_inter_shrinks(monkeypatch):
+    """The bench's gate quantity: hierarchical records ~1/local_N of
+    the flat psum's inter-level payload bytes."""
+    from flink_ml_tpu.common.metrics import metrics
+
+    mesh = _hybrid_mesh()
+    axes = (DCN_AXIS, DATA_AXIS)
+    g = np.zeros(64, np.float32)
+
+    def inter_bytes(mode):
+        before = _level_sum(metrics, "inter")
+        monkeypatch.setenv(coll.HIER_ENV, mode)
+        mr.map_shards(lambda a: mr.reduce_sum(a, axes), mesh,
+                      in_specs=P(), out_specs=P())(g)
+        return _level_sum(metrics, "inter") - before
+
+    flat = inter_bytes("0")
+    hier = inter_bytes("1")
+    assert flat == 64 * 4  # the whole payload crossed the slow fabric
+    assert hier == 64 * 4 / 4  # the 1/local_N slice (local axis = 4)
+
+
+def _level_sum(metrics, level):
+    snap = metrics.snapshot().get("ml.collective", {})
+    return sum(float(h.get("sum", 0.0))
+               for k, h in snap.get("histograms", {}).items()
+               if k.startswith("levelPayloadBytes")
+               and f'level="{level}"' in k)
+
+
+def test_hier_auto_off_single_process(monkeypatch):
+    monkeypatch.delenv(coll.HIER_ENV, raising=False)
+    assert coll.hier_reduce_forced() is None
+    # single-process runtime: auto resolves to the flat path
+    assert coll._hier_active((DCN_AXIS, DATA_AXIS)) is False
+    monkeypatch.setenv(coll.HIER_ENV, "1")
+    assert coll._hier_active((DCN_AXIS, DATA_AXIS)) is True
+    assert coll._hier_active((DATA_AXIS,)) is False  # nothing to split
+
+
+# -- stateful optimizers: convergence + parity --------------------------------
+
+def _sgd_fit(mesh, seed, method, **kw):
+    from flink_ml_tpu.ops.losses import BinaryLogisticLoss
+    from flink_ml_tpu.ops.optimizer import SGD, SGDParams
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(400, 10))
+    y = (x @ rng.normal(size=10) > 0).astype(np.float64)
+    prm = SGDParams(learning_rate=0.1, global_batch_size=80, max_iter=8,
+                    tol=0.0, reg=0.02, elastic_net=0.4, method=method,
+                    **kw)
+    coeffs, loss = SGD(prm).optimize(BinaryLogisticLoss(), np.zeros(10),
+                                     x, y, mesh=mesh)
+    return coeffs, loss
+
+
+def test_momentum_and_adam_converge_faster_than_sgd():
+    mesh = submesh(1)
+    losses = {m: _sgd_fit(mesh, 0, m)[1]
+              for m in ("sgd", "momentum", "adam")}
+    # the stateful rules make real progress where 8 plain-sgd rounds at
+    # this learning rate barely move — the convergence bar
+    assert losses["momentum"] < losses["sgd"]
+    assert losses["adam"] < losses["sgd"]
+
+
+def test_unknown_method_rejected():
+    from flink_ml_tpu.ops.optimizer import SGDParams, _check_method
+
+    with pytest.raises(ValueError, match="method"):
+        _check_method(SGDParams(method="adagrad"))
+
+
+@pytest.mark.parametrize("n_dev", MESH_SIZES)
+@pytest.mark.parametrize("method", ("momentum", "adam"))
+def test_stateful_parity_sharded_vs_replicated(monkeypatch, n_dev,
+                                               method):
+    """The ISSUE 14 parity matrix: moment state sharded 1/N per replica
+    produces the same fit as the replicated rule at every mesh size."""
+    mesh = submesh(n_dev)
+    monkeypatch.delenv(upd.ENV, raising=False)
+    c_rep, l_rep = _sgd_fit(mesh, 1, method)
+    monkeypatch.setenv(upd.ENV, "1")
+    c_sh, l_sh = _sgd_fit(mesh, 1, method)
+    assert c_sh.shape == c_rep.shape  # padding trimmed
+    np.testing.assert_allclose(c_sh, c_rep, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(l_sh, l_rep, rtol=1e-5)
+
+
+def test_adam_dense_vs_csr_parity():
+    """The host CSR trainer shares _update_rule (xp=np), so sparse and
+    dense adam fits agree like the sgd paths always have."""
+    import scipy.sparse as sp
+
+    from flink_ml_tpu.ops.losses import BinaryLogisticLoss
+    from flink_ml_tpu.ops.optimizer import SGD, SGDParams
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(300, 8))
+    y = (x @ rng.normal(size=8) > 0).astype(np.float64)
+    prm = SGDParams(learning_rate=0.1, global_batch_size=60, max_iter=6,
+                    tol=0.0, reg=0.01, elastic_net=0.2, method="adam")
+    mesh = submesh(2)
+    c_dense, _ = SGD(prm).optimize(BinaryLogisticLoss(), np.zeros(8), x,
+                                   y, mesh=mesh)
+    c_csr, _ = SGD(prm).optimize_csr(BinaryLogisticLoss(), np.zeros(8),
+                                     sp.csr_matrix(x), y, mesh=mesh)
+    np.testing.assert_allclose(c_csr, c_dense, rtol=1e-4, atol=1e-6)
+
+
+def test_sharded_adam_moment_bytes_shrink(monkeypatch):
+    """The 1/N memory claim measured from real device buffers: the
+    ``.moments`` record at N=8 is the N=1 size / 8 (plus the scalar
+    step counter)."""
+    monkeypatch.setenv(upd.ENV, "1")
+    _sgd_fit(submesh(1), 3, "adam", eps=1e-8)
+    from flink_ml_tpu.ops.losses import BinaryLogisticLoss
+    from flink_ml_tpu.ops.optimizer import SGD, SGDParams
+
+    rng = np.random.default_rng(3)
+    d = 64
+    x = rng.normal(size=(400, d))
+    y = (x @ rng.normal(size=d) > 0).astype(np.float64)
+    prm = SGDParams(learning_rate=0.1, global_batch_size=80, max_iter=4,
+                    tol=0.0, method="adam")
+
+    SGD(prm).optimize(BinaryLogisticLoss(), np.zeros(d), x, y,
+                      mesh=submesh(1), tag="adam-n1")
+    b1 = upd.last_state_bytes("adam-n1.moments")
+    SGD(prm).optimize(BinaryLogisticLoss(), np.zeros(d), x, y,
+                      mesh=submesh(8), tag="adam-n8")
+    b8 = upd.last_state_bytes("adam-n8.moments")
+    # m + v full (2 * 64 * 4 B) + scalar t vs the 1/8 slices + t
+    assert b1 == 2 * d * 4 + 4
+    assert b8 == 2 * (d // 8) * 4 + 4
+
+
+def test_momentum_model_param_plumbing():
+    """HasOptimizerMethod reaches SGDParams through the estimator."""
+    from flink_ml_tpu.common.table import Table
+    from flink_ml_tpu.models.classification import LogisticRegression
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(200, 6)).astype(np.float32)
+    y = (x @ rng.normal(size=6) > 0).astype(np.float64)
+    t = Table.from_columns(features=x, label=y)
+    m_sgd = LogisticRegression(max_iter=6).fit(t)
+    m_adam = LogisticRegression(max_iter=6, optimizer="adam",
+                                beta1=0.8).fit(t)
+    assert not np.allclose(m_sgd.coefficients, m_adam.coefficients)
+    est = LogisticRegression().params_from_json(
+        LogisticRegression(optimizer="momentum",
+                           momentum=0.7).params_to_json())
+    assert est.optimizer == "momentum" and est.momentum == 0.7
+
+
+# -- chaos restart: sharded-adam segment fit through the v2 manifest ----------
+
+def test_sharded_adam_segmented_restart_bit_identical(monkeypatch,
+                                                      tmp_path):
+    """A sharded-adam segmented fit killed at a segment boundary
+    resumes from the v2-manifest checkpoint — the dim-0-sharded m/v
+    moment slices restore onto their owning replicas through the carry
+    template — and finishes bit-identical to the uninterrupted fit."""
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.iteration.iteration import IterationConfig
+    from flink_ml_tpu.resilience import InjectedFault, faults
+
+    monkeypatch.setenv(upd.ENV, "1")
+    mesh = submesh(8)
+    clean, _ = _sgd_fit(mesh, 4, "adam")
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    cfg = IterationConfig(mode="device", checkpoint_interval=2,
+                          checkpoint_manager=mgr)
+    with faults.chaos(at={"epoch-boundary": [2]}):
+        with pytest.raises(InjectedFault):
+            _sgd_fit_cfg(mesh, 4, "adam", cfg)
+    assert mgr.list_checkpoints()  # a mid-fit snapshot survived
+
+    resumed, _ = _sgd_fit_cfg(mesh, 4, "adam", cfg)
+    np.testing.assert_allclose(resumed, clean, rtol=1e-6, atol=1e-12)
+    assert not mgr.list_checkpoints()  # success cleared them
+
+
+def _sgd_fit_cfg(mesh, seed, method, cfg):
+    from flink_ml_tpu.ops.losses import BinaryLogisticLoss
+    from flink_ml_tpu.ops.optimizer import SGD, SGDParams
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(400, 10))
+    y = (x @ rng.normal(size=10) > 0).astype(np.float64)
+    prm = SGDParams(learning_rate=0.1, global_batch_size=80, max_iter=8,
+                    tol=0.0, reg=0.02, elastic_net=0.4, method=method)
+    return SGD(prm).optimize(BinaryLogisticLoss(), np.zeros(10), x, y,
+                             mesh=mesh, config=cfg)
+
+
+def test_adam_checkpoint_carry_includes_moment_leaves(monkeypatch,
+                                                      tmp_path):
+    """The v2 manifest of a sharded-adam segment snapshot records the
+    moment leaves (coeffs, offsets, loss, m, v, t = 6) while a plain
+    sgd snapshot keeps the stateless-era 3-leaf layout."""
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.iteration.iteration import IterationConfig
+    from flink_ml_tpu.resilience import InjectedFault, faults
+
+    monkeypatch.setenv(upd.ENV, "1")
+    mesh = submesh(8)
+    for method, leaves in (("sgd", 3), ("adam", 6)):
+        mgr = CheckpointManager(str(tmp_path / f"ck-{method}"))
+        cfg = IterationConfig(mode="device", checkpoint_interval=2,
+                              checkpoint_manager=mgr)
+        with faults.chaos(at={"epoch-boundary": [2]}):
+            with pytest.raises(InjectedFault):
+                _sgd_fit_cfg(mesh, 5, method, cfg)
+        name = mgr.list_checkpoints()[-1]
+        with open(tmp_path / f"ck-{method}" / name /
+                  "manifest.json") as f:
+            manifest = json.load(f)
+        assert manifest["num_leaves"] == leaves, method
+
+
+# -- process-labeled trace artifacts ------------------------------------------
+
+def test_artifact_suffix_single_process(monkeypatch):
+    from flink_ml_tpu.observability.exporters import artifact_suffix
+
+    monkeypatch.delenv(dist.NUM_PROCESSES_ENV, raising=False)
+    monkeypatch.delenv(dist.PROCESS_ID_ENV, raising=False)
+    assert artifact_suffix() == str(os.getpid())
+
+
+def test_artifact_suffix_multiprocess(monkeypatch):
+    from flink_ml_tpu.observability.exporters import artifact_suffix
+
+    monkeypatch.setenv(dist.NUM_PROCESSES_ENV, "2")
+    monkeypatch.setenv(dist.PROCESS_ID_ENV, "1")
+    assert artifact_suffix() == f"p1-{os.getpid()}"
+
+
+def test_span_records_carry_process_label(monkeypatch, tmp_path):
+    """Spans written in a multi-process runtime land in
+    ``spans-p<k>-<pid>.jsonl`` and each record carries ``process`` —
+    the merge-side attribution two same-pid hosts depend on."""
+    from flink_ml_tpu.observability import tracing
+    from flink_ml_tpu.observability.exporters import (
+        dump_metrics, read_spans)
+
+    monkeypatch.setenv(dist.NUM_PROCESSES_ENV, "2")
+    monkeypatch.setenv(dist.PROCESS_ID_ENV, "1")
+    tracing.tracer.configure(str(tmp_path))
+    try:
+        with tracing.tracer.span("unit"):
+            pass
+        metrics_path = dump_metrics(str(tmp_path))
+    finally:
+        tracing.tracer.configure(None)
+    span_files = [f for f in os.listdir(tmp_path)
+                  if f.startswith("spans-")]
+    assert span_files == [f"spans-p1-{os.getpid()}.jsonl"]
+    assert os.path.basename(metrics_path) == \
+        f"metrics-p1-{os.getpid()}.json"
+    (rec,) = read_spans(str(tmp_path))
+    assert rec["process"] == 1
+
+
+def test_summary_attributes_spans_per_process(tmp_path):
+    """A merged dir with span files from two processes rolls up a
+    per-process span count in ``mltrace summary``."""
+    from flink_ml_tpu.observability.cli import summarize
+    from flink_ml_tpu.observability.exporters import read_spans
+
+    for proc, pid in ((0, 1234), (1, 1234)):  # same pid, two hosts
+        path = tmp_path / f"spans-p{proc}-{pid}.jsonl"
+        path.write_text(json.dumps({
+            "type": "span", "name": "fit", "trace": f"t{proc}",
+            "id": f"{proc}-1", "parent": None, "ts_us": proc,
+            "dur_us": 5, "pid": pid, "tid": 1, "attrs": {},
+            "events": [], "process": proc}) + "\n")
+    summary = summarize(read_spans(str(tmp_path)))
+    assert summary["processes"] == {"0": 1, "1": 1}
+
+
+def test_single_process_spans_have_no_process_field(monkeypatch,
+                                                    tmp_path):
+    from flink_ml_tpu.observability import tracing
+    from flink_ml_tpu.observability.exporters import read_spans
+
+    monkeypatch.delenv(dist.NUM_PROCESSES_ENV, raising=False)
+    tracing.tracer.configure(str(tmp_path))
+    try:
+        with tracing.tracer.span("unit"):
+            pass
+    finally:
+        tracing.tracer.configure(None)
+    (rec,) = read_spans(str(tmp_path))
+    assert "process" not in rec
