@@ -291,6 +291,11 @@ def _worker(role: str) -> int:
     except Exception:  # noqa: BLE001 — provenance only
         line["drift_psi_max"] = None
         line["baseline_version"] = None
+    # causal-tracing cost provenance (scripts/serve_bench.py measures
+    # it as traced-vs-untraced steady-state serving p99, gated <= 5% —
+    # BENCH_serving.json traceOverheadPct); null on plain fit benches,
+    # carried on the shared one-liner schema like drift_psi_max
+    line["trace_overhead_pct"] = best.get("traceOverheadPct")
     if role == "cpu":
         # a host-CPU demo beating the README sample says nothing about
         # the TPU framework (VERDICT r3 weak #6: the r3 cpu ratio read

@@ -90,10 +90,12 @@ def shard_bounds(n_rows: int, workers: int):
     return bounds
 
 
-def _child_main(fn, lo, hi, wfd, chaos_action=None):
+def _child_main(fn, lo, hi, wfd, chaos_action=None, parent_ctx=None):
     status, payload = 0, None
     # fork re-seed (docs/observability.md): the child's spans go to its
-    # own spans-<pid>.jsonl parented to the inherited dispatch span, and
+    # own spans-<pid>.jsonl parented to the dispatching span — the
+    # TraceContext the parent captured PRE-fork (race-free against
+    # other driver threads mutating their own span stacks) — and
     # its registry restarts empty so the end-of-shard snapshot shipped
     # back holds only child-produced metrics. reseed_child (NOT clear):
     # inherited locks may be held by a driver thread that doesn't exist
@@ -101,7 +103,7 @@ def _child_main(fn, lo, hi, wfd, chaos_action=None):
     from flink_ml_tpu.common.metrics import metrics
     from flink_ml_tpu.observability import tracing
 
-    tracing.tracer.reseed_child()
+    tracing.tracer.reseed_child(parent_ctx)
     metrics.reseed_child()
     # the live telemetry endpoint is driver-only: if the parent armed
     # one, close the inherited listener fd and pin it shut in the child
@@ -317,13 +319,19 @@ def _fork_sliding(fn, shards, workers, timeout_s=None):
             hang_count = faults.decide("hostpool-hang")
             if hang_count:
                 chaos_action = ("hang", hang_count)
+        # the dispatching span's context, captured on THIS thread
+        # before the fork: the child's spans parent to it explicitly
+        # instead of inferring from the inherited thread-locals
+        from flink_ml_tpu.observability import tracing
+
+        parent_ctx = tracing.tracer.current_context()
         rfd, wfd = os.pipe()
         pid = os.fork()
         if pid == 0:  # child: never returns
             os.close(rfd)
             for other_fd in list(live):
                 os.close(other_fd)
-            _child_main(fn, lo, hi, wfd, chaos_action)
+            _child_main(fn, lo, hi, wfd, chaos_action, parent_ctx)
         os.close(wfd)
         deadline = time.monotonic() + timeout_s if bounded else None
         child = _Child(pid, next_shard, rfd, deadline)

@@ -224,13 +224,18 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
     if restored is not None:
         carry, epoch = restored
     stop = False
+    prev_ctx = None
     while epoch < max_iter and not stop:
         # realign to the K-grid so `epoch % K == 0` keeps firing after an
         # off-phase restore
         limit = min(epoch + K - epoch % K, max_iter)
         seg_start = _time.perf_counter()
+        # each segment follows from the previous one: the explicit
+        # carry-handoff edge `flink-ml-tpu-trace path` walks
         with tracing.tracer.span("segment", epoch_from=epoch,
-                                 epoch_to=limit) as sp:
+                                 epoch_to=limit,
+                                 links=([prev_ctx] if prev_ctx
+                                        else None)) as sp:
             carry, e, s = run_segment(carry, epoch, limit)
             if tracing.tracer.enabled:
                 # per-shard time-to-ready at the boundary: the straggler
@@ -258,6 +263,7 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
                 # point, so the sample costs no extra device round-trip;
                 # silent no-op on CPU)
                 compilestats.sample_memory("segment", span=sp)
+            prev_ctx = tracing.context_of(sp)
         # per-segment metrics: the host-sync boundary is already here, so
         # the counters cost no extra device round-trip
         seg_ms = (_time.perf_counter() - seg_start) * 1000.0
@@ -395,9 +401,14 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
             carry, start_epoch = restored
 
     import time as _time
+    prev_ctx = None
     for epoch in range(start_epoch, max_iter):
         round_start = _time.perf_counter()
-        with tracing.tracer.span("epoch", epoch=epoch) as sp:
+        # epoch N follows from epoch N-1: the carry-handoff edge the
+        # critical-path view (`flink-ml-tpu-trace path`) walks
+        with tracing.tracer.span("epoch", epoch=epoch,
+                                 links=([prev_ctx] if prev_ctx
+                                        else None)) as sp:
             if config.per_round_init is not None:
                 carry = config.per_round_init(carry, epoch)
             carry, stop = round_fn(
@@ -431,6 +442,7 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
                 # per-epoch HBM watermark, taken after the stop-bit sync
                 # so the round's allocations are visible (no-op on CPU)
                 compilestats.sample_memory("epoch", span=sp)
+            prev_ctx = tracing.context_of(sp)
         iter_group.gauge("lastRoundMs", total_ms)
         iter_group.gauge("lastRoundHostMs", host_ms)
         iter_group.gauge("lastRoundDeviceMs", total_ms - host_ms)

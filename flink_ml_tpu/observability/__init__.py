@@ -16,6 +16,13 @@ captures training-time distribution baselines at fit time, sketches
 live serving traffic with mergeable streaming sketches, and compares
 the two (PSI / Jensen-Shannon / KS) per model version — inspect with
 ``flink-ml-tpu-trace drift <dir>`` or the live ``/drift`` route.
+Causal tracing (``tracing.TraceContext``) carries span context across
+threads, the host-pool fork, the multi-process launcher and the
+ops-controller cycle; ``flink-ml-tpu-trace path <dir>`` attributes
+per-request wall time along the span DAG, and the flight recorder
+(``flightrecorder``) dumps ``incident-<seq>/`` evidence bundles on SLO
+violations, divergence, drift and rollbacks — inspect with
+``flink-ml-tpu-trace incident <dir>``.
 """
 
 from flink_ml_tpu.observability.compilestats import (
@@ -81,11 +88,22 @@ from flink_ml_tpu.observability.meshstats import (
     record_input_health,
     record_shard_rows,
 )
+from flink_ml_tpu.observability.flightrecorder import (
+    INCIDENT_EVENT,
+    acknowledge,
+    read_incidents,
+    record_incident,
+)
+from flink_ml_tpu.observability.path import analyze_paths
 from flink_ml_tpu.observability.tracing import (
     TRACE_DIR_ENV,
+    TRACE_PARENT_ENV,
     Span,
+    TraceContext,
     Tracer,
+    current_context,
     event,
+    fresh_context,
     span,
     tracer,
 )
@@ -102,16 +120,25 @@ __all__ = [
     "drift_report",
     "install_baseline",
     "observe_transform",
+    "INCIDENT_EVENT",
     "METRICS_PORT_ENV",
     "SKEW_EVENT",
     "SLO",
     "SLO_EVENT",
     "SLO_SPEC_ENV",
     "TRACE_DIR_ENV",
+    "TRACE_PARENT_ENV",
     "ConvergenceListener",
     "Span",
+    "TraceContext",
     "TelemetryServer",
     "Tracer",
+    "acknowledge",
+    "analyze_paths",
+    "current_context",
+    "fresh_context",
+    "read_incidents",
+    "record_incident",
     "aot_compile",
     "check_fit",
     "convergence_row",

@@ -17,6 +17,8 @@ rerunning anything:
     flink-ml-tpu-trace slo TRACE_DIR --check     # SLO verdicts (exit 4)
     flink-ml-tpu-trace drift TRACE_DIR --check   # drift verdicts (exit 4)
     flink-ml-tpu-trace controller TRACE_DIR --check  # ops loop (exit 4)
+    flink-ml-tpu-trace path TRACE_DIR --check --budget 50  # critical path
+    flink-ml-tpu-trace incident TRACE_DIR --check  # flight recorder (exit 4)
     flink-ml-tpu-trace ROOT --latest             # newest trace dir under ROOT
 
 Sections: top spans by self-time (time in a span minus its children —
@@ -51,7 +53,18 @@ gate; the live verdicts come from the ``/drift`` endpoint. The
 the ops-controller timeline — triggers, state transitions, cycle
 outcomes, rollbacks — and with ``--check`` exits 4 unless every
 controller ended healthy (no failed cycles, final state ``watching``),
-2 on missing telemetry: the gate of the chaos-armed ops smoke. Every
+2 on missing telemetry: the gate of the chaos-armed ops smoke. The
+``path`` subcommand (observability/path.py) reconstructs the span DAG
+(parent links + the explicit ``follows_from`` handoff links) and
+attributes each serving request's wall time to named segments — queue
+wait, padding, the pipeline handoff, device dispatch, result fetch —
+plus the per-epoch host/device split; ``--check`` exits 2 with no
+reconstructable requests and, with ``--budget PCT``, 4 when the
+queue-wait share exceeds the budget. The ``incident`` subcommand
+(observability/flightrecorder.py) renders the flight recorder's
+``incident-<seq>/`` bundles — the triggering event plus the span ring
+that preceded it — and with ``--check`` exits 4 while any
+unacknowledged incident exists (``--ack`` marks them reviewed). Every
 subcommand accepts ``--latest``:
 treat the positional dir as a root and resolve the newest trace dir
 under it (exporters.resolve_trace_dir) — no more hand-globbing.
@@ -246,6 +259,20 @@ def main(argv=None) -> int:
         )
 
         return controller_main(argv[1:])
+    if argv and argv[0] == "path":
+        # critical-path view (observability/path.py); same dispatch
+        # rule — use ./path to summarize a directory named "path"
+        from flink_ml_tpu.observability.path import main as path_main
+
+        return path_main(argv[1:])
+    if argv and argv[0] == "incident":
+        # flight-recorder bundles (observability/flightrecorder.py);
+        # same dispatch rule — ./incident summarizes such a directory
+        from flink_ml_tpu.observability.flightrecorder import (
+            main as incident_main,
+        )
+
+        return incident_main(argv[1:])
     if argv and argv[0] == "summary":
         # explicit subcommand spelling for the default view, so
         # unattended consumers can write `summary --json` without

@@ -71,6 +71,13 @@ def latest_trace_dir(root: str) -> Optional[str]:
             except OSError:
                 continue
             d = os.path.dirname(path)
+            if os.path.basename(d).startswith("incident-"):
+                # a flight-recorder bundle (observability/
+                # flightrecorder.py) carries spans-recent.jsonl /
+                # metrics.json copies of its OWNING trace dir — it is
+                # evidence inside a trace dir, never the trace dir
+                # itself (and it is always the newest thing around)
+                continue
             candidates[d] = max(candidates.get(d, 0.0), mtime)
     if not candidates:
         return None
@@ -125,6 +132,12 @@ def chrome_trace_events(spans: List[dict]) -> List[dict]:
         args["span_id"] = sp.get("id")
         if sp.get("parent"):
             args["parent_id"] = sp["parent"]
+        if sp.get("links"):
+            # the follows_from handoff edges (tracing.TraceContext):
+            # Perfetto has no native link rendering, but the ids in
+            # args make the DAG walkable from the event inspector
+            args["follows_from"] = [ln.get("span")
+                                    for ln in sp["links"]]
         events.append({
             "name": sp.get("name", "?"),
             "cat": "span",
@@ -212,6 +225,13 @@ def dump_metrics(trace_dir: str,
     live-sketch state dumps alongside as ``drift-<pid>.json`` — a no-op
     for processes that never sketched."""
     os.makedirs(trace_dir, exist_ok=True)
+    if registry is metrics:
+        # fold the span-ring eviction tally into ml.tracing
+        # droppedSpans before snapshotting — the per-span hot path
+        # only increments an int (tracing.Tracer.mirror_dropped)
+        from flink_ml_tpu.observability import tracing
+
+        tracing.tracer.mirror_dropped()
     path = os.path.join(trace_dir, f"metrics-{artifact_suffix()}.json")
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:

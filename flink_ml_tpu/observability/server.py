@@ -41,6 +41,10 @@ route             serves                                      response with no d
                   (serving/controller.py): state machine      — no controller registered
                   position, cycle, canary version/fraction,   a provider
                   cycle outcomes, recent transitions
+``/incidents``    the flight recorder's incident bundles      200 with an empty
+                  (observability/flightrecorder.py) under     ``incidents`` list — nothing
+                  the armed trace dir, plus the span-ring     recorded, or no trace dir
+                  ``dropped_spans`` truncation count          armed
 ``/spans/recent`` the tracer's in-memory ring of recently     200 ``{"spans": []}``
                   closed spans (tracing.RECENT_SPANS;
                   arming the endpoint flips
@@ -104,6 +108,10 @@ ROUTE_TABLE = {
     "/controller": ("_route_controller",
                     '200 {"controller": null} — no ops controller '
                     'registered a provider (serving/controller.py)'),
+    "/incidents": ("_route_incidents",
+                   '200 with an empty "incidents" list — the flight '
+                   'recorder (observability/flightrecorder.py) has '
+                   'dumped no bundle, or no trace dir is armed'),
     "/spans/recent": ("_route_spans_recent", '200 {"spans": []}'),
 }
 
@@ -281,6 +289,23 @@ class _Handler(BaseHTTPRequestHandler):
         status = provider() if provider is not None else None
         self._send(200, json.dumps(_json_safe({"controller": status}),
                                    default=str), _JSON_CTYPE)
+
+    def _route_incidents(self) -> None:
+        from flink_ml_tpu.observability import flightrecorder
+
+        trace_dir = tracing.tracer.trace_dir
+        # include_spans=False: a polling monitor must not re-parse
+        # every bundle's span evidence per scrape; the meta's own
+        # "spans" count says how much each bundle holds
+        rows = (flightrecorder.read_incidents(trace_dir,
+                                              include_spans=False)
+                if trace_dir else [])
+        slim = [{k: v for k, v in r.items() if k != "recent_spans"}
+                for r in rows]
+        self._send(200, json.dumps(
+            {"trace_dir": trace_dir, "incidents": slim,
+             "dropped_spans": tracing.tracer.mirror_dropped()},
+            default=str), _JSON_CTYPE)
 
     def _route_spans_recent(self) -> None:
         # deque.append is thread-safe but ITERATION is not: serving

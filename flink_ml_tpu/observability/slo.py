@@ -475,6 +475,20 @@ def evaluate_slos(slos: Optional[Sequence[SLO]] = None, registry=None,
                 "slo_violations", labels={"slo": slo.name})
             tracing.tracer.event(SLO_EVENT, slo=slo.name, ok=False,
                                  failing=",".join(failing))
+            try:
+                # flight recorder (observability/flightrecorder.py):
+                # freeze the span ring + windowed metrics that explain
+                # the violation before they rotate away — debounced,
+                # capped, no-op without an armed trace dir, and
+                # re-entrancy-latched (building a bundle evaluates
+                # SLOs itself, non-emitting)
+                from flink_ml_tpu.observability import flightrecorder
+
+                flightrecorder.record_incident(
+                    "slo", slo=slo.name, failing=",".join(failing))
+            except Exception:  # noqa: BLE001 — recording must never
+                # break the evaluation that detected the violation
+                pass
     return verdicts
 
 

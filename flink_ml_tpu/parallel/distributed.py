@@ -277,6 +277,19 @@ def launch(argv: Sequence[str], num_processes: int, local_devices: int = 1,
     base[COORDINATOR_ENV] = f"127.0.0.1:{port}"
     base[NUM_PROCESSES_ENV] = str(int(num_processes))
     base[LOCAL_DEVICES_ENV] = str(int(local_devices))
+    # causal stitching (docs/observability.md "Causal tracing"): every
+    # child inherits ONE trace context through the env — the launcher's
+    # current span when it has one, else a fresh trace-only context —
+    # so each process's root spans join the SAME trace and the merged
+    # spans-p<k>-*.jsonl artifacts stitch into one causal run instead
+    # of N disconnected per-process traces. An explicitly provided
+    # parent (env= or the surrounding environment) wins.
+    from flink_ml_tpu.observability import tracing
+
+    if not base.get(tracing.TRACE_PARENT_ENV):
+        ctx = (tracing.tracer.current_context()
+               or tracing.fresh_context())
+        base[tracing.TRACE_PARENT_ENV] = ctx.to_header()
     flags = base.get("XLA_FLAGS", "")
     # strip any inherited device-count flag: the child's count must be
     # the launcher's, not the parent test env's

@@ -41,13 +41,24 @@ sharded ticks, ``serving.pad`` + ``serving.batch`` spans per tick
 (sharing a ``tick`` attr — overlapping spans ARE the pipelining proof),
 and a ``/serving`` route (observability/server.py) exposing queue
 depth, the bucket table, pipeline depth, mesh and the active model
-version. See docs/serving.md.
+version. Causal tracing (docs/observability.md "Causal tracing,
+critical path & incidents"): every sampled request anchors a
+``serving.submit`` span on the caller's thread whose
+:class:`~flink_ml_tpu.observability.tracing.TraceContext` rides the
+request through the admission queue AND the pad→device handoff — the
+tick's pad/batch spans record explicit ``follows_from`` links back to
+the requests they serve (and the batch to the pad that prepared it),
+and a ``serving.resolve`` span in the request's own trace closes the
+submit→pad→batch→resolve chain, so ``flink-ml-tpu-trace path``
+decomposes per-request latency into queue/pad/handoff/device/resolve
+segments. See docs/serving.md.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import itertools
 import queue
 import threading
 import time
@@ -61,6 +72,7 @@ from flink_ml_tpu.observability.health import (
     SERVING_HORIZON_S,
     SERVING_SLICES,
     observe_serving_rejected,
+    trace_sampled,
 )
 from flink_ml_tpu.servable.api import (
     DataFrame,
@@ -202,7 +214,7 @@ def _row_signature(row) -> tuple:
 
 class _Request:
     __slots__ = ("df", "rows", "n", "schema", "future", "t_enqueue",
-                 "deadline_s")
+                 "deadline_s", "seq", "ctx")
 
     def __init__(self, df: DataFrame, deadline_ms: Optional[float]):
         self.df = df
@@ -216,6 +228,16 @@ class _Request:
         self.t_enqueue = time.perf_counter()
         self.deadline_s = (None if deadline_ms is None
                            else self.t_enqueue + deadline_ms / 1000.0)
+        #: per-batcher request ordinal — the ``req=`` attr joining this
+        #: request's serving.submit span to its serving.resolve span
+        #: (observability/path.py)
+        self.seq: Optional[int] = None
+        #: the request's TraceContext (its serving.submit span, itself
+        #: a child of whatever span the CALLER had open) — rides the
+        #: Future to the device stage so the tick's serving.pad/
+        #: serving.batch spans can link back follows_from, and the
+        #: resolve span re-enters the caller's trace
+        self.ctx = None
 
 
 class _Prepared:
@@ -224,7 +246,8 @@ class _Prepared:
     thread never touches the admission queue."""
 
     __slots__ = ("requests", "batch_df", "bucket", "n_real", "pad",
-                 "fill", "waste", "tick", "reused", "total_rows")
+                 "fill", "waste", "tick", "reused", "total_rows",
+                 "pad_ctx")
 
     def __init__(self, requests, batch_df, bucket, n_real, pad, fill,
                  waste, tick, reused):
@@ -238,6 +261,10 @@ class _Prepared:
         self.tick = tick
         self.reused = reused
         self.total_rows = 0  # drained-row accounting, set by the pad stage
+        #: the serving.pad span's TraceContext, riding the pad→device
+        #: queue handoff so the device stage's serving.batch span can
+        #: record the follows_from edge (observability/tracing.py)
+        self.pad_ctx = None
 
 
 class MicroBatcher:
@@ -295,6 +322,9 @@ class MicroBatcher:
         self._handoff: Optional[queue.Queue] = None
         self._ticks = 0
         self._tick_seq = 0
+        # next() on itertools.count is atomic under the GIL — submit
+        # runs on arbitrary caller threads before taking the cond lock
+        self._req_counter = itertools.count()
         self._served_requests = 0
         self._prev_status = None
         # pad-template cache, keyed by (schema, type key, bucket): the
@@ -375,6 +405,27 @@ class MicroBatcher:
         if deadline_ms is ...:
             deadline_ms = self.config.deadline_ms
         req = _Request(df, deadline_ms)
+        req.seq = next(self._req_counter)
+        if tracing.tracer.enabled and trace_sampled():
+            # the request's causal anchor: a near-instant span on the
+            # CALLER's thread — child of whatever span the caller has
+            # open — whose context rides the request to the dispatcher
+            # so the tick's pad/batch spans link back follows_from and
+            # the resolve span closes the submit→pad→batch→resolve
+            # chain in ONE trace (docs/observability.md "Causal
+            # tracing"). Opened BEFORE admission: the context must be
+            # attached before the pad stage can see the request, and a
+            # rejected request keeps its anchor too. Gated on
+            # ``enabled`` (an armed trace dir — the debugging/incident
+            # investigation mode), NOT on the always-on ring: the
+            # per-request chain serializes spans onto the device
+            # thread, and the ring-only production shape must stay
+            # within the serve_bench traceOverheadPct budget. Sampled
+            # with the serving.request spans
+            # (FLINK_ML_TPU_TRACE_SAMPLE).
+            with tracing.tracer.span("serving.submit", req=req.seq,
+                                     rows=req.n) as sp:
+                req.ctx = tracing.context_of(sp)
         cfg = self.config
         with self._cond:
             if self._stopping or self._thread is None:
@@ -523,9 +574,17 @@ class MicroBatcher:
         # the tick-drain boundary tests.
         pad = bucket - n_real
         reused = 0
+        # the tick follows from the requests it drained: explicit
+        # follows_from links to each request's submit context — with no
+        # local parent the pad span adopts the first link's trace id,
+        # so a single-request tick shares the request's trace outright
+        link_ctxs = [req.ctx for req in kept if req.ctx is not None]
+        pad_ctx = None
         with tracing.tracer.span("serving.pad", tick=tick,
                                  bucket=bucket, rows=n_real,
-                                 requests=len(kept), pad=pad):
+                                 requests=len(kept), pad=pad,
+                                 links=link_ctxs or None) as pad_sp:
+            pad_ctx = tracing.context_of(pad_sp)
             if pad:
                 types = kept[0].df.data_types
                 # the value-shape signature rides the key: the declared
@@ -557,8 +616,10 @@ class MicroBatcher:
         batch_df.drift_real_rows = n_real
         fill = n_real / bucket if bucket else 1.0
         waste = pad / bucket if bucket else 0.0
-        return _Prepared(kept, batch_df, bucket, n_real, pad, fill,
-                         waste, tick, reused)
+        prepared = _Prepared(kept, batch_df, bucket, n_real, pad, fill,
+                             waste, tick, reused)
+        prepared.pad_ctx = pad_ctx
+        return prepared
 
     def _release_inflight(self, rows: int) -> None:
         # called the moment the device stage takes a batch over: rows
@@ -626,11 +687,20 @@ class MicroBatcher:
                 slices=SERVING_SLICES, labels=labels).observe(
                     (now - req.t_enqueue) * 1000.0)
         t0 = time.perf_counter()
+        # the causal edges of this tick: the pad span whose prepared
+        # batch crossed the pipeline handoff, plus every request this
+        # batch serves — the links satellite-fixing "pad/batch carry
+        # only tick=": a request's latency now decomposes from the DAG
+        batch_links = [prep.pad_ctx] if prep.pad_ctx is not None else []
+        batch_links += [req.ctx for req in live if req.ctx is not None]
+        batch_ctx = None
         with tracing.tracer.span("serving.batch", servable=name,
                                  bucket=prep.bucket, rows=prep.n_real,
                                  requests=len(kept), tick=prep.tick,
                                  pipeline_depth=self.config
-                                 .pipeline_depth):
+                                 .pipeline_depth,
+                                 links=batch_links or None) as batch_sp:
+            batch_ctx = tracing.context_of(batch_sp)
             try:
                 out = servable.transform(prep.batch_df)
             except Exception as e:  # noqa: BLE001 — the batch fails,
@@ -652,8 +722,21 @@ class MicroBatcher:
         offset = 0
         for req in kept:
             if not req.future.done():
-                req.future.set_result(DataFrame(
-                    names, types, out_rows[offset:offset + req.n]))
+                result = DataFrame(
+                    names, types, out_rows[offset:offset + req.n])
+                if req.ctx is not None:
+                    # close the request's causal chain: a resolve span
+                    # in the REQUEST's trace (child of its submit span)
+                    # following from the batch that computed it — the
+                    # last segment `flink-ml-tpu-trace path` attributes
+                    with tracing.tracer.span(
+                            "serving.resolve", parent=req.ctx,
+                            links=([batch_ctx] if batch_ctx is not None
+                                   else None),
+                            req=req.seq, tick=prep.tick, rows=req.n):
+                        req.future.set_result(result)
+                else:
+                    req.future.set_result(result)
             offset += req.n
 
     def _record_tick(self, labels, bucket, n_real, pad, fill, waste,

@@ -254,6 +254,13 @@ class OpsController:
         self._pending: dict = {}
         self._cooldown_until = 0.0
         self._cycle_t0: Optional[float] = None
+        # the live cycle's trace: the step span where the trigger fired
+        # mints it (its ml.drift/ml.slo trigger events are INSIDE that
+        # span), every later step of the cycle links follows_from to
+        # the previous step's context and adopts the same trace id —
+        # one retrain→publish→canary→…→watching cycle reads as ONE
+        # trace chained across steps (docs/observability.md)
+        self._cycle_ctx = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
@@ -334,9 +341,14 @@ class OpsController:
         (possibly unchanged) state. Synchronous and deterministic given
         deterministic traffic/verdicts — the smoke's driver."""
         with self._lock:
+            in_cycle = self.state != WATCHING
+            links = ([self._cycle_ctx]
+                     if (in_cycle and self._cycle_ctx is not None)
+                     else None)
             with tracing.tracer.span("controller.step",
                                      model=self.model,
-                                     state=self.state):
+                                     state=self.state,
+                                     links=links) as sp:
                 handler = {
                     WATCHING: self._step_watching,
                     RETRAINING: self._step_retraining,
@@ -347,6 +359,17 @@ class OpsController:
                     ROLLING_BACK: self._step_rolling_back,
                 }[self.state]
                 handler()
+                ctx = tracing.context_of(sp)
+            if self.state != WATCHING:
+                # a cycle is (still) live: the NEXT step chains to this
+                # one. The step that triggered it (watching → retraining)
+                # mints the cycle trace — its trigger events ride along
+                self._cycle_ctx = ctx
+            elif not in_cycle or self._trigger is None:
+                # back in watching with no cycle pending: the chain is
+                # closed (the finishing step still linked to its
+                # predecessor above)
+                self._cycle_ctx = None
             return self.state
 
     def _transition(self, to: str, reason: str = "") -> None:

@@ -255,6 +255,12 @@ def _spawn_cell(n_procs: int, smoke: bool, hier=None, trace_dir=None,
         extra["FLINK_ML_TPU_HIER_REDUCE"] = hier
     if trace_dir:
         extra["FLINK_ML_TPU_TRACE_DIR"] = trace_dir
+        # causal stitching (docs/observability.md "Causal tracing"):
+        # one shared trace parent per cell, the distributed.launch
+        # recipe hand-rolled (this parent never imports the package) —
+        # every worker's root spans join ONE trace, gated below
+        extra["FLINK_ML_TPU_TRACE_PARENT"] = \
+            f"mhbench-{os.getpid():x}-{n_procs}:"
     argv = [sys.executable, os.path.abspath(__file__), "--worker"]
     if smoke:
         argv.append("--smoke")
@@ -460,6 +466,20 @@ def main(argv=None) -> int:
         failures.append(
             f"merged trace attributes spans to {len(procs_seen)} "
             f"process(es), wanted 2 (process labels missing?)")
+    # gate 6: the merged 2-process artifacts stitch into ONE trace —
+    # every worker's root spans joined the cell's shared
+    # FLINK_ML_TPU_TRACE_PARENT (docs/observability.md "Causal
+    # tracing, critical path & incidents")
+    traces_seen = None
+    try:
+        traces_seen = json.loads(summary.stdout).get("traces")
+    except (json.JSONDecodeError, AttributeError):
+        pass
+    record["gates"]["traceStitch"] = {"traces": traces_seen}
+    if traces_seen != 1:
+        failures.append(
+            f"merged 2-process trace holds {traces_seen} trace id(s), "
+            f"wanted 1 (FLINK_ML_TPU_TRACE_PARENT stitching broken?)")
 
     record["gates"]["ok"] = not failures
     record["failures"] = failures

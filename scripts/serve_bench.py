@@ -33,9 +33,24 @@ dispatcher's pad/compute span-overlap proof and ``mltrace shards
 
 Gates (exit codes follow the repo convention): 0 ok; 1 an acceptance
 gate failed (ratio < --min-ratio, steady compiles > 0, errors, p99 over
-budget, hot-swap missed, a mesh-sweep gate); 2 broken environment; 4
-the ``flink-ml-tpu-trace slo --check`` artifact gate found a violated
-SLO.
+budget, hot-swap missed, trace overhead > --trace-overhead-budget, a
+mesh-sweep gate); 2 broken environment; 4 the ``flink-ml-tpu-trace slo
+--check`` artifact gate found a violated SLO.
+
+The **trace-overhead** gate (docs/observability.md "Causal tracing,
+critical path & incidents"): the same closed-loop workload at equal
+offered load, measured with the ALWAYS-ON causal-tracing configuration
+(the recent-span ring armed, no trace dir — per-TICK pad/batch/request
+spans built and ringed; the per-REQUEST submit/resolve chain only arms
+with a trace dir, the debugging mode, so its cost shows in the
+informational ``diskTracedP99Ms``, not in this gate) and fully dark —
+interleaved best-of-N p99s; the ring-armed
+run must stay within ``--trace-overhead-budget`` (default 5%) of the
+dark one, recorded as ``traceOverheadPct`` in BENCH_serving.json and
+the bench.py one-liner. The budget enforces on >= 4-core
+hosts and records itself skipped on fewer (a 1-core box's p99 noise
+band is wider than the budget — the PR 11/12 precedent); a 50%
+collapse floor enforces everywhere.
 """
 
 from __future__ import annotations
@@ -442,6 +457,11 @@ def main(argv=None) -> int:
     parser.add_argument("--mesh-min-ratio", type=float, default=1.0,
                         help="sharded/unsharded throughput gate at the "
                              "max device count (>= 4-core hosts)")
+    parser.add_argument("--trace-overhead-budget", type=float,
+                        default=5.0,
+                        help="max traced-vs-untraced steady-state p99 "
+                             "overhead (percent) — the always-on "
+                             "causal-tracing ring must stay cheap")
     args = parser.parse_args(argv)
 
     if args.mesh_cell:
@@ -453,6 +473,14 @@ def main(argv=None) -> int:
     trace_dir = os.path.join(root, "trace")
     os.environ["FLINK_ML_TPU_TRACE_DIR"] = trace_dir
     os.environ.setdefault("FLINK_ML_TPU_METRICS_PORT", "0")
+    # the HEADLINE runs measure serving, not debugging-mode tracing:
+    # with the dir armed and the default sample rate, every request
+    # would pay the per-request submit/resolve causal chain serialized
+    # onto the device thread (the diskTracedP99Ms informational probe
+    # below shows that mode costs multiples of dark p99) — the ratchet
+    # numbers and the CI >= 3x gate must not ratchet against it. The
+    # overhead probe re-arms the sample for its disk-traced leg.
+    os.environ.setdefault("FLINK_ML_TPU_TRACE_SAMPLE", "0")
 
     from flink_ml_tpu.observability import server, slo, tracing
     from flink_ml_tpu.observability.exporters import dump_metrics
@@ -533,6 +561,78 @@ def main(argv=None) -> int:
           f"steady compiles {steady_compiles}, "
           f"model now v{swapped_version}")
 
+    # -- trace overhead: ring-armed vs dark steady-state p99 -----------------
+    # The ALWAYS-ON half of causal tracing is the recent-span ring
+    # (tracing.Tracer.recent — the flight recorder's evidence and the
+    # /spans/recent route): production serving runs with the ring armed
+    # and NO trace dir, so that is the configuration whose cost the
+    # gate bounds. Same closed-loop workload at equal offered load,
+    # best-of-N p99: ring armed (the per-TICK pad/batch/request spans
+    # built and ringed, nothing on disk — the per-request
+    # submit/resolve chain gates on an armed trace dir, so it is NOT
+    # in this shape) vs fully dark (no spans at all), gated at
+    # --trace-overhead-budget (default 5%). The full disk-traced p99
+    # (dir + per-span flush + the per-request chain, the debugging
+    # mode the measured runs above used) rides along as informational
+    # provenance, not a gate.
+    def overhead_p99(repeats: int = 3) -> float:
+        n = max(120, n_requests // 2)
+        best = None
+        for _ in range(max(1, repeats)):
+            r = run_loadgen(batcher.submit, request_frame,
+                            LoadGenConfig(mode="closed", requests=n,
+                                          concurrency=args.concurrency))
+            p = r["latency_ms"]["p99"]
+            best = p if best is None else min(best, p)
+        return best
+
+    saved_sample = os.environ.get("FLINK_ML_TPU_TRACE_SAMPLE")
+    os.environ["FLINK_ML_TPU_TRACE_SAMPLE"] = "1"  # the probes run at
+    # the DEFAULT sampling: the disk leg measures the full debugging
+    # mode (per-request chain and all), the ring leg the full
+    # always-on production shape — not the headline runs' sample=0
+    disk_traced_p99 = overhead_p99()
+    tracing.tracer.shutdown()       # close the sink; env still armed
+    saved_dir = os.environ.pop("FLINK_ML_TPU_TRACE_DIR")
+    saved_ring = tracing.tracer.keep_recent
+    traced_p99 = untraced_p99 = None
+    try:
+        # interleave the A/B runs: host-load drift on a shared runner
+        # must hit both modes equally, or the "overhead" would just
+        # measure which half-minute was noisier (best-of-N min per
+        # mode then kills the outliers)
+        for _ in range(4):
+            tracing.tracer.keep_recent = True   # always-on production
+            p = overhead_p99(repeats=1)         # shape: ring, no dir
+            traced_p99 = p if traced_p99 is None else min(traced_p99,
+                                                          p)
+            tracing.tracer.keep_recent = False  # fully dark
+            p = overhead_p99(repeats=1)
+            untraced_p99 = p if untraced_p99 is None \
+                else min(untraced_p99, p)
+    finally:
+        os.environ["FLINK_ML_TPU_TRACE_DIR"] = saved_dir
+        tracing.tracer.keep_recent = saved_ring
+        if saved_sample is None:
+            os.environ.pop("FLINK_ML_TPU_TRACE_SAMPLE", None)
+        else:
+            os.environ["FLINK_ML_TPU_TRACE_SAMPLE"] = saved_sample
+    trace_overhead_pct = round(
+        (traced_p99 - untraced_p99) / max(untraced_p99, 1e-9) * 100.0,
+        2)
+    # the budget needs quiet hardware to mean anything: on a 1-core
+    # host the p99 noise band is wider than the budget itself (the
+    # PR 11 native-threading / PR 12 mesh-throughput precedent) —
+    # enforced on >= 4-core hosts, recorded skipped on fewer; an
+    # always-on 50% collapse floor catches a real regression anywhere
+    overhead_cores = os.cpu_count() or 1
+    overhead_enforced = overhead_cores >= 4
+    print(f"serve_bench: trace overhead — ring-armed p99 {traced_p99} "
+          f"ms vs dark {untraced_p99} ms ({trace_overhead_pct:+.2f}%; "
+          f"full disk tracing {disk_traced_p99} ms; budget "
+          f"{'enforced' if overhead_enforced else 'skipped'} on "
+          f"{overhead_cores} core(s))")
+
     # -- optional window/bucket sweep ----------------------------------------
     sweep = []
     if not args.smoke:
@@ -596,6 +696,20 @@ def main(argv=None) -> int:
                      "serving_version": swapped_version,
                      "swapped_mid_run": swapped_version == 2},
         "ftrl_train_ms": round(train_ms, 1),
+        # causal-tracing cost provenance (docs/observability.md
+        # "Causal tracing, critical path & incidents"): best-of-N p99
+        # at equal offered load, armed vs dark — the always-on ring +
+        # per-request spans must stay under the budget
+        "traceOverheadPct": trace_overhead_pct,
+        "trace_overhead": {"tracedP99Ms": traced_p99,
+                           "untracedP99Ms": untraced_p99,
+                           "diskTracedP99Ms": disk_traced_p99,
+                           "budgetPct": args.trace_overhead_budget,
+                           "hostCores": overhead_cores,
+                           "enforced": overhead_enforced,
+                           "skipped": (None if overhead_enforced else
+                                       f"host has {overhead_cores} "
+                                       f"core(s)")},
         "sweep": sweep,
         "mesh_sweep": mesh_sweep,
     }
@@ -636,6 +750,15 @@ def main(argv=None) -> int:
     if ratio < args.min_ratio:
         fail(1, f"batched/per-request ratio {ratio:.2f} below "
                 f"{args.min_ratio}")
+    if overhead_enforced and \
+            trace_overhead_pct > args.trace_overhead_budget:
+        fail(1, f"traced steady-state p99 is {trace_overhead_pct:.2f}% "
+                f"over untraced — the causal-tracing layer exceeds its "
+                f"{args.trace_overhead_budget:g}% budget")
+    if trace_overhead_pct > 50.0:
+        fail(1, f"traced steady-state p99 is {trace_overhead_pct:.2f}% "
+                f"over untraced — the always-on ring collapsed serving "
+                f"latency (the unconditional floor)")
     if mesh_sweep is not None and not mesh_sweep["gates"]["ok"]:
         fail(1, "mesh sweep gates failed: "
                 + "; ".join(mesh_sweep["failures"]))

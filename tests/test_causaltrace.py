@@ -1,0 +1,698 @@
+"""End-to-end causal tracing (ISSUE 15): context propagation across
+threads/processes, critical-path analysis, and the flight recorder.
+
+Acceptance bar: ONE trace id spans submit→pad→batch→resolve across the
+pipelined batcher handoff (depth 0 AND >= 1) and a 2-process launcher
+run (stitched from merged per-process artifacts); ``mltrace path``
+attributes >= 90% of a request's wall time to named segments; a forced
+SLO violation produces an incident bundle that ``mltrace incident
+--check`` exits 4 on, with the triggering event and the preceding spans
+inside the bundle.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.hostpool import map_row_shards
+from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+from flink_ml_tpu.observability import flightrecorder, server, tracing
+from flink_ml_tpu.observability.cli import main as trace_cli
+from flink_ml_tpu.observability.exporters import read_spans
+from flink_ml_tpu.observability.path import (
+    analyze_paths,
+    main as path_main,
+)
+from flink_ml_tpu.observability.flightrecorder import (
+    main as incident_main,
+)
+from flink_ml_tpu.observability.slo import SLO, evaluate_slos
+from flink_ml_tpu.observability.tracing import (
+    TRACE_PARENT_ENV,
+    TraceContext,
+    tracer,
+)
+from flink_ml_tpu.servable.api import (
+    DataFrame,
+    DataTypes,
+    Row,
+    TransformerServable,
+)
+from flink_ml_tpu.serving import BatcherConfig, MicroBatcher
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.delenv(tracing.TRACE_DIR_ENV, raising=False)
+    monkeypatch.delenv(TRACE_PARENT_ENV, raising=False)
+    monkeypatch.delenv(tracing.RING_ENV, raising=False)
+    monkeypatch.delenv(flightrecorder.DEBOUNCE_ENV, raising=False)
+    monkeypatch.delenv(flightrecorder.MAX_ENV, raising=False)
+    server.stop()
+    flightrecorder.reset()
+    yield
+    tracer.shutdown()
+    tracer.attach_context(None)
+    server.stop()
+    flightrecorder.reset()
+
+
+def frame(rows: int) -> DataFrame:
+    return DataFrame(["x"], [DataTypes.DOUBLE],
+                     [Row([float(i)]) for i in range(rows)])
+
+
+class Echo(TransformerServable):
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df
+
+
+# -- TraceContext -------------------------------------------------------------
+
+def test_trace_context_round_trips():
+    ctx = TraceContext("abc-1", "def-2")
+    assert TraceContext.from_dict(ctx.to_dict()) == ctx
+    assert TraceContext.from_header(ctx.to_header()) == ctx
+    trace_only = TraceContext("abc-1")
+    assert TraceContext.from_header(trace_only.to_header()) == trace_only
+    assert TraceContext.from_header("") is None
+    assert TraceContext.from_header("no-colon") is None
+    assert TraceContext.from_header(":orphan-span") is None
+
+
+def test_span_parent_override_and_links(tmp_path):
+    tracer.configure(str(tmp_path))
+    with tracer.span("producer") as p:
+        ctx = tracing.context_of(p)
+    with tracer.span("consumer", parent=ctx):
+        pass
+    with tracer.span("follower", links=[ctx]) as f:
+        assert f.trace_id == ctx.trace_id  # link adoption
+    tracer.shutdown()
+    spans = {sp["name"]: sp for sp in read_spans(str(tmp_path))}
+    assert spans["consumer"]["trace"] == ctx.trace_id
+    assert spans["consumer"]["parent"] == ctx.span_id
+    assert spans["follower"]["parent"] is None
+    assert spans["follower"]["links"] == [
+        {"trace": ctx.trace_id, "span": ctx.span_id,
+         "kind": "follows_from"}]
+    # parent links stay the default: producer has neither
+    assert "links" not in spans["producer"]
+
+
+def test_env_trace_parent_stitches_root_spans(tmp_path, monkeypatch):
+    monkeypatch.setenv(TRACE_PARENT_ENV, "envtrace-1:envspan-2")
+    tracer.configure(str(tmp_path))
+    with tracer.span("root"):
+        with tracer.span("child"):
+            pass
+    tracer.shutdown()
+    spans = {sp["name"]: sp for sp in read_spans(str(tmp_path))}
+    assert spans["root"]["trace"] == "envtrace-1"
+    assert spans["root"]["parent"] == "envspan-2"
+    assert spans["child"]["trace"] == "envtrace-1"
+    # a malformed header must not sink span creation
+    monkeypatch.setenv(TRACE_PARENT_ENV, "garbage")
+    tracer.configure(str(tmp_path))  # shutdown() above disarmed it
+    with tracer.span("still-works") as sp:
+        assert sp.trace_id
+
+
+def test_attach_context_programmatic(tmp_path):
+    tracer.configure(str(tmp_path))
+    tracer.attach_context(TraceContext("t-9", "s-9"))
+    try:
+        with tracer.span("adopted") as sp:
+            assert sp.trace_id == "t-9" and sp.parent_id == "s-9"
+    finally:
+        tracer.attach_context(None)
+
+
+# -- the recent-span ring (flight-recorder evidence) --------------------------
+
+def test_ring_capacity_env_and_dropped_counter(tmp_path, monkeypatch):
+    monkeypatch.setenv(tracing.RING_ENV, "4")
+    t = tracing.Tracer()
+    t.configure(str(tmp_path / "ring"))
+    base = metrics.group(ML_GROUP, "tracing").get_counter("droppedSpans")
+    for i in range(7):
+        with t.span(f"s{i}"):
+            pass
+    assert t.recent.maxlen == 4
+    assert len(t.recent) == 4
+    assert [r["name"] for r in t.recent] == ["s3", "s4", "s5", "s6"]
+    assert t.dropped_spans == 3
+    # the hot path only tallies an int; the registry counter fills at
+    # mirror points (metrics dumps, incident bundles, /incidents)
+    assert metrics.group(ML_GROUP, "tracing").get_counter(
+        "droppedSpans") == base
+    assert t.mirror_dropped() == 3
+    assert metrics.group(ML_GROUP, "tracing").get_counter(
+        "droppedSpans") == base + 3
+    t.mirror_dropped()  # idempotent: no double count
+    assert metrics.group(ML_GROUP, "tracing").get_counter(
+        "droppedSpans") == base + 3
+    t.shutdown()
+    # garbage / non-positive values fall back to the default
+    monkeypatch.setenv(tracing.RING_ENV, "bogus")
+    assert tracing.ring_capacity() == tracing.RECENT_SPANS
+    monkeypatch.setenv(tracing.RING_ENV, "0")
+    assert tracing.ring_capacity() == tracing.RECENT_SPANS
+
+
+def test_ring_fills_with_trace_dir_only(tmp_path):
+    """The ring is the flight recorder's evidence: it must fill while a
+    trace dir is armed even when no live endpoint set keep_recent."""
+    t = tracing.Tracer()
+    t.configure(str(tmp_path))
+    assert not t.keep_recent
+    with t.span("evidence"):
+        pass
+    assert [r["name"] for r in t.recent] == ["evidence"]
+    t.shutdown()
+
+
+# -- batcher propagation: submit -> pad -> batch -> resolve -------------------
+
+@pytest.mark.parametrize("depth", [0, 1])
+def test_one_trace_spans_the_pipelined_handoff(tmp_path, depth):
+    d = str(tmp_path / f"depth{depth}")
+    tracer.configure(d)
+    with MicroBatcher(Echo(), BatcherConfig(
+            buckets=(1, 8), window_ms=1.0, pipeline_depth=depth)) as b:
+        fut = b.submit(frame(1))
+        fut.result(timeout=10)
+        time.sleep(0.05)  # the resolve span closes after set_result
+    tracer.shutdown()
+    spans = read_spans(d)
+    by_name = {sp["name"]: sp for sp in spans}
+    for name in ("serving.submit", "serving.pad", "serving.batch",
+                 "serving.resolve"):
+        assert name in by_name, (name, sorted(by_name))
+    trace_ids = {by_name[n]["trace"] for n in (
+        "serving.submit", "serving.pad", "serving.batch",
+        "serving.resolve")}
+    assert len(trace_ids) == 1, trace_ids
+    # the DAG edges: pad follows the submit, batch follows the pad (the
+    # queue handoff) and the request, resolve is a child of the submit
+    # span following from the batch
+    submit, pad = by_name["serving.submit"], by_name["serving.pad"]
+    batch, resolve = by_name["serving.batch"], by_name["serving.resolve"]
+    assert {ln["span"] for ln in pad["links"]} == {submit["id"]}
+    assert submit["id"] in {ln["span"] for ln in batch["links"]}
+    assert pad["id"] in {ln["span"] for ln in batch["links"]}
+    assert resolve["parent"] == submit["id"]
+    assert {ln["span"] for ln in resolve["links"]} == {batch["id"]}
+    # the _served request span nests inside the batch span
+    assert by_name["serving.request"]["parent"] == batch["id"]
+
+
+def test_caller_span_parents_the_request_trace(tmp_path):
+    """A caller with an open span keeps the whole chain in ITS trace —
+    per-request serving latency decomposes under the caller's root."""
+    d = str(tmp_path)
+    tracer.configure(d)
+    with MicroBatcher(Echo(), BatcherConfig(
+            buckets=(1, 8), window_ms=1.0)) as b:
+        with tracer.span("caller") as root:
+            root_trace = root.trace_id
+            b.submit(frame(1)).result(timeout=10)
+        time.sleep(0.05)
+    tracer.shutdown()
+    by_name = {sp["name"]: sp for sp in read_spans(d)}
+    assert by_name["serving.submit"]["trace"] == root_trace
+    assert by_name["serving.batch"]["trace"] == root_trace
+    assert by_name["serving.resolve"]["trace"] == root_trace
+
+
+def test_rejected_request_keeps_no_dangling_links(tmp_path):
+    d = str(tmp_path)
+    tracer.configure(d)
+    with MicroBatcher(Echo(), BatcherConfig(
+            buckets=(1, 2), window_ms=1.0)) as b:
+        from flink_ml_tpu.servable.api import RejectedRequest
+
+        with pytest.raises(RejectedRequest):
+            b.submit(frame(5)).result(timeout=10)  # too-large
+    tracer.shutdown()
+    names = [sp["name"] for sp in read_spans(d)]
+    assert "serving.submit" in names  # the anchor exists
+    assert "serving.resolve" not in names  # nothing resolved
+
+
+# -- critical-path analysis ---------------------------------------------------
+
+def test_path_attributes_90pct_of_request_wall_time(tmp_path):
+    d = str(tmp_path)
+    tracer.configure(d)
+    with MicroBatcher(Echo(), BatcherConfig(
+            buckets=(1, 4, 8), window_ms=2.0, pipeline_depth=1)) as b:
+        futs = [b.submit(frame(2)) for _ in range(6)]
+        for f in futs:
+            f.result(timeout=10)
+        time.sleep(0.05)
+    tracer.shutdown()
+    report = analyze_paths(read_spans(d))
+    req = report["requests"]
+    assert req["count"] == 6
+    assert req["coverage"] >= 0.9, req
+    # every named segment is present and the mix sums to ~1
+    assert set(req["segments_ms"]) == {
+        "submit", "queue", "pad", "handoff", "device", "resolve"}
+    assert sum(req["segment_share"].values()) == pytest.approx(1.0,
+                                                               abs=0.01)
+    # per-request rows telescope: coverage ~1 for each
+    for row in report["slowest"]:
+        assert row["coverage"] >= 0.95, row
+
+
+def test_path_cli_check_and_budget(tmp_path, capsys):
+    d = str(tmp_path / "t")
+    tracer.configure(d)
+    with MicroBatcher(Echo(), BatcherConfig(
+            buckets=(1, 8), window_ms=1.0)) as b:
+        b.submit(frame(1)).result(timeout=10)
+        time.sleep(0.05)
+    tracer.shutdown()
+    assert path_main([d]) == 0
+    assert path_main([d, "--check"]) == 0
+    out = capsys.readouterr().out
+    assert "request path" in out
+    # JSON spelling parses and carries the gate quantities
+    assert path_main([d, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["report"]["requests"]["count"] == 1
+    assert doc["report"]["requests"]["queue_share"] is not None
+    # an impossible budget trips the 4 exit; a generous one passes
+    assert path_main([d, "--check", "--budget", "0.0001"]) == 4
+    assert path_main([d, "--check", "--budget", "100"]) == 0
+    # a dir without request spans is invalid under --check
+    empty = str(tmp_path / "empty")
+    tracer.configure(empty)
+    with tracer.span("not-serving"):
+        pass
+    tracer.shutdown()
+    assert path_main([empty, "--check"]) == 2
+    assert path_main([empty]) == 0  # render-only stays usable
+    # dispatched through the umbrella CLI
+    assert trace_cli(["path", d, "--check"]) == 0
+
+
+def test_path_epoch_attribution(tmp_path):
+    """Epoch spans (host_ms/device_ms attrs + the follows_from chain)
+    render in the path view for training traces."""
+    from flink_ml_tpu.iteration.iteration import (
+        IterationConfig,
+        iterate_bounded,
+    )
+
+    d = str(tmp_path)
+    tracer.configure(d)
+    iterate_bounded(np.zeros(2), lambda c, e: c + 1.0, max_iter=3,
+                    config=IterationConfig(mode="host"))
+    tracer.shutdown()
+    spans = read_spans(d)
+    epochs = [sp for sp in spans if sp["name"] == "epoch"]
+    assert len(epochs) == 3
+    # the chain: epoch N>0 follows from epoch N-1
+    linked = [sp for sp in epochs if sp.get("links")]
+    assert len(linked) == 2
+    report = analyze_paths(spans)
+    assert len(report["epochs"]) == 3
+    assert all("host_ms" in row for row in report["epochs"])
+
+
+# -- fork boundary ------------------------------------------------------------
+
+def test_hostpool_children_stitch_into_one_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv("FLINK_ML_TPU_HOST_PARALLELISM", "2")
+    d = str(tmp_path)
+    tracer.configure(d)
+    with tracer.span("driver") as root:
+        root_trace = root.trace_id
+        map_row_shards(lambda lo, hi: hi - lo, 1 << 18, workers=2,
+                       min_rows=1)
+    tracer.shutdown()
+    spans = read_spans(d)
+    children = [sp for sp in spans if sp["name"] == "hostpool.child"]
+    assert children, [sp["name"] for sp in spans]
+    assert {sp["trace"] for sp in children} == {root_trace}
+    dispatch = next(sp for sp in spans if sp["name"] == "hostpool.map")
+    assert {sp["parent"] for sp in children} == {dispatch["id"]}
+
+
+# -- process boundary: the 2-process launcher stitch --------------------------
+
+_LAUNCH_SCRIPT = """\
+import os, sys
+sys.path.insert(0, {root!r})
+from flink_ml_tpu.observability import tracing
+with tracing.tracer.span("proc-root"):
+    with tracing.tracer.span("proc-work"):
+        pass
+tracing.tracer.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_launcher_two_process_trace_stitches(tmp_path):
+    """The acceptance stitch: a 2-process launcher run whose merged
+    spans-p<k>-*.jsonl artifacts yield a SINGLE trace id (no jax —
+    the launcher's env mapping and the tracer do all the work)."""
+    from flink_ml_tpu.parallel.distributed import launch
+
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    script = tmp_path / "traced_child.py"
+    script.write_text(_LAUNCH_SCRIPT.format(root=repo_root))
+    trace_dir = str(tmp_path / "trace")
+    results = launch([sys.executable, str(script)], num_processes=2,
+                     env={tracing.TRACE_DIR_ENV: trace_dir},
+                     timeout=120.0)
+    assert [r["returncode"] for r in results] == [0, 0], results
+    files = sorted(os.listdir(trace_dir))
+    # per-process artifact names carry the process index
+    assert any(f.startswith("spans-p0-") for f in files), files
+    assert any(f.startswith("spans-p1-") for f in files), files
+    spans = read_spans(trace_dir)
+    assert {sp["name"] for sp in spans} == {"proc-root", "proc-work"}
+    assert len({sp["trace"] for sp in spans}) == 1, spans
+    assert {sp.get("process") for sp in spans} == {0, 1}
+
+
+def test_launch_env_respects_existing_trace_parent(tmp_path,
+                                                   monkeypatch):
+    """An explicitly provided trace parent wins over the launcher's
+    fresh context (a nested launch keeps the OUTER trace)."""
+    from flink_ml_tpu.parallel.distributed import launch
+
+    monkeypatch.setenv(TRACE_PARENT_ENV, "outer-1:outer-2")
+    results = launch(
+        [sys.executable, "-c",
+         "import os; print(os.environ['FLINK_ML_TPU_TRACE_PARENT'])"],
+        num_processes=1, timeout=60.0)
+    assert results[0]["returncode"] == 0, results
+    assert results[0]["stdout"].strip() == "outer-1:outer-2"
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def _tight_slo():
+    return SLO(name="impossible-latency", kind="latency",
+               threshold_ms=0.000001, window_s=60.0)
+
+
+def _serve_some(trace_dir, n=4):
+    tracer.configure(trace_dir)
+    with MicroBatcher(Echo(), BatcherConfig(
+            buckets=(1, 8), window_ms=1.0)) as b:
+        for _ in range(n):
+            b.submit(frame(1)).result(timeout=10)
+        time.sleep(0.05)
+
+
+def test_slo_violation_dumps_incident_bundle(tmp_path):
+    d = str(tmp_path)
+    _serve_some(d)
+    verdicts = evaluate_slos([_tight_slo()], emit=True)
+    assert not verdicts[0]["ok"]
+    tracer.shutdown()
+    rows = flightrecorder.read_incidents(d)
+    assert len(rows) == 1
+    inc = rows[0]
+    assert inc["kind"] == "slo"
+    assert inc["attrs"]["slo"] == "impossible-latency"
+    assert not inc["acknowledged"]
+    # the preceding spans are inside the bundle — the serving activity
+    # that violated the SLO is the evidence
+    names = {sp["name"] for sp in inc["recent_spans"]}
+    assert "serving.batch" in names, names
+    bundle = inc["dir"]
+    assert os.path.isfile(os.path.join(bundle, "metrics.json"))
+    # slo.json freezes the ACTIVE specs' verdicts at trigger time
+    with open(os.path.join(bundle, "slo.json")) as f:
+        frozen = json.load(f)
+    assert isinstance(frozen, list) and frozen
+    assert all({"slo", "ok"} <= set(v) for v in frozen)
+    with open(os.path.join(bundle, "metrics.json")) as f:
+        snap = json.load(f)
+    assert f"{ML_GROUP}.serving" in snap
+    # the ml.incident event landed in the trace
+    events = [ev for sp in read_spans(d) for ev in sp.get("events", ())]
+    assert any(ev["name"] == flightrecorder.INCIDENT_EVENT
+               for ev in events)
+
+
+def test_divergence_trips_the_recorder(tmp_path):
+    from flink_ml_tpu.observability import health
+
+    d = str(tmp_path)
+    tracer.configure(d)
+    with tracer.span("fit"):
+        health.report_divergence("TestAlgo", "non-finite", epoch=3)
+    tracer.shutdown()
+    rows = flightrecorder.read_incidents(d)
+    assert len(rows) == 1
+    assert rows[0]["kind"] == "divergence"
+    assert rows[0]["attrs"]["algo"] == "TestAlgo"
+
+
+def test_recorder_debounce_and_cap(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    tracer.configure(d)
+    with tracer.span("work"):
+        pass
+    monkeypatch.setenv(flightrecorder.DEBOUNCE_ENV, "3600")
+    assert flightrecorder.record_incident("slo", slo="a") is not None
+    # debounced: the burst after the first bundle is suppressed
+    assert flightrecorder.record_incident("slo", slo="b") is None
+    sup = metrics.group(ML_GROUP, "incident").get_counter(
+        "suppressed", labels={"reason": "debounced"})
+    assert sup >= 1
+    # cap: with debounce off, the per-process max stops the flood
+    monkeypatch.setenv(flightrecorder.DEBOUNCE_ENV, "0")
+    monkeypatch.setenv(flightrecorder.MAX_ENV, "2")
+    assert flightrecorder.record_incident("drift", servable="s") \
+        is not None
+    assert flightrecorder.record_incident("drift", servable="s") is None
+    assert len(flightrecorder.read_incidents(d)) == 2
+    tracer.shutdown()
+
+
+def test_recorder_extends_existing_bundle_series(tmp_path,
+                                                 monkeypatch):
+    """A restarting process reusing the same trace dir must extend the
+    incident-<seq> series, not collide with the previous run's
+    incident-000 and lose its evidence."""
+    monkeypatch.setenv(flightrecorder.DEBOUNCE_ENV, "0")
+    d = str(tmp_path)
+    tracer.configure(d)
+    with tracer.span("run-1"):
+        pass
+    assert flightrecorder.record_incident("slo", slo="a") is not None
+    flightrecorder.reset()  # a fresh process's per-run state
+    with tracer.span("run-2"):
+        pass
+    bundle = flightrecorder.record_incident("slo", slo="b")
+    assert bundle is not None and bundle.endswith("incident-001")
+    rows = flightrecorder.read_incidents(d, include_spans=False)
+    assert [r["seq"] for r in rows] == [0, 1]
+    assert [r["attrs"]["slo"] for r in rows] == ["a", "b"]
+    tracer.shutdown()
+
+
+def test_recorder_noop_without_trace_dir():
+    assert tracer.trace_dir is None
+    assert flightrecorder.record_incident("slo", slo="x") is None
+    assert metrics.group(ML_GROUP, "incident").get_counter(
+        "suppressed", labels={"reason": "no-trace-dir"}) >= 1
+
+
+def test_recorder_disabled_by_env(tmp_path, monkeypatch):
+    tracer.configure(str(tmp_path))
+    monkeypatch.setenv(flightrecorder.RECORDER_ENV, "0")
+    assert flightrecorder.record_incident("slo", slo="x") is None
+    assert flightrecorder.read_incidents(str(tmp_path)) == []
+
+
+def test_rollback_records_an_incident(tmp_path):
+    from flink_ml_tpu.serving import ModelRegistry, publish_model
+
+    d = str(tmp_path / "trace")
+    tracer.configure(d)
+    watch = str(tmp_path / "models")
+
+    class Const(TransformerServable):
+        def __init__(self, v):
+            super().__init__()
+            self.v = v
+
+        def transform(self, df):
+            return df
+
+    for v in (1, 2):
+        publish_model(watch, [np.full(3, float(v))], v)
+    reg = ModelRegistry(watch, lambda leaves, version:
+                        Const(float(np.asarray(leaves[0]).ravel()[0])),
+                        model="fr")
+    reg._adopt(1)
+    reg._adopt(2)
+    restored = reg.rollback(reason="regression")
+    assert restored == 1
+    tracer.shutdown()
+    rows = flightrecorder.read_incidents(d)
+    assert len(rows) == 1
+    assert rows[0]["kind"] == "rollback"
+    assert rows[0]["attrs"]["demoted"] == 2
+
+
+def test_incident_cli_check_ack_cycle(tmp_path, capsys):
+    d = str(tmp_path)
+    _serve_some(d)
+    evaluate_slos([_tight_slo()], emit=True)
+    tracer.shutdown()
+    # unacknowledged -> 4; render names the trigger
+    assert incident_main([d]) == 0
+    out = capsys.readouterr().out
+    assert "kind=slo" in out and "UNACKNOWLEDGED" in out
+    assert incident_main([d, "--check"]) == 4
+    # umbrella CLI spelling
+    assert trace_cli(["incident", d, "--check"]) == 4
+    capsys.readouterr()  # drain the render output of the check calls
+    # JSON parses strictly
+    assert incident_main([d, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["incidents"][0]["kind"] == "slo"
+    assert doc["incidents"][0]["recent_spans"] > 0
+    # acknowledge -> clean
+    assert incident_main([d, "--ack", "--check"]) == 0
+    assert incident_main([d, "--check"]) == 0
+
+
+def test_incident_cli_clean_and_invalid(tmp_path, capsys):
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    assert incident_main([str(clean), "--check"]) == 0
+    assert "no incident bundles" in capsys.readouterr().out
+    assert incident_main([str(tmp_path / "missing"), "--check"]) == 2
+
+
+def test_latest_never_resolves_an_incident_bundle(tmp_path):
+    """incident-<seq>/ bundles hold spans-recent.jsonl copies and are
+    always the newest thing in a trace dir — --latest must keep
+    resolving the OWNING trace dir, never the evidence inside it."""
+    from flink_ml_tpu.observability.exporters import latest_trace_dir
+
+    d = str(tmp_path)
+    tracer.configure(d)
+    with tracer.span("work"):
+        pass
+    assert flightrecorder.record_incident("slo", slo="x") is not None
+    tracer.shutdown()
+    assert latest_trace_dir(d) == d
+    parent = str(tmp_path.parent)
+    resolved = latest_trace_dir(parent)
+    assert resolved is not None
+    assert "incident-" not in os.path.basename(resolved)
+
+
+def test_incidents_live_route(tmp_path, monkeypatch):
+    import urllib.request
+
+    d = str(tmp_path)
+    tracer.configure(d)
+    with tracer.span("w"):
+        pass
+    flightrecorder.record_incident("slo", slo="latency")
+    monkeypatch.setenv(server.METRICS_PORT_ENV, "0")
+    srv = server.maybe_start()
+    assert srv is not None
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/incidents", timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["trace_dir"] == d
+    assert len(doc["incidents"]) == 1
+    assert doc["incidents"][0]["kind"] == "slo"
+    tracer.shutdown()
+
+
+# -- controller cycle stitching -----------------------------------------------
+
+def test_controller_cycle_shares_one_trace(tmp_path, monkeypatch):
+    """Every step span of one retrain→publish→canary→…→watching cycle
+    shares the trigger step's trace id, chained follows_from — and the
+    triggering ml.slo event is inside the first span of that trace."""
+    from flink_ml_tpu.resilience.policy import RetryPolicy
+    from flink_ml_tpu.serving import (
+        ControllerConfig,
+        ModelRegistry,
+        OpsController,
+        publish_model,
+    )
+
+    d = str(tmp_path / "trace")
+    tracer.configure(d)
+    watch = str(tmp_path / "models")
+
+    class Const(TransformerServable):
+        def __init__(self, v):
+            super().__init__()
+            self.v = v
+
+        def transform(self, df):
+            return df
+
+    publish_model(watch, [np.full(3, 1.0)], 1)
+    reg = ModelRegistry(watch, lambda leaves, version:
+                        Const(float(np.asarray(leaves[0]).ravel()[0])),
+                        model="cyc")
+    reg._adopt(1)
+    cfg = ControllerConfig(
+        ramp_stages=(), stage_min_requests=1, bake_min_requests=1,
+        stage_timeout_s=0.0, cooldown_s=0.0,
+        policy=RetryPolicy(max_restarts=1, backoff_s=0.0),
+        slos=[_tight_slo()])
+    # the tight SLO needs serving traffic to violate on
+    sv = reg.active
+    sv.transform(frame(2))
+    ctl = OpsController(
+        reg, lambda trigger: [np.full(3, 2.0)], config=cfg)
+    try:
+        states = []
+        for _ in range(16):
+            states.append(ctl.step())
+            if (states[-1] == "watching"
+                    and ctl._outcomes.get("swapped")):
+                break
+        assert ctl._outcomes.get("swapped") == 1, (states,
+                                                   ctl._outcomes)
+    finally:
+        ctl.stop()
+    tracer.shutdown()
+    spans = read_spans(d)
+    steps = [sp for sp in spans if sp["name"] == "controller.step"]
+    cycle_steps = [sp for sp in steps
+                   if sp["attrs"].get("state") != "watching"
+                   or any(ev["name"] == "ml.controller"
+                          and ev["attrs"].get("kind") == "trigger"
+                          for ev in sp.get("events", ()))]
+    assert len(cycle_steps) >= 3
+    trigger_step = next(
+        sp for sp in steps
+        if any(ev["attrs"].get("kind") == "trigger"
+               for ev in sp.get("events", ())
+               if ev["name"] == "ml.controller"))
+    # ONE trace across the cycle, rooted at the trigger step — which
+    # also carries the triggering ml.slo event
+    assert {sp["trace"] for sp in cycle_steps} == {
+        trigger_step["trace"]}
+    assert any(ev["name"] == "ml.slo"
+               for ev in trigger_step.get("events", ()))
+    # chained follows_from: every non-trigger cycle step links back
+    chained = [sp for sp in cycle_steps if sp is not trigger_step]
+    assert all(sp.get("links") for sp in chained), [
+        sp["attrs"] for sp in chained if not sp.get("links")]
