@@ -29,6 +29,11 @@ def main(argv=None) -> int:
     parser.add_argument("--show-suppressed", action="store_true",
                         help="include suppressed findings in text output")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--suppressions", action="store_true",
+                        help="audit mode: list every suppression with "
+                             "its justification, flag stale ones "
+                             "(GitHub ::warning annotations), exit 0 "
+                             "always — a report, not a gate")
     args = parser.parse_args(argv)
 
     from flink_ml_tpu.analysis import Report, all_rules, analyze_paths
@@ -39,6 +44,9 @@ def main(argv=None) -> int:
         return 0
     if not args.paths:
         parser.error("no paths given (or use --list-rules)")
+
+    if args.suppressions:
+        return _suppressions_report(args)
 
     rules = None
     if args.rules:
@@ -55,6 +63,50 @@ def main(argv=None) -> int:
         with open(args.output, "w") as f:
             f.write(rendered + "\n")
     return report.exit_code
+
+
+def _suppressions_report(args) -> int:
+    """The ``--suppressions`` audit: every justified silence in one
+    place, stale ones flagged. Always exits 0 — CI runs this as an
+    annotation step, not a gate (the gate is the plain lint run, where
+    ``unused-suppression`` is a blocking finding)."""
+    import json
+
+    from flink_ml_tpu.analysis.core import collect_suppressions
+
+    pairs = collect_suppressions(args.paths)
+    stale = [(p, s) for p, s in pairs if not s.used]
+    if args.format == "json":
+        rendered = json.dumps({
+            "suppressions": [
+                {"path": p, "line": s.line, "rules": list(s.rules),
+                 "justification": s.justification, "used": s.used}
+                for p, s in pairs],
+            "counts": {"total": len(pairs), "stale": len(stale)},
+        }, indent=2)
+    else:
+        lines = []
+        for p, s in pairs:
+            mark = "     " if s.used else "STALE"
+            lines.append(f"{mark} {p}:{s.line}: "
+                         f"disable={','.join(s.rules)} -- "
+                         f"{s.justification or '(no justification)'}")
+        lines.append(f"jaxlint: {len(pairs)} suppression(s), "
+                     f"{len(stale)} stale")
+        rendered = "\n".join(lines)
+    print(rendered)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(rendered + "\n")
+    # GitHub annotations for stale entries: visible on the PR without
+    # failing the job (the blocking copy is unused-suppression)
+    import os
+    if os.environ.get("GITHUB_ACTIONS") == "true":
+        for p, s in stale:
+            print(f"::warning file={p},line={s.line}::stale jaxlint "
+                  f"suppression for {','.join(s.rules)} — no finding "
+                  f"matches this line; delete it")
+    return 0
 
 
 if __name__ == "__main__":

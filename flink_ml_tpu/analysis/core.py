@@ -264,6 +264,31 @@ def analyze_paths(paths: Iterable[str],
     return findings
 
 
+def collect_suppressions(
+        paths: Iterable[str]) -> List[Tuple[str, Suppression]]:
+    """Every suppression in ``paths`` with its ``used`` flag settled by
+    a full analysis pass — the ``--suppressions`` audit (analysis/cli.py):
+    the justification inventory reviewers read, plus staleness (a
+    suppression no finding matched is dead weight that could mask a
+    future hazard). Unparseable files simply contribute none."""
+    registry = all_rules()
+    out: List[Tuple[str, Suppression]] = []
+    for path in iter_python_files(paths):
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            ctx = FileContext(path, source)
+        except SyntaxError:
+            continue
+        findings: List[Finding] = []
+        for rule in registry.values():
+            findings.extend(rule.check(ctx))
+        _apply_suppressions(ctx, findings, report_unused=False)
+        for sup in ctx.suppressions:
+            out.append((path, sup))
+    return out
+
+
 @dataclass
 class Report:
     findings: List[Finding] = field(default_factory=list)

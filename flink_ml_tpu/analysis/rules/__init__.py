@@ -3,6 +3,7 @@
 
 from flink_ml_tpu.analysis.rules import (  # noqa: F401
     aliasing,
+    concurrency,
     hostsync,
     metrics_in_jit,
     native_contract,

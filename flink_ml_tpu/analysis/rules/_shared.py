@@ -12,8 +12,11 @@ from flink_ml_tpu.analysis.core import FileContext, call_name, dotted_name
 #: jax.jit / jit / jax.experimental.shard_map.shard_map all count).
 #: map_shards is the repo's own SPMD seam (parallel/mapreduce.py): a body
 #: wrapped by it is traced exactly like a shard_map body, so the traced-
-#: code rules (JL101/JL107/...) must see through it too.
-JIT_NAMES = {"jit", "pjit", "pmap", "vmap", "shard_map", "map_shards"}
+#: code rules (JL101/JL107/...) must see through it too — and so is
+#: map_rows, the row-sharded serving wrapper layered on top of it (same
+#: signature shape: the traced body is positional arg 0)
+JIT_NAMES = {"jit", "pjit", "pmap", "vmap", "shard_map", "map_shards",
+             "map_rows"}
 
 #: composition methods whose FUNCTION-VALUED positional args are all
 #: traced (MapReduceProgram.build(map_fn, update_fn, ...) — both bodies

@@ -58,6 +58,11 @@ def _profiled(method, kind: str):
         if not trace_dir and not tracer.active:
             return method(self, *args, **kwargs)
         region = f"{type(self).__name__}.{kind}"
+        # a telemetry-armed run is exactly the run whose daemon threads
+        # (metrics server, watchers) must not die silently
+        from flink_ml_tpu.common.locks import install_thread_excepthook
+
+        install_thread_excepthook()
         try:
             with contextlib.ExitStack() as stack:
                 sp = None

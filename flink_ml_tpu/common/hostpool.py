@@ -100,9 +100,14 @@ def _child_main(fn, lo, hi, wfd, chaos_action=None, parent_ctx=None):
     # back holds only child-produced metrics. reseed_child (NOT clear):
     # inherited locks may be held by a driver thread that doesn't exist
     # in the child, so they must be replaced, never acquired
+    from flink_ml_tpu.common import locks
     from flink_ml_tpu.common.metrics import metrics
     from flink_ml_tpu.observability import tracing
 
+    # the lock watchdog first: its internal mutex may itself have been
+    # forked held, and the reseeded tracer/metrics below acquire
+    # watchdog-instrumented locks when lockcheck is armed
+    locks.reseed_child()
     tracing.tracer.reseed_child(parent_ctx)
     metrics.reseed_child()
     # the live telemetry endpoint is driver-only: if the parent armed

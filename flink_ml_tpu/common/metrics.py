@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from flink_ml_tpu.common import locks
+
 ML_GROUP = "ml"
 MODEL_GROUP = "model"
 TIMESTAMP_GAUGE = "timestamp"
@@ -637,7 +639,7 @@ class MetricsRegistry:
         worker. Post-fork the child is single-threaded, so plain
         reassignment is safe."""
         self._lock = threading.Lock()
-        self._groups = {}
+        self._groups = {}  # jaxlint: disable=unguarded-shared-state -- single-threaded post-fork; the stale guard was just replaced above
 
 
 #: default process-wide registry
@@ -649,7 +651,11 @@ metrics = MetricsRegistry()
 PROFILE_DIR_ENV = "FLINK_ML_TPU_PROFILE_DIR"
 
 _trace_active = False  # jax.profiler allows one trace at a time
-_trace_lock = threading.Lock()  # guards the start/stop decision
+# the seam lock (common/locks.py): coarse, name-visible to the
+# watchdog; the per-Histogram/group micro-locks above stay bare —
+# the watchdog mirrors INTO them, so instrumenting them would
+# measure the measurer
+_trace_lock = locks.make_lock("common.metrics.profile")
 
 
 @contextlib.contextmanager

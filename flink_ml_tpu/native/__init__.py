@@ -20,6 +20,7 @@ from typing import Optional
 
 import numpy as np
 
+from flink_ml_tpu.common.locks import make_lock
 from flink_ml_tpu.resilience import faults
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -27,7 +28,7 @@ _SOURCES = sorted(
     os.path.join(_DIR, f) for f in os.listdir(_DIR) if f.endswith(".cpp"))
 _LIB = os.path.join(_DIR, "_native_kernels.so")
 
-_lock = threading.Lock()
+_lock = make_lock("native.load")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 

@@ -19,6 +19,7 @@ rerunning anything:
     flink-ml-tpu-trace controller TRACE_DIR --check  # ops loop (exit 4)
     flink-ml-tpu-trace path TRACE_DIR --check --budget 50  # critical path
     flink-ml-tpu-trace incident TRACE_DIR --check  # flight recorder (exit 4)
+    flink-ml-tpu-trace locks TRACE_DIR --check   # lock watchdog (exit 4)
     flink-ml-tpu-trace ROOT --latest             # newest trace dir under ROOT
 
 Sections: top spans by self-time (time in a span minus its children —
@@ -64,7 +65,13 @@ queue-wait share exceeds the budget. The ``incident`` subcommand
 (observability/flightrecorder.py) renders the flight recorder's
 ``incident-<seq>/`` bundles — the triggering event plus the span ring
 that preceded it — and with ``--check`` exits 4 while any
-unacknowledged incident exists (``--ack`` marks them reviewed). Every
+unacknowledged incident exists (``--ack`` marks them reviewed). The
+``locks`` subcommand (observability/lockstats.py) merges the lock
+watchdog's ``locks-*.json`` dumps (``FLINK_ML_TPU_LOCKCHECK``-armed
+runs, common/locks.py) — per-lock hold stats, the acquisition-order
+graph, detected cycles (including cycles visible only across processes)
+— and with ``--check`` exits 4 on any cycle or long hold, 2 when the
+dir holds no lock telemetry at all. Every
 subcommand accepts ``--latest``:
 treat the positional dir as a root and resolve the newest trace dir
 under it (exporters.resolve_trace_dir) — no more hand-globbing.
@@ -273,6 +280,14 @@ def main(argv=None) -> int:
         )
 
         return incident_main(argv[1:])
+    if argv and argv[0] == "locks":
+        # lock-watchdog view (observability/lockstats.py); same
+        # dispatch rule — use ./locks to summarize such a directory
+        from flink_ml_tpu.observability.lockstats import (
+            main as locks_main,
+        )
+
+        return locks_main(argv[1:])
     if argv and argv[0] == "summary":
         # explicit subcommand spelling for the default view, so
         # unattended consumers can write `summary --json` without

@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Dict, Optional, Set
 
+from flink_ml_tpu.common.locks import make_lock
 from flink_ml_tpu.common.metrics import ML_GROUP, MetricsRegistry, metrics
 from flink_ml_tpu.observability import tracing
 
@@ -103,7 +104,7 @@ class CompileStats:
 
     def __init__(self, registry: MetricsRegistry = metrics):
         self._registry = registry
-        self._lock = threading.Lock()
+        self._lock = make_lock("observability.compilestats")
         self._installed = False
         self._enabled = False
         self._sigs: Dict[str, Set] = {}
@@ -140,7 +141,7 @@ class CompileStats:
             self._enabled = False
 
     def _on_duration(self, event: str, duration_secs: float, **kw) -> None:
-        if not self._enabled:
+        if not self._enabled:  # jaxlint: disable=unguarded-shared-state -- lock-free bool fast path on the per-compile listener; a stale read delays disarm by one event
             return
         try:
             phase = _channel_tail(event)
@@ -155,7 +156,7 @@ class CompileStats:
             pass
 
     def _on_event(self, event: str, **kw) -> None:
-        if not self._enabled:
+        if not self._enabled:  # jaxlint: disable=unguarded-shared-state -- lock-free bool fast path on the per-compile listener; a stale read delays disarm by one event
             return
         try:
             channel = event.removeprefix("/jax/")
@@ -303,7 +304,7 @@ def instrumented_jit(fn=None, *, name: Optional[str] = None,
     label = name or getattr(fn, "__name__", None) or "jit"
     jitted = jax.jit(fn, **jit_kwargs)
     cache: Dict = {}
-    cache_lock = threading.Lock()
+    cache_lock = make_lock("observability.compilestats.aot")
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):

@@ -59,6 +59,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from flink_ml_tpu.common.locks import make_lock
 from flink_ml_tpu.common.metrics import ML_GROUP, metrics
 from flink_ml_tpu.observability import tracing
 
@@ -801,7 +802,7 @@ class _LiveWindow:
         return merged.to_json()
 
 
-_lock = threading.Lock()
+_lock = make_lock("observability.drift")
 _baselines: Dict[str, DriftBaseline] = {}
 _missing: set = set()       # servables that swapped in without a baseline
 _windows: Dict[str, _LiveWindow] = {}
@@ -1068,7 +1069,7 @@ def reseed_child() -> None:
     child's live sketches seed from the same bin edges as the driver's,
     so the fold back is bin-exact."""
     global _lock, _windows, _last_eval, _last_results
-    _lock = threading.Lock()
+    _lock = make_lock("observability.drift")
     _windows = {}
     _last_eval = {}
     _last_results = {}

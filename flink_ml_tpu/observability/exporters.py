@@ -232,6 +232,12 @@ def dump_metrics(trace_dir: str,
         from flink_ml_tpu.observability import tracing
 
         tracing.tracer.mirror_dropped()
+        # same dump-point pattern for the lock watchdog (common/
+        # locks.py): hold-time histograms and cycle/long-hold counters
+        # fold into ml.lock BEFORE the snapshot is written
+        from flink_ml_tpu.common import locks
+
+        locks.mirror_metrics()
     path = os.path.join(trace_dir, f"metrics-{artifact_suffix()}.json")
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
@@ -243,6 +249,14 @@ def dump_metrics(trace_dir: str,
             drift_mod.dump_state(trace_dir)
         except OSError:
             pass  # the metrics snapshot is the primary artifact
+    # lock-watchdog acquisition graph rides alongside as
+    # locks-<suffix>.json (a no-op for processes that never armed it)
+    try:
+        from flink_ml_tpu.common import locks as locks_mod
+
+        locks_mod.dump_state(trace_dir)
+    except OSError:
+        pass
     return path
 
 

@@ -67,6 +67,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from flink_ml_tpu.common.locks import make_lock
 from flink_ml_tpu.common.metrics import ML_GROUP, metrics
 from flink_ml_tpu.observability import tracing
 
@@ -99,7 +100,7 @@ EXIT_INVALID = 2
 #: same violation class as slo/drift/controller's 4
 EXIT_UNACKED = 4
 
-_lock = threading.Lock()
+_lock = make_lock("observability.flightrecorder")
 _seq = 0
 _last_ts: Optional[float] = None
 # re-entrancy latch: building a bundle evaluates SLOs/drift, which can

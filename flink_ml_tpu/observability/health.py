@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from flink_ml_tpu.common.locks import make_lock
 from flink_ml_tpu.common.metrics import ML_GROUP, metrics
 from flink_ml_tpu.observability import tracing
 from flink_ml_tpu.resilience.policy import NonFiniteState
@@ -496,7 +497,7 @@ def trace_sampled() -> bool:
 
 
 _inflight: Dict[str, int] = {}
-_inflight_lock = threading.Lock()
+_inflight_lock = make_lock("observability.health.inflight")
 
 
 def serving_inflight(servable: str, delta: int) -> int:

@@ -73,6 +73,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from flink_ml_tpu.common.locks import make_lock
 from flink_ml_tpu.common.metrics import metrics
 from flink_ml_tpu.observability import tracing
 
@@ -122,7 +123,7 @@ _JSON_CTYPE = "application/json"
 
 _log = logging.getLogger(__name__)
 
-_lock = threading.Lock()
+_lock = make_lock("observability.server")
 _FAILED = object()   # latched off: bad port / bind failure / forked child
 _server = None       # None | TelemetryServer | _FAILED
 _owner_pid = os.getpid()
@@ -136,7 +137,7 @@ _t0 = time.monotonic()
 # gates registered (every plain fit/serve process) /healthz is 200, as
 # before.
 _gates: dict = {}
-_gates_lock = threading.Lock()
+_gates_lock = make_lock("observability.server.gates")
 
 # ``/serving`` status provider: the serving runtime (serving/batcher.py)
 # registers a zero-arg callable returning its live status dict (queue

@@ -15,6 +15,7 @@ from typing import Tuple
 
 import numpy as np
 
+from flink_ml_tpu.common.locks import make_lock
 from flink_ml_tpu.linalg.vectors import DenseVector, Vector
 from flink_ml_tpu.params.shared import (
     HasFeaturesCol,
@@ -49,7 +50,7 @@ class LogisticRegressionModelData:
 
 
 _PREDICT_JIT = None
-_PREDICT_LOCK = threading.Lock()
+_PREDICT_LOCK = make_lock("servable.lr.predict")
 
 #: one row-sharded predict twin per mesh (keyed by device ids + axes):
 #: the executable is shared across model versions — a hot-swap only

@@ -65,6 +65,10 @@ import time
 from concurrent.futures import Future
 from typing import List, Optional, Tuple
 
+from flink_ml_tpu.common.locks import (
+    install_thread_excepthook,
+    make_condition,
+)
 from flink_ml_tpu.common.metrics import ML_GROUP, RATIO_BUCKETS, metrics
 from flink_ml_tpu.observability import tracing
 from flink_ml_tpu.observability.health import (
@@ -315,7 +319,7 @@ class MicroBatcher:
         # device stage: admission counts them, or the pipeline would
         # quietly extend max_queue_rows by a tick per handoff slot
         self._inflight_rows = 0
-        self._cond = threading.Condition()
+        self._cond = make_condition("serving.batcher")
         self._stopping = False
         self._thread: Optional[threading.Thread] = None
         self._device_thread: Optional[threading.Thread] = None
@@ -337,7 +341,13 @@ class MicroBatcher:
     def start(self) -> "MicroBatcher":
         if self._thread is not None:
             return self
-        self._stopping = False
+        # a crashing tick/device daemon must surface in telemetry
+        install_thread_excepthook()
+        # under the cond: a submitter thread racing a restart must see
+        # either the old True (and get rejected) or the new False —
+        # never a torn interleaving with its own queue append
+        with self._cond:
+            self._stopping = False
         if self.config.pipeline_depth > 0:
             self._handoff = queue.Queue(
                 maxsize=self.config.pipeline_depth)

@@ -71,6 +71,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from flink_ml_tpu.common.locks import make_lock
 from flink_ml_tpu.common.metrics import ML_GROUP, metrics
 from flink_ml_tpu.observability import tracing
 from flink_ml_tpu.resilience import faults
@@ -263,7 +264,7 @@ class OpsController:
         self._cycle_ctx = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.controller")
         self._group = metrics.group(ML_GROUP, "controller")
         # the /controller route reflects this controller from
         # construction — step-driven controllers (tests, the smoke)

@@ -36,6 +36,7 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, List, Optional
 
+from flink_ml_tpu.common.locks import make_lock
 from flink_ml_tpu.servable.api import RejectedRequest
 
 __all__ = ["LoadGenConfig", "percentiles", "run_loadgen"]
@@ -89,7 +90,7 @@ def percentiles(samples_ms: List[float]) -> dict:
 
 class _Collector:
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = make_lock("serving.loadgen.stats")
         self.ok_ms: List[float] = []
         self.rejected: dict = {}
         self.errors: dict = {}
@@ -130,7 +131,7 @@ def run_loadgen(submit: Callable, frame_factory: Callable[[int], object],
     cfg = cfg or LoadGenConfig()
     collector = _Collector()
     completed = [0]
-    done_lock = threading.Lock()
+    done_lock = make_lock("serving.loadgen.done")
     tick_errors: List[BaseException] = []
 
     def finish(i: int, t0: float, fut: Future, frame) -> None:
@@ -156,7 +157,7 @@ def run_loadgen(submit: Callable, frame_factory: Callable[[int], object],
     t_start = time.perf_counter()
     if cfg.mode == "closed":
         counter = [0]
-        counter_lock = threading.Lock()
+        counter_lock = make_lock("serving.loadgen.counter")
 
         def worker() -> None:
             while True:

@@ -69,6 +69,10 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from flink_ml_tpu.common.locks import (
+    install_thread_excepthook,
+    make_lock,
+)
 from flink_ml_tpu.common.metrics import ML_GROUP, metrics
 from flink_ml_tpu.iteration.checkpoint import (
     CheckpointManager,
@@ -149,7 +153,7 @@ class ModelRegistry:
         #: single-device path the warmup never warmed
         self._mesh = mesh
         self.poll_interval_s = float(poll_interval_s)
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.registry")
         self._active = None
         self._version: Optional[int] = None
         self._rejected: set = set()
@@ -175,20 +179,20 @@ class ModelRegistry:
     def active(self):
         """The committed serving servable (None before the first
         successful swap). One atomic read — safe from any thread."""
-        return self._active
+        return self._active  # jaxlint: disable=unguarded-shared-state -- one atomic reference read; swaps replace the object under the lock
 
     @property
     def version(self) -> Optional[int]:
-        return self._version
+        return self._version  # jaxlint: disable=unguarded-shared-state -- one atomic int read; the serving path tolerates a stale version
 
     @property
     def canary_version(self) -> Optional[int]:
-        canary = self._canary
+        canary = self._canary  # jaxlint: disable=unguarded-shared-state -- one atomic tuple read, unpacked from the local snapshot
         return canary[1] if canary is not None else None
 
     @property
     def canary_fraction(self) -> float:
-        return self._canary_fraction if self._canary is not None else 0.0
+        return self._canary_fraction if self._canary is not None else 0.0  # jaxlint: disable=unguarded-shared-state -- per-tick routing reads a snapshot; a stale fraction skews one tick
 
     def resolve(self):
         """The servable for ONE dispatch tick: the canary for
@@ -197,14 +201,14 @@ class ModelRegistry:
         ``active`` — a staged rollout needs per-tick routing, and the
         batcher already resolves once per tick so in-flight batches
         complete on the version they were dispatched with."""
-        canary = self._canary
+        canary = self._canary  # jaxlint: disable=unguarded-shared-state -- resolve snapshots the canary tuple once; ticks tolerate staleness
         if canary is not None:
-            fraction = self._canary_fraction
+            fraction = self._canary_fraction  # jaxlint: disable=unguarded-shared-state -- a stale fraction mis-routes at most the current tick
             if fraction >= 1.0 or (fraction > 0.0
                                    and self._canary_rng.random()
                                    < fraction):
                 return canary[0]
-        return self._active
+        return self._active  # jaxlint: disable=unguarded-shared-state -- fallback is the same atomic read the active property makes
 
     # -- candidate discovery -------------------------------------------------
     def _published_versions(self) -> List[int]:
@@ -223,7 +227,8 @@ class ModelRegistry:
         counter + event — the one rejection bookkeeping path, shared by
         :meth:`poll` and callers driving :meth:`load_candidate`
         themselves (serving/controller.py)."""
-        self._rejected.add(int(version))
+        with self._lock:
+            self._rejected.add(int(version))
         self._group.counter(
             "swapRejected",
             labels={"model": self.model, "reason": reason})
@@ -236,10 +241,12 @@ class ModelRegistry:
         it, so a running watcher cannot adopt it directly while the
         ops controller canaries it. Released by :meth:`release_version`
         (and implicitly by rollback/drop, which condemn or free it)."""
-        self._held.add(int(version))
+        with self._lock:
+            self._held.add(int(version))
 
     def release_version(self, version: int) -> None:
-        self._held.discard(int(version))
+        with self._lock:
+            self._held.discard(int(version))
 
     def poll(self) -> bool:
         """One watcher step: consider published versions newer than the
@@ -250,13 +257,18 @@ class ModelRegistry:
         held for a staged rollout (:meth:`hold_version`) or currently
         riding as the canary are skipped — adopting them here would
         bypass the ramp and bake gates."""
-        current = self._version
-        canary = self._canary
+        # one consistent snapshot of the swap state; the dir scan and
+        # the adopt work run lock-free on the copies
+        with self._lock:
+            current = self._version
+            canary = self._canary
+            rejected = set(self._rejected)
+            held = set(self._held)
         canary_version = canary[1] if canary is not None else None
         fresh = [v for v in self._published_versions()
                  if (current is None or v > current)
-                 and v not in self._rejected
-                 and v not in self._held
+                 and v not in rejected
+                 and v not in held
                  and v != canary_version]
         for version in reversed(fresh):
             try:
@@ -418,7 +430,7 @@ class ModelRegistry:
         staged rollout); returns the promoted version. Retryable on an
         injected ``model-swap`` fault — nothing is mutated until the
         commit."""
-        canary = self._canary
+        canary = self._canary  # jaxlint: disable=unguarded-shared-state -- snapshot-then-commit: _commit takes the lock before mutating
         if canary is None:
             raise ValueError("no canary to promote")
         candidate, version = canary
@@ -437,11 +449,13 @@ class ModelRegistry:
         with self._lock:
             canary, self._canary = self._canary, None
             self._canary_fraction = 0.0
+            if canary is not None:
+                # a dropped canary's version is free again — including
+                # for the watcher, which the hold/canary guards kept
+                # away from it
+                self._held.discard(canary[1])
         if canary is None:
             return None
-        # a dropped canary's version is free again — including for the
-        # watcher, which the hold/canary guards kept away from it
-        self._held.discard(canary[1])
         self._group.gauge("canaryFraction", 0.0,
                           labels={"model": self.model})
         self._group.gauge("canaryVersion", 0,  # 0 = none (v start at 1)
@@ -603,6 +617,8 @@ class ModelRegistry:
     def start_watcher(self) -> "ModelRegistry":
         if self._watcher is not None:
             return self
+        # a crashing watcher must surface in telemetry, not die mute
+        install_thread_excepthook()
         self._stop.clear()
         self._watcher = threading.Thread(
             target=self._watch_supervised,

@@ -198,6 +198,19 @@ def main() -> int:
     if rc_loose != 0:
         fail(1, f"slo --check --latest on a satisfied spec exited "
                 f"{rc_loose}, expected 0")
+
+    # when CI arms the lock watchdog, the smoke self-gates its own lock
+    # discipline: dump_metrics above left locks-*.json beside the spans,
+    # and a cycle or long hold in the serving path must fail here
+    if os.environ.get("FLINK_ML_TPU_LOCKCHECK"):
+        from flink_ml_tpu.observability import lockstats
+
+        rc_locks = lockstats.main([TRACE_DIR, "--check"])
+        if rc_locks != 0:
+            fail(1, f"locks --check exited {rc_locks}, expected 0 "
+                    "(lock-order cycle, long hold, or missing lock "
+                    "telemetry in the smoke)")
+
     print("serve_smoke: OK — /metrics + /slo live, error path counted, "
           "slo --check gates 4/0")
     return 0

@@ -1,0 +1,28 @@
+"""jaxlint fixture: NEGATIVE for blocking-under-lock.
+
+The shapes that must NOT fire: blocking calls outside any guard,
+``cond.wait()`` under its own condition (releases the lock while
+waiting), string ``sep.join(parts)``, and ``dict.get(key)``.
+"""
+import threading
+import time
+
+_cond = threading.Condition()
+_lock = threading.Lock()
+
+
+def blocking_outside(future, worker):
+    time.sleep(0.1)
+    future.result()
+    worker.join()
+
+
+def sanctioned_wait():
+    with _cond:
+        _cond.wait()
+
+
+def lookups(labels, table):
+    with _lock:
+        rendered = ", ".join(labels)
+        return table.get(rendered)

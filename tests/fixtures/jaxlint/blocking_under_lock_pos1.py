@@ -1,0 +1,19 @@
+"""jaxlint fixture: POSITIVE for blocking-under-lock.
+
+A Future.result() and a time.sleep() with the lock held — every thread
+contending for ``_lock`` stalls behind the block.
+"""
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def wait_for(future):
+    with _lock:
+        return future.result()
+
+
+def throttle():
+    with _lock:
+        time.sleep(0.5)

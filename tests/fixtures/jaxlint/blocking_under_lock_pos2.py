@@ -1,0 +1,25 @@
+"""jaxlint fixture: POSITIVE for blocking-under-lock.
+
+A thread join and a queue get inside ``with self._lock:``, plus a
+``*_locked`` helper (lock held by contract) that joins — all three
+block indefinitely with the lock held.
+"""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=lambda: None)
+        self.queue = None
+
+    def stop(self):
+        with self._lock:
+            self._worker.join()
+
+    def take(self):
+        with self._lock:
+            return self.queue.get()
+
+    def _stop_locked(self):
+        self._worker.join(5.0)

@@ -1,0 +1,25 @@
+"""jaxlint fixture: NEGATIVE for fork-unsafe-state.
+
+The reseed pattern: the child re-creates its lock first thing instead
+of touching the inherited one, the fork happens outside any guard, and
+the parent branch may use pre-fork state freely.
+"""
+import os
+import threading
+
+_log_lock = threading.Lock()
+
+
+def _child_main(payload):
+    fresh = threading.Lock()
+    with fresh:
+        return payload
+
+
+def spawn(payload):
+    pid = os.fork()
+    if pid == 0:
+        _child_main(payload)
+        os._exit(0)
+    with _log_lock:  # parent-side use of pre-fork state is fine
+        return pid

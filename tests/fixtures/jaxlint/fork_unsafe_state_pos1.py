@@ -1,0 +1,23 @@
+"""jaxlint fixture: POSITIVE for fork-unsafe-state.
+
+A module-level lock created before the fork is used in the child's
+entrypoint — a sibling thread may have held it at fork time, so the
+child's first acquire can deadlock forever.
+"""
+import os
+import threading
+
+_cache_lock = threading.Lock()
+
+
+def _child_main(payload):
+    with _cache_lock:
+        return payload
+
+
+def spawn(payload):
+    pid = os.fork()
+    if pid == 0:
+        _child_main(payload)
+        os._exit(0)
+    return pid

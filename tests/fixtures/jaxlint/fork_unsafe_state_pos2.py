@@ -1,0 +1,29 @@
+"""jaxlint fixture: POSITIVE for fork-unsafe-state.
+
+Two hazards: forking while the guard is held (the child is born with
+the mutex locked), and a pre-fork worker Thread joined from a function
+the ``pid == 0`` branch calls (the thread object exists in the child
+but its OS thread does not).
+"""
+import os
+import threading
+
+_state_lock = threading.Lock()
+_worker = threading.Thread(target=lambda: None)
+
+
+def fork_under_guard():
+    with _state_lock:
+        return os.fork()
+
+
+def _drain():
+    _worker.join()
+
+
+def launch():
+    pid = os.fork()
+    if pid == 0:
+        _drain()
+        os._exit(0)
+    return pid

@@ -1,0 +1,28 @@
+"""jaxlint fixture: NEGATIVE for lock-order.
+
+Both paths nest the same pair in the same order (outer before inner) —
+a consistent hierarchy never deadlocks, and a single-lock file has no
+order to violate.
+"""
+import threading
+
+_outer = threading.Lock()
+_inner = threading.Lock()
+_solo = threading.Lock()
+
+
+def path_one():
+    with _outer:
+        with _inner:
+            return 1
+
+
+def path_two():
+    with _outer:
+        with _inner:
+            return 2
+
+
+def lone():
+    with _solo:
+        return 3

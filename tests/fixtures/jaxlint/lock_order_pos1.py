@@ -1,0 +1,21 @@
+"""jaxlint fixture: POSITIVE for lock-order.
+
+Two module-level locks nested in opposite orders across two paths —
+run concurrently, the paths deadlock.
+"""
+import threading
+
+_stats_lock = threading.Lock()
+_state_lock = threading.Lock()
+
+
+def record(value):
+    with _stats_lock:
+        with _state_lock:
+            return value
+
+
+def rollover():
+    with _state_lock:
+        with _stats_lock:
+            return None

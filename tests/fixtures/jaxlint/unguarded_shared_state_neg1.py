@@ -1,0 +1,34 @@
+"""jaxlint fixture: NEGATIVE for unguarded-shared-state.
+
+Every access to ``_items`` is either under the lock or inside a
+``*_locked`` helper (the callee-side guard contract); ``Plain`` has no
+lock at all, so its attributes carry no discipline to violate.
+"""
+import threading
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, item):
+        with self._lock:
+            self._items.append(item)
+
+    def drain(self):
+        with self._lock:
+            return self._drain_locked()
+
+    def _drain_locked(self):
+        out = list(self._items)
+        self._items = []
+        return out
+
+
+class Plain:
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1

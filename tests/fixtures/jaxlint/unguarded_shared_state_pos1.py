@@ -1,0 +1,23 @@
+"""jaxlint fixture: POSITIVE for unguarded-shared-state.
+
+``_pending`` is written under ``with self._lock:`` in submit(), so the
+class's discipline is established — the unguarded read in size() and the
+unguarded write in clear() are both races.
+"""
+import threading
+
+
+class WorkQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+
+    def submit(self, item):
+        with self._lock:
+            self._pending.append(item)
+
+    def size(self):
+        return len(self._pending)  # read without the lock
+
+    def clear(self):
+        self._pending = []  # write without the lock

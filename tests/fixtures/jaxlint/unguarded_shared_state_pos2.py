@@ -1,0 +1,23 @@
+"""jaxlint fixture: POSITIVE for unguarded-shared-state.
+
+The lock comes from the package seam (``make_lock``), the guarded write
+is in swap(); resolve() then reads both guarded attributes lock-free.
+"""
+from flink_ml_tpu.common.locks import make_lock
+
+
+class Registry:
+    def __init__(self):
+        self._lock = make_lock("fixture.registry")
+        self._active = None
+        self._version = 0
+
+    def swap(self, servable):
+        with self._lock:
+            self._active = servable
+            self._version += 1
+
+    def resolve(self):
+        if self._active is None:  # read without the lock
+            raise KeyError("no active servable")
+        return self._active, self._version

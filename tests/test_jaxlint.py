@@ -37,6 +37,10 @@ RULE_OF_PREFIX = {
     "alias_mutation": "alias-mutation",
     "metric_in_jit": "metric-in-jit",
     "raw_collective": "raw-collective",
+    "unguarded_shared_state": "unguarded-shared-state",
+    "lock_order": "lock-order",
+    "blocking_under_lock": "blocking-under-lock",
+    "fork_unsafe_state": "fork-unsafe-state",
 }
 
 
@@ -172,6 +176,27 @@ def test_unused_suppression_is_reported_except_on_subset_runs():
     assert analyze_source(src, rules=["tracer-leak"]) == []
 
 
+def test_cli_suppressions_audit_is_nonblocking(tmp_path):
+    """``--suppressions`` is a report, not a gate: exit 0 even with a
+    stale entry, which is flagged in the listing (the blocking copy of
+    staleness is the unused-suppression finding in a plain run)."""
+    stale = tmp_path / "stale.py"
+    stale.write_text(
+        "x = 1  # jaxlint: disable=rng-reuse -- hazard was removed\n")
+    proc = _run_cli("--suppressions", str(stale),
+                    os.path.join(FIXTURES, "suppression_justified.py"))
+    assert proc.returncode == 0
+    assert "STALE" in proc.stdout
+    assert proc.stdout.rstrip().endswith("1 stale")
+    out = tmp_path / "sup.json"
+    proc = _run_cli("--suppressions", "--format", "json",
+                    "--output", str(out), str(stale))
+    assert proc.returncode == 0
+    data = json.loads(out.read_text())
+    assert data["counts"] == {"total": 1, "stale": 1}
+    assert data["suppressions"][0]["rules"] == ["rng-reuse"]
+
+
 def test_disable_example_in_docstring_is_not_a_suppression():
     src = ('"""Docs: write `# jaxlint: disable=rng-reuse -- why` '
            'to suppress."""\nx = 1\n')
@@ -301,6 +326,23 @@ def test_map_shards_wrap_marks_body_as_traced():
            "                     out_specs=None)\n")
     assert [f for f in analyze_source(src, "m.py")
             if f.rule == "tracer-leak"]
+
+
+def test_map_rows_wrap_marks_body_as_traced():
+    """map_rows (the row-sharded serving wrapper over map_shards) is a
+    JIT seam too: a body it wraps is traced, so the traced-code rules
+    must see through it — pinned so the serving predict bodies keep
+    their JL101/JL107 coverage."""
+    src = ("from flink_ml_tpu.parallel import mapreduce as mr\n"
+           "def predict_rows(x):\n"
+           "    if float(x.sum()) > 0:\n"
+           "        return x\n"
+           "    metrics.group('ml').counter('rows')\n"
+           "    return -x\n"
+           "fn = mr.map_rows(predict_rows, None)\n")
+    rules = {f.rule for f in analyze_source(src, "m.py")}
+    assert "tracer-leak" in rules
+    assert "metric-in-jit" in rules
 
 
 def test_program_builder_compose_marks_both_bodies_as_traced():
