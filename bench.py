@@ -210,6 +210,11 @@ def _worker(role: str) -> int:
                         # multi-process provenance (jax.distributed)
                         "processCount": best.get("processCount"),
                         "processIndex": best.get("processIndex"),
+                        # elastic provenance (parallel/elastic.py):
+                        # worker losses/relaunches/dropped rounds and
+                        # the worst round-participation fraction
+                        "elasticEvents": best.get("elasticEvents"),
+                        "participationMin": best.get("participationMin"),
                         # serving-dispatch provenance (null on plain
                         # fits — no micro-batcher ran beside this row)
                         "shardedDispatch": best.get("shardedDispatch"),
@@ -256,6 +261,12 @@ def _worker(role: str) -> int:
         # this one-liner was written from
         "process_count": best.get("processCount"),
         "process_index": best.get("processIndex"),
+        # elastic provenance (parallel/elastic.py): how many elastic
+        # events (worker losses, relaunches, straggler-dropped rounds)
+        # this number absorbed — 0 on a calm run — and the worst
+        # round-participation fraction (1.0 = every shard, every round)
+        "elastic_events": best.get("elasticEvents"),
+        "participation_min": best.get("participationMin"),
         # serving-dispatch provenance (serving/batcher.py): whether a
         # mesh-sharded, pipelined micro-batcher served beside this row
         # (null on plain fit benches)

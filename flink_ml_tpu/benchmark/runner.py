@@ -104,9 +104,10 @@ def run_benchmark(name: str, spec: dict) -> dict:
 
 def _run_benchmark(name: str, spec: dict) -> dict:
     try:  # a row must carry only ITS OWN run's update-state provenance
-        from flink_ml_tpu.parallel import update_sharding
+        from flink_ml_tpu.parallel import elastic, update_sharding
 
         update_sharding.reset_last()
+        elastic.reset_stats()
     except Exception:  # noqa: BLE001 — provenance only
         pass
     stage = resolve_stage(spec["stage"]["className"])()

@@ -207,12 +207,52 @@ def quarantine_checkpoint(ckpt_dir: str, reason: str) -> str:
     return target
 
 
-class CheckpointManager:
-    """Saves/restores (carry, epoch) snapshots under a base directory."""
+def repad_leading(host: np.ndarray, target_shape) -> np.ndarray:
+    """Re-place one dim-0 zero-padded leaf onto a different padded
+    length (the elastic cross-N re-placement seam): the update-sharding
+    layer pads dim 0 to ``padded_len(n, n_shards)`` with trailing zeros
+    that stay inert through every update rule, so a checkpoint written
+    at N processes restores at M by trimming or re-extending that pad.
+    A NONZERO trimmed tail is genuine incompatibility (real state would
+    be lost) and raises :class:`CorruptCheckpoint`, routing the restore
+    to quarantine + fallback like any other integrity failure."""
+    target_shape = tuple(int(s) for s in target_shape)
+    if tuple(host.shape) == target_shape:
+        return host
+    if (host.ndim != len(target_shape) or host.ndim == 0
+            or tuple(host.shape[1:]) != target_shape[1:]):
+        raise CorruptCheckpoint(
+            f"leaf shape {tuple(host.shape)} cannot re-place onto "
+            f"{target_shape}: only the leading (padded) dim may differ")
+    n = target_shape[0]
+    if host.shape[0] > n:
+        tail = host[n:]
+        if np.any(tail != np.zeros((), dtype=host.dtype)):
+            raise CorruptCheckpoint(
+                f"leaf shape {tuple(host.shape)} trim to {target_shape} "
+                "would drop nonzero state (not dim-0 padding)")
+        return np.ascontiguousarray(host[:n])
+    pad = [(0, n - host.shape[0])] + [(0, 0)] * (host.ndim - 1)
+    return np.pad(host, pad)
 
-    def __init__(self, base_dir: str, keep: int = 2):
+
+class CheckpointManager:
+    """Saves/restores (carry, epoch) snapshots under a base directory.
+
+    ``repad_dim0=True`` opts restore into cross-parallelism
+    re-placement: leaves whose shapes differ from the template only in
+    dim 0 are trimmed/zero-extended through :func:`repad_leading`
+    before being device_put onto the template's shardings — how the
+    elastic driver (parallel/elastic.py) resumes an N-process fit on a
+    smaller replica set. Off by default: the same-parallelism
+    restriction stays the safe baseline (a shape drift is corruption
+    unless a caller explicitly declares its dim 0 to be padding)."""
+
+    def __init__(self, base_dir: str, keep: int = 2,
+                 repad_dim0: bool = False):
         self.base_dir = base_dir
         self.keep = keep
+        self.repad_dim0 = repad_dim0
         os.makedirs(base_dir, exist_ok=True)
         # a crash between makedirs and the atomic rename strands a
         # ckpt-*.tmp dir; left alone they accumulate forever
@@ -337,6 +377,22 @@ class CheckpointManager:
                   ) -> Tuple[List[np.ndarray], int]:
         return load_validated(ckpt_dir, expected_leaves)
 
+    def _place(self, host: np.ndarray, tmpl):
+        """One restored host leaf onto the template leaf's placement —
+        the seam the elastic manager (parallel/elastic.py) overrides to
+        place shards of a mesh that spans processes."""
+        if hasattr(tmpl, "sharding"):
+            return jax.device_put(host, tmpl.sharding)
+        return host
+
+    def _repad(self, host: np.ndarray, target_shape) -> np.ndarray:
+        """One leaf re-placed onto the template's shape (only consulted
+        under ``repad_dim0``): the baseline treats every dim-0 mismatch
+        as the sharded update's zero padding. The elastic manager
+        overrides this to ALSO rescale per-shard integer progress
+        counters across the changed shard count."""
+        return repad_leading(host, target_shape)
+
     def restore(self, template_carry: Any) -> Optional[Tuple[Any, int]]:
         """Newest checkpoint that passes integrity validation, restored
         onto the template's structure and shardings; corrupt checkpoints
@@ -352,16 +408,15 @@ class CheckpointManager:
                 try:
                     host_leaves, epoch = self._load_validated(
                         ckpt_dir, len(t_leaves))
+                    if self.repad_dim0:
+                        host_leaves = [
+                            self._repad(h, np.shape(t))
+                            for h, t in zip(host_leaves, t_leaves)]
                 except CorruptCheckpoint as e:
                     self._quarantine(ckpt_dir, str(e))
                     continue
-                restored = []
-                for host, tmpl in zip(host_leaves, t_leaves):
-                    if hasattr(tmpl, "sharding"):
-                        restored.append(jax.device_put(host,
-                                                       tmpl.sharding))
-                    else:
-                        restored.append(host)
+                restored = [self._place(host, tmpl)
+                            for host, tmpl in zip(host_leaves, t_leaves)]
                 nbytes = int(sum(x.nbytes for x in host_leaves))
                 sp.set_attribute("epoch", epoch)
                 sp.set_attribute("checkpoint", name)

@@ -55,7 +55,13 @@ def read_boundary(boundary) -> list:
     scalars (the pre-fusion form — one transfer each). Returns the
     values as numpy scalars in order."""
     from flink_ml_tpu.common.metrics import ML_GROUP, metrics
+    from flink_ml_tpu.parallel import elastic
 
+    # the boundary fetch is where a wedged inter-process reduce leg
+    # surfaces on host: with FLINK_ML_TPU_COLLECTIVE_TIMEOUT_S armed
+    # the sync runs under a watchdog and a dead peer becomes a
+    # retryable WorkerLost instead of a hang (parallel/elastic.py)
+    boundary = elastic.guard_fetch(boundary, what="segment boundary")
     grp = metrics.group(ML_GROUP, "iteration")
     if isinstance(boundary, (tuple, list)):
         vals = [np.asarray(v) for v in boundary]
@@ -255,6 +261,10 @@ def run_segmented(run_segment, initial_carry, max_iter: int, K: int, mgr):
             # chaos site: the segment boundary is this mode's epoch
             # boundary
             faults.inject("epoch-boundary", epoch=epoch)
+            # heartbeat + worker-loss/worker-hang chaos probe
+            # (multi-process only; see parallel/elastic.py)
+            from flink_ml_tpu.parallel import elastic
+            elastic.on_boundary(epoch)
             done = epoch >= max_iter or stop
             if epoch % K == 0 and not done:
                 mgr.save(carry, epoch)
@@ -414,6 +424,8 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
             carry, stop = round_fn(
                 carry, jnp.int32(epoch) if jit_round else epoch)
             faults.inject("epoch-boundary", epoch=epoch)
+            from flink_ml_tpu.parallel import elastic
+            elastic.on_boundary(epoch)
             # listeners/checkpoints run while the async-dispatched device
             # round is still executing — host and device legs overlap
             host_start = _time.perf_counter()
@@ -430,7 +442,9 @@ def _host_loop(initial_carry, body, max_iter, terminate, config, listeners,
                 from flink_ml_tpu.observability import meshstats
                 meshstats.observe_shard_ready(carry, span=sp,
                                               phase="epoch")
-            stop = bool(stop)  # host sync point: device round complete
+            # guarded host sync point (device round complete): a wedged
+            # inter-process reduce becomes WorkerLost past the deadline
+            stop = bool(elastic.guard_fetch(stop, what="round stop bit"))
             # per-round wall time split: hostMs = listener/checkpoint
             # work, deviceMs = dispatch + residual device wait after the
             # overlap — the profiling surface the reference lacks (its

@@ -99,7 +99,9 @@ from flink_ml_tpu.observability.exporters import (
 
 #: events that belong on the failure/recovery timeline
 TIMELINE_EVENTS = ("supervisor.restart", "supervisor.recovered",
-                   "checkpoint.quarantine", "hostpool.timeout")
+                   "checkpoint.quarantine", "hostpool.timeout",
+                   "elastic.worker-lost", "elastic.relaunch",
+                   "elastic.participation", "elastic.chaos")
 
 
 def _ms(us) -> float:

@@ -911,10 +911,15 @@ class SGD:
             # restore simply resumes the series at its epoch (earlier
             # rows stay NaN and are sliced off by `first`)
             repl = NamedSharding(mesh, P())
+            # built under jit, not device_put: putting a host NaN array
+            # onto a multi-process sharding trips jax's cross-process
+            # value check (NaN != NaN in multihost_utils.assert_equal)
+            hist_rows = self.params.max_iter if health_on else 0
             hstate = {
-                "hist": jax.device_put(jnp.full(
-                    (self.params.max_iter if health_on else 0, 3),
-                    jnp.nan, jnp.float32), repl),
+                "hist": jax.jit(
+                    functools.partial(jnp.full, (hist_rows, 3),
+                                      jnp.nan, jnp.float32),
+                    out_shardings=repl)(),
                 "fin": True, "first": None, "epoch": 0,
             }
 

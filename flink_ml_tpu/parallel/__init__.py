@@ -27,6 +27,7 @@ from flink_ml_tpu.parallel.collective import (  # noqa: F401
     all_reduce_sum,
     broadcast_from,
     reduce_scatter,
+    renormalized_sum,
     shard_batch,
     shard_index,
     replicate,
@@ -43,3 +44,4 @@ from flink_ml_tpu.parallel.mapreduce import (  # noqa: F401
 from flink_ml_tpu.parallel import update_sharding  # noqa: F401
 from flink_ml_tpu.parallel import distributed  # noqa: F401
 from flink_ml_tpu.parallel.distributed import build_mesh  # noqa: F401
+from flink_ml_tpu.parallel import elastic  # noqa: F401

@@ -205,6 +205,33 @@ def all_reduce_sum(x, axis_name=DATA_AXIS):
     return jax.lax.psum(x, axis_name)
 
 
+def renormalized_sum(x, include, axis_name=DATA_AXIS):
+    """Partial-participation all-reduce (JiT aggregation,
+    arXiv:2208.09740): every shard still executes the collective (SPMD
+    lockstep — a shard cannot skip a psum), but a shard whose ``include``
+    is 0 contributes zero, and the sum is rescaled by
+    ``n_shards / participants`` so the expected update stays unbiased —
+    dropping shard k for one round scales the survivors up instead of
+    silently shrinking the step. ``include`` is this shard's 0/1 scalar,
+    decided on HOST from the *previous* round's readiness timings
+    (parallel/elastic.py:round_participation — the actuator guarantees
+    at least one participant; the ``maximum(…, 1)`` below only keeps a
+    pathological all-dropped round finite). With every shard included
+    the result is bit-identical to :func:`all_reduce_sum` (``include``
+    multiplies by exactly 1 and the scale is exactly 1)."""
+    axes = ((axis_name,) if isinstance(axis_name, str)
+            else tuple(axis_name))
+    n_shards = int(np.prod([axis_size(a) for a in axes]))
+    dtype = jnp.result_type(x)
+    if not jnp.issubdtype(dtype, jnp.inexact):
+        dtype = jnp.float32
+    inc = jnp.asarray(include).astype(dtype)
+    total = all_reduce_sum(x * inc, axis_name)
+    participants = all_reduce_sum(inc, axis_name)
+    scale = n_shards / jnp.maximum(participants, jnp.asarray(1, dtype))
+    return total * scale
+
+
 def all_reduce_mean(x, axis_name: str = DATA_AXIS):
     _note_traced("pmean", x, axis_name)
     return jax.lax.pmean(x, axis_name)

@@ -253,7 +253,8 @@ def _free_port() -> int:
 
 def launch(argv: Sequence[str], num_processes: int, local_devices: int = 1,
            env: Optional[dict] = None, timeout: float = 900.0,
-           coordinator_port: Optional[int] = None) -> List[dict]:
+           coordinator_port: Optional[int] = None,
+           child_grace_s: float = 30.0) -> List[dict]:
     """Run ``argv`` as ``num_processes`` coordinated CPU processes.
 
     Each child gets the env mapping (coordinator on a free localhost
@@ -265,11 +266,24 @@ def launch(argv: Sequence[str], num_processes: int, local_devices: int = 1,
     process joins); output is captured per process.
 
     Returns one record per process: ``{"process", "returncode",
-    "stdout", "stderr"}``, in process order. Raises nothing on a child
+    "exitOrder", "stdout", "stderr"}``, in process order —
+    ``exitOrder`` is the poll-observed exit sequence (0 = first to
+    exit, None when the launcher never saw it exit before draining),
+    which lets an elastic driver name the FIRST signal death (the true
+    victim) rather than a grace-killed survivor. Raises nothing on a child
     failure — the caller owns the verdict (the bench gates on it) — but
     a TimeoutExpired kills the whole group (a wedged coordinator must
     not hang CI forever).
-    """
+
+    ``child_grace_s`` is the per-child liveness deadline: once ANY
+    child exits nonzero, its surviving siblings get this many seconds
+    to finish before the group is killed and the records (with the real
+    failing rc) are returned. Without it a crashed child's exit code
+    was held hostage by a wedged sibling until the FULL ``timeout`` —
+    a lost worker wedges the whole lockstep group mid-collective, so
+    that was the common case, not the corner. The killed survivors
+    report their signal rc (e.g. ``-9``); the caller still owns the
+    verdict."""
     port = coordinator_port or _free_port()
     base = dict(os.environ)
     base.update(env or {})
@@ -321,19 +335,47 @@ def launch(argv: Sequence[str], num_processes: int, local_devices: int = 1,
     for t in threads:
         t.start()
     deadline = time.monotonic() + timeout
-    for t in threads:
-        t.join(max(deadline - time.monotonic(), 0.0))
-    if any(t.is_alive() for t in threads):
-        for proc in procs:
-            if proc.poll() is None:
-                proc.kill()
-        for t in threads:
-            t.join(10.0)
-        raise subprocess.TimeoutExpired(list(argv), timeout)
-    return [{"process": pid, "returncode": proc.returncode,
-             "stdout": out, "stderr": err}
-            for pid, (proc, (out, err))
-            in enumerate(zip(procs, collected))]
+    grace_deadline = None  # armed by the first nonzero child exit
+    exit_order = [None] * len(procs)  # poll-observed exit sequence
+    exit_seq = 0
+    while True:
+        alive = [t for t in threads if t.is_alive()]
+        if not alive:
+            break
+        now = time.monotonic()
+        for i, p in enumerate(procs):
+            if exit_order[i] is None and p.poll() is not None:
+                exit_order[i] = exit_seq
+                exit_seq += 1
+        if now >= deadline:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+            for t in threads:
+                t.join(10.0)
+            raise subprocess.TimeoutExpired(list(argv), timeout)
+        if grace_deadline is None:
+            if any(p.poll() is not None and p.returncode != 0
+                   for p in procs):
+                grace_deadline = now + max(float(child_grace_s), 0.0)
+        elif now >= grace_deadline:
+            # per-child liveness deadline tripped: a crashed child's rc
+            # must not be held hostage by a wedged sibling until the
+            # full group timeout — kill the survivors and report
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+            for t in threads:
+                t.join(10.0)
+            break
+        alive[0].join(0.05)
+    records = []
+    for pid, (proc, got) in enumerate(zip(procs, collected)):
+        out, err = got if got is not None else ("", "")
+        records.append({"process": pid, "returncode": proc.returncode,
+                        "exitOrder": exit_order[pid],
+                        "stdout": out, "stderr": err})
+    return records
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

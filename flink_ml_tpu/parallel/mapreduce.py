@@ -55,8 +55,8 @@ from flink_ml_tpu.parallel.shardmap import shard_map as _shard_map
 
 __all__ = [
     "broadcast", "map_shards", "map_rows", "reduce_sum", "reduce_mean",
-    "reduce_max", "reduce_scatter", "all_gather", "shard_index",
-    "shard_count", "local_valid_mask", "MapReduceProgram",
+    "reduce_max", "reduce_scatter", "renormalized_sum", "all_gather",
+    "shard_index", "shard_count", "local_valid_mask", "MapReduceProgram",
 ]
 
 
@@ -86,6 +86,15 @@ def reduce_scatter(x, axis_name=DATA_AXIS):
     """Sum of the per-shard partials, scattered: each shard keeps its
     own ``1/N`` slice of dim 0 (see collective.reduce_scatter)."""
     return _c.reduce_scatter(x, axis_name)
+
+
+def renormalized_sum(x, include, axis_name=DATA_AXIS):
+    """Partial-participation reduce: shards with ``include=0`` contribute
+    zero and the sum is rescaled by ``n_shards / participants`` so the
+    update stays unbiased — the straggler-aware round primitive
+    (parallel/elastic.py decides ``include`` per round on host; see
+    collective.renormalized_sum)."""
+    return _c.renormalized_sum(x, include, axis_name)
 
 
 def all_gather(x, axis_name=DATA_AXIS, axis: int = 0, tiled: bool = True):

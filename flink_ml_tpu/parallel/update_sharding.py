@@ -203,11 +203,16 @@ def reset_last() -> None:
 
 
 def provenance() -> dict:
-    """Benchmark-row provenance: whether the sharded update is armed and
+    """Benchmark-row provenance: whether the sharded update is armed,
     the last recorded per-replica state bytes (absent if nothing has
-    recorded yet)."""
+    recorded yet), and the elastic-run fields (``elasticEvents`` /
+    ``participationMin`` — parallel/elastic.py) that sit beside
+    ``processCount`` on every row."""
     out = {"updateSharding": enabled()}
     b = last_state_bytes()
     if b is not None:
         out["optStateBytesPerReplica"] = b
+    from flink_ml_tpu.parallel import elastic
+
+    out.update(elastic.provenance())
     return out

@@ -22,6 +22,7 @@ from flink_ml_tpu.resilience.policy import (  # noqa: F401
     RetryableFailure,
     RetryPolicy,
     TerminalFailure,
+    WorkerLost,
     WorkerTimeout,
 )
 from flink_ml_tpu.resilience.supervisor import run_supervised  # noqa: F401
@@ -36,6 +37,7 @@ __all__ = [
     "RetryableFailure",
     "RetryPolicy",
     "TerminalFailure",
+    "WorkerLost",
     "WorkerTimeout",
     "run_supervised",
 ]

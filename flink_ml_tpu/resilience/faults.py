@@ -47,6 +47,13 @@ Known injection sites:
 - ``model-rollback``     entry of ModelRegistry.rollback, before any
                          mutation (the controller re-enters until the
                          prior version serves again)
+- ``worker-loss``        a launched multi-process training child
+                         SIGKILLs itself at an epoch boundary (the
+                         elastic mesh-rebuild path; only the victim
+                         process acts — see parallel/elastic.py)
+- ``worker-hang``        a launched child stalls at the boundary past
+                         the collective deadline (the WorkerLost
+                         detection path)
 """
 
 from __future__ import annotations
@@ -63,7 +70,7 @@ from flink_ml_tpu.resilience.policy import InjectedFault
 SITES = ("checkpoint-save", "checkpoint-publish", "epoch-boundary",
          "hostpool-child", "hostpool-hang", "native-kernel",
          "controller-retrain", "controller-publish", "canary-probe",
-         "model-swap", "model-rollback")
+         "model-swap", "model-rollback", "worker-loss", "worker-hang")
 
 #: the ops-loop subset (serving/controller.py + registry canary/swap/
 #: rollback seams) — what scripts/ops_loop_smoke.py arms

@@ -51,6 +51,29 @@ class WorkerTimeout(RetryableFailure):
             f"{timeout_s:g}s deadline and was killed")
 
 
+class WorkerLost(RetryableFailure):
+    """A multi-process training peer stopped participating: its
+    heartbeat went stale, it crashed under ``distributed.launch``, or
+    the inter-process reduce leg wedged past the collective deadline
+    (``FLINK_ML_TPU_COLLECTIVE_TIMEOUT_S``).
+
+    Retryable: the elastic driver (parallel/elastic.py) answers a
+    WorkerLost by rebuilding a smaller ``(dcn, data)`` mesh from the
+    survivors and re-placing the 1/N-sharded optimizer slices from the
+    newest v2 manifest — the restart budget bounds how many losses a
+    fit may absorb."""
+
+    def __init__(self, process_index: Optional[int], reason: str = "",
+                 timeout_s: Optional[float] = None):
+        self.process_index = process_index
+        self.timeout_s = timeout_s
+        who = (f"process {process_index}" if process_index is not None
+               else "an unidentified process")
+        tail = f": {reason}" if reason else ""
+        after = (f" after {timeout_s:g}s" if timeout_s is not None else "")
+        super().__init__(f"worker lost ({who}){after}{tail}")
+
+
 class InjectedFault(RetryableFailure):
     """Raised by the chaos harness (resilience/faults.py) at an
     instrumented site; always retryable — recovery is the thing under
@@ -65,10 +88,14 @@ class InjectedFault(RetryableFailure):
 
 class RestartsExhausted(TerminalFailure):
     """The supervisor ran out of restart budget; the last underlying
-    failure rides along as ``__cause__``."""
+    failure rides along as ``__cause__``.  ``budget`` names WHICH bound
+    tripped — ``"restart"``/``"deadline"`` from run_supervised, or
+    ``"elastic"`` when the elastic driver could not shrink the mesh any
+    further (survivor count would fall below ``min_processes``)."""
 
-    def __init__(self, attempts: int, reason: str):
+    def __init__(self, attempts: int, reason: str, budget: str = "restart"):
         self.attempts = attempts
+        self.budget = budget
         super().__init__(
             f"gave up after {attempts} restart(s): {reason}")
 

@@ -1,0 +1,372 @@
+#!/usr/bin/env python
+"""Elastic multi-process smoke: survive a worker SIGKILL mid-fit.
+
+Proves the elastic recovery path (``parallel/elastic.py``) end to end:
+a 4-process sharded-adam fit is launched through ``run_elastic`` with
+the ``worker-loss`` chaos site armed to SIGKILL process 1 at the second
+epoch boundary (epoch 4, BEFORE that boundary's checkpoint). The
+launcher's per-child liveness grace kills the wedged survivors, the
+elastic driver names the victim, shrinks the world to 3, and the
+relaunch resumes from the epoch-2 checkpoint with the 1/N slices
+re-placed across the changed N.
+
+Self-gating:
+
+1. **Recovery** — the fit completes at 3 processes after exactly one
+   worker loss + one relaunch (``ml.elastic`` provenance).
+2. **Parity** — the recovered params are BIT-IDENTICAL to a clean
+   3-process fit restored from a snapshot of the same epoch-2
+   checkpoint (same world, same boundary, same computation — float
+   reassociation never enters).
+3. **Straggler rounds** — a 4-shard partial-participation loop drops
+   ONLY the deadline'd shard, ``renormalized_sum`` keeps the update
+   unbiased (exact vs the host-side expectation; bit-identical to the
+   plain reduce at full participation), staleness force-readmits after
+   ``max_staleness`` consecutive drops, and a round never drops every
+   shard.
+
+The record lands in ``BENCH_multihost.json`` under ``elastic_sweep``.
+Structure mirrors multihost_bench.py (every fit runs in subprocesses
+with its own env); the parent imports the package only for the elastic
+driver and never builds a mesh or touches devices itself.
+
+Exit codes mirror run_chaos_smoke.py: 0 = recovered and identical;
+2 = elastic/restart budget exhausted (retryable); 3 = recovered but
+results differ (a correctness regression in the recovery path).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # run from a checkout without installing
+
+#: fit geometry — batch 120 divides every world size the sweep visits
+#: (4 procs, the shrunken 3, and the 2-proc floor), so every attempt
+#: runs the identical SPMD program over identical batches
+N_ROWS, N_DIM, BATCH = 360, 10, 120
+MAX_ITER, CKPT_INTERVAL = 8, 2
+
+
+# ---------------------------------------------------------------------------
+# worker: one process of the elastic fit (imports jax; the parent never does)
+# ---------------------------------------------------------------------------
+
+def run_worker() -> int:
+    from flink_ml_tpu.parallel import elastic
+
+    attempt = int(os.environ.get(elastic.ATTEMPT_ENV, "0"))
+    if attempt > 0:
+        # the scheduled kill already fired: a relaunched world must not
+        # replay it (the deterministic counter would otherwise strike
+        # again two boundaries after the resume point)
+        os.environ.pop("FLINK_ML_TPU_CHAOS", None)
+
+    from flink_ml_tpu.parallel import distributed as dist
+
+    dist.init_from_env()
+
+    import numpy as np
+
+    import jax
+
+    from flink_ml_tpu.iteration.iteration import IterationConfig
+    from flink_ml_tpu.ops.losses import BinaryLogisticLoss
+    from flink_ml_tpu.ops.optimizer import SGD, SGDParams
+    from flink_ml_tpu.parallel.mesh import set_default_mesh
+
+    mesh = dist.build_mesh()
+    set_default_mesh(mesh)
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(N_ROWS, N_DIM))
+    y = (x @ rng.normal(size=N_DIM) > 0).astype(np.float64)
+    prm = SGDParams(learning_rate=0.1, global_batch_size=BATCH,
+                    max_iter=MAX_ITER, tol=0.0, reg=0.02,
+                    elastic_net=0.4, method="adam")
+    mgr = elastic.ElasticCheckpointManager(os.environ["ELASTIC_CKPT_DIR"])
+    cfg = IterationConfig(mode="device", checkpoint_interval=CKPT_INTERVAL,
+                          checkpoint_manager=mgr)
+    coeffs, loss = SGD(prm).optimize(
+        BinaryLogisticLoss(), np.zeros(N_DIM), x, y, mesh=mesh,
+        config=cfg, tag="elastic-smoke")
+
+    from flink_ml_tpu.observability import tracing
+
+    tracing.maybe_dump_root_metrics()
+    if jax.process_index() == 0:
+        print(json.dumps({
+            "processCount": jax.process_count(),
+            "attempt": attempt,
+            "loss": float(loss),
+            # full precision: the parity gate is bit-identicality
+            "coeffs": [float(v) for v in np.asarray(coeffs)],
+        }), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# straggler worker: 4 simulated devices, one process
+# ---------------------------------------------------------------------------
+
+def run_straggler() -> int:
+    import numpy as np
+
+    from jax.sharding import PartitionSpec as P
+
+    from flink_ml_tpu.parallel import DATA_AXIS, create_mesh, elastic
+    from flink_ml_tpu.parallel import mapreduce as mr
+
+    n_shards = 4
+    mesh = create_mesh()
+    assert int(np.prod(list(mesh.shape.values()))) == n_shards, mesh
+    parts = (np.arange(n_shards * 3, dtype=np.float64)
+             .reshape(n_shards, 3) + 1.0)
+
+    prog = mr.map_shards(
+        lambda a, inc: mr.renormalized_sum(a[0], inc[0]),
+        mesh, in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=P())
+    plain = mr.map_shards(
+        lambda a: mr.reduce_sum(a[0]), mesh,
+        in_specs=P(DATA_AXIS, None), out_specs=P())
+
+    rp = elastic.RoundParticipation(n_shards, deadline_ms=100.0,
+                                    max_staleness=2)
+    # shard 2 misses the deadline from round 1 on; everyone else is
+    # fast; the final round also stalls EVERY shard (never-drop-all)
+    timings = [
+        [10.0, 12.0, 11.0, 13.0],     # round 1 sees: all fast
+        [10.0, 12.0, 180.0, 13.0],    # round 2 drops shard 2
+        [10.0, 12.0, 185.0, 13.0],    # round 3 drops shard 2 (stale=2)
+        [10.0, 12.0, 190.0, 13.0],    # round 4 MUST readmit shard 2
+        [150.0, 160.0, 170.0, 180.0],  # round 5: all slow -> keep all
+    ]
+    failures = []
+    masks = []
+    for rnd in range(len(timings) + 1):
+        include = rp.decide(rnd)
+        masks.append([int(v) for v in include])
+        got = np.asarray(prog(parts, include))
+        participants = include.sum()
+        expected = (parts * include[:, None]).sum(axis=0) \
+            * n_shards / max(participants, 1.0)
+        if not np.allclose(got, expected, rtol=0, atol=1e-9):
+            failures.append(
+                f"round {rnd}: renormalized {got} != {expected} "
+                f"(include={include})")
+        if participants == n_shards:
+            # full participation must be BIT-IDENTICAL to the plain
+            # reduce — renormalization may not perturb the healthy path
+            ref = np.asarray(plain(parts))
+            if not np.array_equal(got, ref):
+                failures.append(
+                    f"round {rnd}: full-participation sum differs from "
+                    f"reduce_sum: {got} vs {ref}")
+        if rnd < len(timings):
+            rp.observe(timings[rnd])
+
+    expected_masks = [
+        [1, 1, 1, 1],  # round 0: nothing observed yet
+        [1, 1, 1, 1],  # round 1: all fast
+        [1, 1, 0, 1],  # round 2: shard 2 dropped (stale=1)
+        [1, 1, 0, 1],  # round 3: shard 2 dropped (stale=2)
+        [1, 1, 1, 1],  # round 4: force-readmitted at max_staleness
+        [1, 1, 1, 1],  # round 5: all slow -> never drop every shard
+    ]
+    if masks != expected_masks:
+        failures.append(f"participation masks {masks} != "
+                        f"{expected_masks}")
+    out = {"rounds": rp.rounds, "droppedRounds": rp.dropped_rounds,
+           "participationMin": rp.participation_min, "masks": masks,
+           "failures": failures}
+    print(json.dumps(out), flush=True)
+    return 1 if failures else 0
+
+
+# ---------------------------------------------------------------------------
+# parent: the elastic launch + gates (never imports jax)
+# ---------------------------------------------------------------------------
+
+def _parse_worker_json(record: dict) -> dict:
+    for line in reversed(record["stdout"].strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise ValueError(
+        f"process {record['process']} printed no JSON:\n"
+        f"{record['stdout'][-500:]}\n{record['stderr'][-2000:]}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="elastic-smoke")
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--straggler", action="store_true")
+    parser.add_argument("--processes", type=int, default=4)
+    parser.add_argument("--min-processes", type=int, default=2)
+    parser.add_argument("--timeout", type=float, default=600.0)
+    parser.add_argument("--child-grace", type=float, default=20.0)
+    parser.add_argument("--out", default=os.path.join(
+        REPO, "BENCH_multihost.json"))
+    args = parser.parse_args(argv)
+    if args.worker:
+        return run_worker()
+    if args.straggler:
+        return run_straggler()
+
+    import subprocess
+
+    from flink_ml_tpu.parallel import distributed, elastic
+    from flink_ml_tpu.resilience.policy import (RestartsExhausted,
+                                                RetryPolicy)
+
+    tmp = tempfile.mkdtemp(prefix="elastic-smoke-")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    snap_dir = os.path.join(tmp, "snap")
+    record = {"processes": args.processes,
+              "minProcesses": args.min_processes}
+
+    class Snapshotter:
+        """Copies the shared checkpoint dir at the first restart: the
+        parity gate replays a clean world from EXACTLY the boundary the
+        recovery resumed from (the relaunch keeps writing to — and on
+        success clears — the live dir)."""
+
+        def on_restart(self, attempt, error):
+            if not os.path.isdir(snap_dir) and os.path.isdir(ckpt_dir):
+                shutil.copytree(ckpt_dir, snap_dir)
+
+        def on_recovered(self, attempt):
+            pass
+
+    child_env = {
+        "ELASTIC_CKPT_DIR": ckpt_dir,
+        "FLINK_ML_TPU_UPDATE_SHARDING": "1",
+        # detection armed (exercises the watchdog'd boundary fetches);
+        # the scripted kill is actually caught by the launcher's
+        # per-child grace, which is faster than a 60s collective stall
+        elastic.COLLECTIVE_TIMEOUT_ENV: "60",
+        # the chaos schedule: SIGKILL process 1 at the SECOND epoch
+        # boundary (epoch 4) — after the epoch-2 checkpoint, before the
+        # epoch-4 one, so recovery must re-place from epoch 2
+        "FLINK_ML_TPU_CHAOS": "1",
+        "FLINK_ML_TPU_CHAOS_SITES": "worker-loss",
+        "FLINK_ML_TPU_CHAOS_AT": "worker-loss:2",
+        elastic.CHAOS_VICTIM_ENV: "1",
+    }
+    print(f"elastic smoke: {args.processes} processes, kill process 1 "
+          f"at epoch {2 * CKPT_INTERVAL}, floor {args.min_processes}")
+    try:
+        records = elastic.run_elastic(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            num_processes=args.processes,
+            min_processes=args.min_processes,
+            policy=RetryPolicy(max_restarts=3, backoff_s=0.2),
+            listeners=[Snapshotter()],
+            env=child_env, timeout=args.timeout,
+            heartbeat_dir=os.path.join(tmp, "hb"),
+            child_grace_s=args.child_grace)
+    except RestartsExhausted as e:
+        print(f"elastic budget exhausted: {e}")
+        return 2
+
+    recovered = _parse_worker_json(records[0])
+    prov = elastic.provenance()
+    record.update(recovered=dict(recovered, coeffs=None), **prov)
+    print(f"recovered at {recovered['processCount']} processes "
+          f"(attempt {recovered['attempt']}), loss="
+          f"{recovered['loss']:.6f}, provenance={prov}")
+
+    failures = []
+    if recovered["processCount"] != args.processes - 1:
+        failures.append(
+            f"expected recovery at {args.processes - 1} processes, got "
+            f"{recovered['processCount']}")
+    if recovered["attempt"] < 1:
+        failures.append("fit completed on attempt 0 — the kill never "
+                        "fired; nothing was recovered")
+    if prov["elasticEvents"] < 2:
+        failures.append(f"provenance recorded {prov['elasticEvents']} "
+                        f"elastic events, expected loss + relaunch")
+    quarantined = [r["process"] for r in records
+                   if "quarantined" in r["stderr"]]
+    if quarantined:
+        failures.append(
+            f"processes {quarantined} quarantined the checkpoint on "
+            f"restore — the relaunch restarted from scratch instead of "
+            f"re-placing the slices (parity would be vacuous)")
+
+    # -- parity: a clean (N-1)-world resumed from the SAME snapshot ---------
+    if not os.path.isdir(snap_dir):
+        failures.append("no checkpoint snapshot was taken at restart")
+    else:
+        clean_env = {"ELASTIC_CKPT_DIR": snap_dir,
+                     "FLINK_ML_TPU_UPDATE_SHARDING": "1"}
+        clean_records = distributed.launch(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            args.processes - 1, env=clean_env, timeout=args.timeout)
+        bad = [r for r in clean_records if r["returncode"] != 0]
+        if bad:
+            failures.append(
+                f"clean parity run failed rc={bad[0]['returncode']}:\n"
+                f"{bad[0]['stderr'][-2000:]}")
+        else:
+            clean = _parse_worker_json(clean_records[0])
+            if clean["coeffs"] == recovered["coeffs"]:
+                print("parity: recovered params BIT-IDENTICAL to the "
+                      "clean resume")
+                record["parity"] = "bit-identical"
+            else:
+                failures.append(
+                    f"recovered params differ from the clean resume:\n"
+                    f"  recovered: {recovered['coeffs']}\n"
+                    f"  clean:     {clean['coeffs']}")
+
+    # -- straggler rounds ---------------------------------------------------
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = \
+        f"{flags} --xla_force_host_platform_device_count=4".strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--straggler"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        failures.append(f"straggler phase rc={proc.returncode}:\n"
+                        f"{proc.stdout[-1000:]}\n{proc.stderr[-2000:]}")
+    else:
+        straggler = json.loads(proc.stdout.strip().splitlines()[-1])
+        print(f"straggler rounds: {straggler['droppedRounds']} of "
+              f"{straggler['rounds']} dropped a shard, participationMin="
+              f"{straggler['participationMin']}")
+        record["straggler"] = {k: straggler[k] for k in
+                               ("rounds", "droppedRounds",
+                                "participationMin")}
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    if failures:
+        for f in failures:
+            print(f"ELASTIC REGRESSION: {f}")
+        return 3
+
+    # -- the elastic_sweep record -------------------------------------------
+    try:
+        with open(args.out) as f:
+            bench = json.load(f)
+    except (OSError, ValueError):
+        bench = {}
+    bench["elastic_sweep"] = record
+    with open(args.out, "w") as f:
+        json.dump(bench, f, indent=1, sort_keys=False)
+        f.write("\n")
+    print(f"elastic smoke passed; elastic_sweep -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

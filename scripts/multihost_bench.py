@@ -176,6 +176,11 @@ def run_worker(smoke: bool) -> int:
         "fitMs": round(fit_ms, 3),
         "result": summarize(model.coefficients)}
 
+    from flink_ml_tpu.parallel import elastic
+
+    # elastic provenance beside processCount (ISSUE 17): 0 events /
+    # 1.0 participation on a healthy cell — the row says so explicitly
+    out.update(elastic.provenance())
     out["levelPayloadBytes"] = _level_bytes()
     out["donationWarnings"] = len(donation_warnings)
     out["donationWarningSamples"] = donation_warnings[:3]

@@ -5,7 +5,12 @@ Arms the fault harness (honoring FLINK_ML_TPU_CHAOS_* when already set —
 how CI's chaos job drives it — else the --seed/--rate flags), then runs
 supervised fits whose recovery paths span the whole resilience stack:
 host-loop epoch faults, checkpoint save/publish faults with restore
-fallback, and a host-pool worker wedge killed by the per-child deadline.
+fallback, a host-pool worker wedge killed by the per-child deadline, and
+an elastic worker-loss leg — the ``worker-loss`` chaos site SIGKILLs a
+launched child mid-run and ``parallel.elastic.run_elastic`` must name
+the victim, shrink the world, and complete on the survivors. (The
+``worker-loss``/``worker-hang`` sites are multi-process-gated: armed
+here, they stay inert in the single-process fits above.)
 
 Exit codes mirror the sweep precedent (run_benchmark_sweep.py):
 0 = recovered and results identical; 2 = restart budget exhausted
@@ -38,6 +43,8 @@ def main(argv=None) -> int:
 
     import numpy as np
 
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
     from flink_ml_tpu.common.hostpool import map_row_shards
     from flink_ml_tpu.iteration.checkpoint import CheckpointManager
     from flink_ml_tpu.iteration.iteration import (IterationConfig,
@@ -54,7 +61,8 @@ def main(argv=None) -> int:
         plan_ctx = faults.chaos(
             seed=args.seed, rate=args.rate,
             sites=["epoch-boundary", "checkpoint-save",
-                   "checkpoint-publish", "hostpool-hang"])
+                   "checkpoint-publish", "hostpool-hang",
+                   "worker-loss", "worker-hang"])
         print(f"chaos: programmatic (seed={args.seed}, rate={args.rate})")
 
     # a pure-host GD iteration: exercises the host loop, checkpointing
@@ -102,6 +110,49 @@ def main(argv=None) -> int:
             failures.append(f"hostpool sum {sum(parts)} != {expected_sum}")
         else:
             print("supervised host-pool map: identical")
+        run_elastic_leg()
+
+    def run_elastic_leg():
+        """Worker-loss recovery: the chaos site kills a launched child
+        at its 3rd epoch boundary; the elastic driver must name it,
+        shrink the world by one and complete on the survivor. Children
+        are a bare on_boundary loop (no distributed init needed — the
+        site reads the launcher's env mapping), so the leg stays
+        subprocess-cheap like the host-pool one."""
+        from flink_ml_tpu.parallel import elastic
+
+        child = (
+            "import os, sys\n"
+            f"sys.path.insert(0, {repr(repo)})\n"
+            "from flink_ml_tpu.parallel import elastic\n"
+            "if int(os.environ.get(elastic.ATTEMPT_ENV, '0')) > 0:\n"
+            "    os.environ.pop('FLINK_ML_TPU_CHAOS', None)\n"
+            "for epoch in range(1, 7):\n"
+            "    elastic.on_boundary(epoch)\n"
+        )
+        # the leg owns its chaos env (child env overrides the ambient
+        # plan): deterministic kill, victim 1, 3rd boundary
+        child_env = {"FLINK_ML_TPU_CHAOS": "1",
+                     "FLINK_ML_TPU_CHAOS_SITES": "worker-loss",
+                     "FLINK_ML_TPU_CHAOS_AT": "worker-loss:3",
+                     elastic.CHAOS_VICTIM_ENV: "1"}
+        elastic.reset_stats()
+        with faults.suppressed():  # the parent-side driver runs clean
+            # run_elastic supervises its own attempts (WorkerLost is
+            # retryable inside; budget exhaustion surfaces as
+            # RestartsExhausted -> this smoke's exit code 2)
+            records = elastic.run_elastic(
+                [sys.executable, "-c", child], num_processes=2,
+                min_processes=1, env=child_env, timeout=120.0,
+                policy=policy, child_grace_s=10.0)
+        prov = elastic.provenance()
+        if len(records) == 1 and prov["elasticEvents"] >= 2:
+            print(f"supervised elastic worker-loss: recovered at world "
+                  f"size 1 ({prov['elasticEvents']} elastic events)")
+        else:
+            failures.append(
+                f"elastic leg: {len(records)} record(s), provenance "
+                f"{prov} — expected a loss + relaunch down to 1")
 
     try:
         if plan_ctx is None:
