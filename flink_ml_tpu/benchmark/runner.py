@@ -225,7 +225,8 @@ def _mesh_provenance() -> dict:
                 "processCount": process_count(),
                 "processIndex": process_index(),
                 **update_sharding.provenance(),
-                **_serving_provenance()}
+                **_serving_provenance(),
+                **_fleet_provenance()}
     except Exception:  # noqa: BLE001 — provenance only
         return {}
 
@@ -248,6 +249,20 @@ def _serving_provenance() -> dict:
     except Exception:  # noqa: BLE001 — provenance only
         pass
     return {"shardedDispatch": sharded, "pipelineDepth": depth}
+
+
+def _fleet_provenance() -> dict:
+    """``fleetMembers`` + ``fleetP99Ms`` from the live fleet telemetry
+    plane (observability/fleet.py) when a fleet dir resolves and holds
+    beacons — null on single-process / disarmed benches: a solo row
+    honestly says no fleet measured it. Never fails a finished
+    measurement."""
+    try:
+        from flink_ml_tpu.observability import fleet
+
+        return fleet.provenance()
+    except Exception:  # noqa: BLE001 — provenance only
+        return {"fleetMembers": None, "fleetP99Ms": None}
 
 
 def _table_bytes(table) -> int:

@@ -529,6 +529,13 @@ class MetricGroup:
         with self._lock:
             return list(self._windowed_counters.items())
 
+    def histogram_items(self):
+        """``(key, Histogram)`` pairs registered on this group —
+        includes :class:`WindowedHistogram` instances. The fleet beacon
+        writer's enumeration seam (observability/fleet.py)."""
+        with self._lock:
+            return list(self._histograms.items())
+
     def get_gauge(self, name: str,
                   labels: Optional[Dict[str, str]] = None):
         with self._lock:
@@ -610,6 +617,13 @@ class MetricsRegistry:
         with self._lock:
             groups = list(self._groups.items())
         return {name: g.snapshot() for name, g in groups}
+
+    def group_items(self):
+        """``(name, MetricGroup)`` pairs currently registered — the
+        enumeration seam for live readers that need the group objects
+        (windowed views), not just :meth:`snapshot` data."""
+        with self._lock:
+            return list(self._groups.items())
 
     def merge(self, snapshot: Dict[str, dict]) -> None:
         """Fold another registry's :meth:`snapshot` into this one — how

@@ -20,6 +20,7 @@ rerunning anything:
     flink-ml-tpu-trace path TRACE_DIR --check --budget 50  # critical path
     flink-ml-tpu-trace incident TRACE_DIR --check  # flight recorder (exit 4)
     flink-ml-tpu-trace locks TRACE_DIR --check   # lock watchdog (exit 4)
+    flink-ml-tpu-trace fleet DIR --check         # fleet membership (exit 4)
     flink-ml-tpu-trace ROOT --latest             # newest trace dir under ROOT
 
 Sections: top spans by self-time (time in a span minus its children —
@@ -71,7 +72,13 @@ watchdog's ``locks-*.json`` dumps (``FLINK_ML_TPU_LOCKCHECK``-armed
 runs, common/locks.py) — per-lock hold stats, the acquisition-order
 graph, detected cycles (including cycles visible only across processes)
 — and with ``--check`` exits 4 on any cycle or long hold, 2 when the
-dir holds no lock telemetry at all. Every
+dir holds no lock telemetry at all. The ``fleet`` subcommand
+(observability/fleet.py) merges the live ``fleet-*.json`` beacons every
+process of a multi-process runtime writes — membership with
+alive/stale/dead classification by beacon age, bin-exact fleet-level
+windowed quantiles, per-replica load rows — and with ``--check`` exits
+4 on a dead member or a violated fleet-scope SLO, 2 when the dir holds
+no fleet telemetry at all. Every
 subcommand accepts ``--latest``:
 treat the positional dir as a root and resolve the newest trace dir
 under it (exporters.resolve_trace_dir) — no more hand-globbing.
@@ -290,6 +297,12 @@ def main(argv=None) -> int:
         )
 
         return locks_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # live fleet membership + aggregates (observability/fleet.py);
+        # same dispatch rule — use ./fleet to summarize such a directory
+        from flink_ml_tpu.observability.fleet import main as fleet_main
+
+        return fleet_main(argv[1:])
     if argv and argv[0] == "summary":
         # explicit subcommand spelling for the default view, so
         # unattended consumers can write `summary --json` without

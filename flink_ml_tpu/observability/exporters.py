@@ -239,9 +239,16 @@ def dump_metrics(trace_dir: str,
 
         locks.mirror_metrics()
     path = os.path.join(trace_dir, f"metrics-{artifact_suffix()}.json")
+    snap = registry.snapshot()
+    proc = _process_labels()
+    if proc is not None:
+        # multi-process runs share one trace dir: label every series
+        # with its member so the artifact merge keeps them distinct
+        # (the Prometheus-collision fix, see relabel_snapshot)
+        snap = relabel_snapshot(snap, proc)
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(registry.snapshot(), f, default=str)
+        json.dump(snap, f, default=str)
     os.replace(tmp, path)
     drift_mod = sys.modules.get("flink_ml_tpu.observability.drift")
     if drift_mod is not None:
@@ -270,6 +277,49 @@ def read_metrics(trace_dir: str) -> Dict[str, dict]:
         except (OSError, json.JSONDecodeError, ValueError):
             continue  # a torn snapshot must not sink the readable ones
     return merged.snapshot()
+
+
+# -- multi-process series disambiguation --------------------------------------
+def _relabel_key(key: str, extra: Dict[str, str]) -> str:
+    """Fold ``extra`` labels into a rendered series key; labels the key
+    already carries win (a series explicitly attributed stays as
+    written)."""
+    from flink_ml_tpu.common.metrics import metric_key
+    from flink_ml_tpu.observability.health import _parse_labels
+
+    name, rest = _split_labels(key)
+    got = _parse_labels(rest)
+    for k, v in extra.items():
+        got.setdefault(k, v)
+    return metric_key(name, got)
+
+
+def relabel_snapshot(snapshot: Dict[str, dict],
+                     extra: Dict[str, str]) -> Dict[str, dict]:
+    """A copy of a registry snapshot with ``extra`` labels folded into
+    every series key. The multi-process collision fix: two replicas
+    both recording ``transformMs{servable="lr"}`` would otherwise dump
+    and expose IDENTICAL series names — a scraper silently
+    last-writes-wins, and the artifact merge sums them with no way to
+    tell members apart. A ``process="p<k>"`` label keeps every member's
+    series distinct while the slo/diff readers' label-subset matching
+    still aggregates across them."""
+    out: Dict[str, dict] = {}
+    for group, gsnap in snapshot.items():
+        gout = dict(gsnap)
+        for section in ("gauges", "counters", "histograms"):
+            entries = gsnap.get(section)
+            if isinstance(entries, dict):
+                gout[section] = {_relabel_key(k, extra): v
+                                 for k, v in entries.items()}
+        out[group] = gout
+    return out
+
+
+def _process_labels() -> Optional[Dict[str, str]]:
+    """``{"process": "p<k>"}`` in a multi-process runtime, else None."""
+    k = safe_process_label()
+    return {"process": f"p{k}"} if k is not None else None
 
 
 # -- Prometheus text exposition ----------------------------------------------
@@ -318,9 +368,15 @@ def _series_by_name(entries: Dict[str, object]):
 def prometheus_text(snapshot: Optional[Dict[str, dict]] = None) -> str:
     """Render a registry snapshot (default: the live process registry) in
     the Prometheus text exposition format, histograms as cumulative
-    ``_bucket{le=...}`` series plus ``_sum``/``_count``."""
+    ``_bucket{le=...}`` series plus ``_sum``/``_count``. In a
+    multi-process runtime every series gains a ``process="p<k>"`` label
+    (see :func:`relabel_snapshot` — two scraped replicas must never
+    emit identical series names)."""
     if snapshot is None:
         snapshot = metrics.snapshot()
+    proc = _process_labels()
+    if proc is not None:
+        snapshot = relabel_snapshot(snapshot, proc)
     lines: List[str] = []
     for group in sorted(snapshot):
         gsnap = snapshot[group]

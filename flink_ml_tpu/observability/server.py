@@ -50,6 +50,11 @@ route             serves                                      response with no d
                   arming the endpoint flips
                   ``tracer.keep_recent`` so request-scoped
                   spans exist even without a trace dir)
+``/fleet``        the live fleet report                        200 ``{"fleet": null}`` —
+                  (observability/fleet.py): membership with    no fleet dir resolves, or
+                  alive/stale/dead classification, bin-exact   no member wrote a beacon
+                  windowed fleet quantiles folded across       yet
+                  member beacons, per-replica load rows
 ================  ==========================================  =============================
 
 Any other path: 404 JSON naming the known routes.
@@ -114,6 +119,9 @@ ROUTE_TABLE = {
                    'recorder (observability/flightrecorder.py) has '
                    'dumped no bundle, or no trace dir is armed'),
     "/spans/recent": ("_route_spans_recent", '200 {"spans": []}'),
+    "/fleet": ("_route_fleet",
+               '200 {"fleet": null} — no fleet dir resolves '
+               '(observability/fleet.py) or no beacons written yet'),
 }
 
 ROUTES = tuple(ROUTE_TABLE)
@@ -321,6 +329,22 @@ class _Handler(BaseHTTPRequestHandler):
                 continue
         self._send(200, json.dumps({"spans": spans},
                                    default=str), _JSON_CTYPE)
+
+    def _route_fleet(self) -> None:
+        from flink_ml_tpu.observability import fleet
+        from flink_ml_tpu.observability.health import _json_safe
+
+        base = fleet.fleet_dir()
+        resolved = fleet.find_fleet_dir(base) if base else None
+        if resolved is None:
+            self._send(200, json.dumps({"fleet": None,
+                                        "fleetDir": base}),
+                       _JSON_CTYPE)
+            return
+        view = fleet.FleetView(resolved)
+        self._send(200, json.dumps(
+            _json_safe({"fleet": view.report()}), default=str),
+            _JSON_CTYPE)
 
     def do_GET(self):  # noqa: N802 — http.server's casing
         path = self.path.split("?", 1)[0]

@@ -33,7 +33,15 @@ the format — or fall back to :func:`default_slos`. Evaluation sources:
 - **artifacts** (``flink-ml-tpu-trace slo <dir>``): the merged
   ``metrics-*.json`` snapshots are cumulative, so every objective
   evaluates the run-total distribution and is tagged
-  ``source: "cumulative"`` — the windowed half needs the live endpoint.
+  ``source: "cumulative"`` — the windowed half needs the live endpoint;
+- **fleet** (``scope: fleet`` on the SLO): windowed bucket slices from
+  the live fleet beacons (observability/fleet.py) are summed bin-exactly
+  across *alive* members BEFORE quantiles/burn rates, tagged
+  ``source: "fleet[<n>]:<w>s"``; the verdict carries ``members`` /
+  ``membersAlive`` / ``membersMissing`` (+ a ``perMember`` quantile
+  table for latency kinds) and FAILS outright while any member is dead
+  — a half-dead fleet must not report a healthy p99 from survivors
+  alone.
 
 CLI: ``flink-ml-tpu-trace slo <dir> [--spec F] [--check] [--json]
 [--latest]`` — with ``--check`` exits :data:`EXIT_VIOLATION` (4) on any
@@ -119,12 +127,17 @@ class SLO:
     burn_windows: Tuple[Tuple[float, float], ...] = DEFAULT_BURN_WINDOWS
     stat: str = "psi"                # drift statistic: psi | js | ks
     max_drift: float = 0.2           # drift gauge bound
+    scope: str = "process"           # "process" | "fleet"
 
     def __post_init__(self):
         if self.kind not in _KINDS:
             raise ValueError(
                 f"SLO {self.name!r}: unknown kind {self.kind!r} "
                 f"(expected one of {_KINDS})")
+        if self.scope not in ("process", "fleet"):
+            raise ValueError(
+                f"SLO {self.name!r}: unknown scope {self.scope!r} "
+                f"(expected 'process' or 'fleet')")
         if not 0.0 < float(self.quantile) < 1.0:
             raise ValueError(
                 f"SLO {self.name!r}: quantile must be in (0, 1)")
@@ -351,6 +364,64 @@ class _SnapshotSource:
         return out
 
 
+class _FleetSource:
+    """``scope: fleet`` evaluation: windowed bucket slices summed
+    bin-exactly across the fleet's *alive* members
+    (observability/fleet.py :class:`~FleetView`) BEFORE any quantile or
+    burn rate — a half-dead fleet must not report a healthy p99 from
+    survivors alone, so the members that did NOT contribute surface as
+    ``membersMissing`` on the verdict (and a dead member fails it)."""
+
+    def __init__(self, view):
+        self.view = view
+
+    def hist_window(self, group, name, labels, window_s):
+        return self.view.hist_window(group, name, labels, window_s)
+
+    def counter_window(self, group, name, labels, window_s):
+        return self.view.counter_window(group, name, labels, window_s)
+
+    def gauge_values(self, group, name, labels):
+        return self.view.gauge_values(group, name, labels)
+
+
+class _EmptyFleetSource:
+    """A fleet-scope SLO with no fleet telemetry resolvable: every read
+    answers 'no data' tagged ``fleet-missing`` — absence of a fleet
+    plane is visible on the verdict, never a crash."""
+
+    view = None
+
+    def hist_window(self, group, name, labels, window_s):
+        return None, "fleet-missing"
+
+    def counter_window(self, group, name, labels, window_s):
+        return 0, "fleet-missing"
+
+    def gauge_values(self, group, name, labels):
+        return []
+
+
+def _make_fleet_source(fleet_view=None, fleet_dir: Optional[str] = None):
+    """The ``scope: fleet`` source: an explicit view, a directory, or
+    this process's own fleet-dir resolution (the ``/slo`` route path)."""
+    if fleet_view is not None:
+        return _FleetSource(fleet_view)
+    from flink_ml_tpu.observability import fleet
+
+    base = fleet_dir
+    if base is not None:
+        base = fleet.find_fleet_dir(base) or base
+    else:
+        base = fleet.fleet_dir()
+    if not base:
+        return _EmptyFleetSource()
+    view = fleet.FleetView(base)
+    if not view.members:
+        return _EmptyFleetSource()
+    return _FleetSource(view)
+
+
 # -- evaluation ---------------------------------------------------------------
 
 def _eval_latency(slo: SLO, source) -> List[dict]:
@@ -394,8 +465,12 @@ def _eval_error_rate(slo: SLO, source) -> List[dict]:
                                             slo.labels, window_s)
         requests = int(errors) + int(total)
         ratio = (errors / requests) if requests else 0.0
-        src = ("windowed" if {esrc, tsrc} <= {"windowed", "none"}
-               else "cumulative")
+        if esrc.startswith("fleet") or tsrc.startswith("fleet"):
+            # fleet-scope reads keep their member-count attribution
+            src = tsrc if tsrc.startswith("fleet") else esrc
+        else:
+            src = ("windowed" if {esrc, tsrc} <= {"windowed", "none"}
+                   else "cumulative")
         if max_burn is None:  # the primary objective
             objectives.append({
                 "objective": "error-ratio", "window_s": window_s,
@@ -443,13 +518,21 @@ def _eval_drift(slo: SLO, source) -> List[dict]:
 
 def evaluate_slos(slos: Optional[Sequence[SLO]] = None, registry=None,
                   snapshot: Optional[Dict[str, dict]] = None,
-                  emit: bool = False) -> List[dict]:
+                  emit: bool = False, fleet_view=None,
+                  fleet_dir: Optional[str] = None) -> List[dict]:
     """Evaluate ``slos`` (default: :func:`active_slos`) against either a
     live ``registry`` (default: the process registry — sliding windows)
-    or an artifact ``snapshot`` (cumulative). With ``emit``, every
-    violated SLO lands an ``ml.slo`` trace event plus a
-    ``slo_violations{slo=...}`` counter in the ``ml.slo`` group of the
-    process registry. Returns one verdict dict per SLO."""
+    or an artifact ``snapshot`` (cumulative). SLOs declaring
+    ``scope: fleet`` instead read live fleet beacons — an explicit
+    ``fleet_view`` (:class:`~flink_ml_tpu.observability.fleet.FleetView`),
+    a ``fleet_dir``, or this process's own fleet-dir resolution — and
+    their verdicts carry fleet bookkeeping: ``members`` /
+    ``membersAlive`` / ``membersMissing`` plus a ``perMember`` quantile
+    table, and FAIL whenever a member is dead even if the survivors'
+    aggregate meets the objective. With ``emit``, every violated SLO
+    lands an ``ml.slo`` trace event plus a ``slo_violations{slo=...}``
+    counter in the ``ml.slo`` group of the process registry. Returns
+    one verdict dict per SLO."""
     if slos is None:
         slos = active_slos()
     if snapshot is not None:
@@ -457,17 +540,51 @@ def evaluate_slos(slos: Optional[Sequence[SLO]] = None, registry=None,
     else:
         source = _RegistrySource(metrics if registry is None
                                  else registry)
+    fleet_source = None
     verdicts = []
     for slo in slos:
+        src = source
+        if slo.scope == "fleet":
+            if fleet_source is None:
+                fleet_source = _make_fleet_source(fleet_view, fleet_dir)
+            src = fleet_source
         if slo.kind == "latency":
-            objectives = _eval_latency(slo, source)
+            objectives = _eval_latency(slo, src)
         elif slo.kind == "drift":
-            objectives = _eval_drift(slo, source)
+            objectives = _eval_drift(slo, src)
         else:
-            objectives = _eval_error_rate(slo, source)
+            objectives = _eval_error_rate(slo, src)
         ok = all(o["ok"] for o in objectives)
-        verdicts.append({"slo": slo.name, "kind": slo.kind, "ok": ok,
-                         "objectives": objectives})
+        verdict = {"slo": slo.name, "kind": slo.kind, "ok": ok,
+                   "objectives": objectives}
+        if slo.scope == "fleet":
+            verdict["scope"] = "fleet"
+            view = getattr(src, "view", None)
+            if view is None:
+                verdict.update(members=0, membersAlive=0,
+                               membersMissing=[], fleet="missing")
+            else:
+                membership = view.membership()
+                missing = view.members_missing()
+                dead = [row["member"] for row in membership
+                        if row["state"] == "dead"]
+                verdict.update(
+                    members=len(membership),
+                    membersAlive=sum(1 for row in membership
+                                     if row["state"] == "alive"),
+                    membersMissing=missing)
+                if slo.kind == "latency":
+                    verdict["perMember"] = {
+                        m: round(q, 3) for m, q in
+                        view.per_member_quantile(
+                            slo.group, slo.histogram, slo.labels,
+                            slo.window_s, slo.quantile).items()}
+                if dead:
+                    # survivors meeting the bound is NOT a healthy
+                    # fleet: a dead member fails the verdict outright
+                    verdict["ok"] = ok = False
+                    verdict["membersDead"] = dead
+        verdicts.append(verdict)
         if emit and not ok:
             failing = [o["objective"] for o in objectives
                        if not o["ok"]]
@@ -501,6 +618,23 @@ def render_verdicts(verdicts: List[dict]) -> str:
         out.append("")
         out.append(f"SLO {v['slo']} ({v['kind']})  "
                    f"[{'ok' if v['ok'] else 'VIOLATED'}]")
+        if v.get("scope") == "fleet":
+            if v.get("fleet") == "missing":
+                out.append("  fleet: no telemetry (no beacons resolve)")
+            else:
+                missing = v.get("membersMissing") or []
+                dead = v.get("membersDead") or []
+                line = (f"  fleet: {v.get('membersAlive', 0)}/"
+                        f"{v.get('members', 0)} member(s) alive")
+                if missing:
+                    line += f", missing: {', '.join(missing)}"
+                if dead:
+                    line += f", DEAD: {', '.join(dead)}"
+                out.append(line)
+                per = v.get("perMember") or {}
+                if per:
+                    out.append("  per-member: " + "  ".join(
+                        f"{m}={q:g}ms" for m, q in sorted(per.items())))
         for o in v["objectives"]:
             if o["objective"] == "drift-stat":
                 val = "-" if o["value"] is None else f"{o['value']:g}"
@@ -566,6 +700,10 @@ def main(argv=None) -> int:
     parser.add_argument("--latest", action="store_true",
                         help="treat TRACE_DIR as a root and pick the "
                              "newest trace dir under it")
+    parser.add_argument("--fleet", metavar="DIR", default=None,
+                        help="fleet beacon dir for 'scope: fleet' "
+                             "SLOs (default: TRACE_DIR's fleet/ "
+                             "subdir)")
     args = parser.parse_args(argv)
 
     try:
@@ -575,13 +713,21 @@ def main(argv=None) -> int:
         print(f"flink-ml-tpu-trace slo: cannot read {args.trace_dir}: "
               f"{e}", file=sys.stderr)
         return EXIT_INVALID
-    if not snapshot:
+    try:
+        slos = load_specs(args.spec) if args.spec else default_slos()
+    except (OSError, ValueError) as e:
+        print(f"flink-ml-tpu-trace slo: {e}", file=sys.stderr)
+        return EXIT_INVALID
+    if not snapshot and not any(s.scope == "fleet" for s in slos):
+        # a fleet-scope spec evaluates from beacons, not metrics
+        # artifacts — only the artifact path needs them
         print(f"flink-ml-tpu-trace slo: no metrics-*.json artifacts in "
               f"{trace_dir}", file=sys.stderr)
         return EXIT_INVALID
     try:
-        slos = load_specs(args.spec) if args.spec else default_slos()
-        verdicts = evaluate_slos(slos, snapshot=snapshot)
+        verdicts = evaluate_slos(
+            slos, snapshot=snapshot,
+            fleet_dir=args.fleet if args.fleet else trace_dir)
     except (OSError, ValueError) as e:
         print(f"flink-ml-tpu-trace slo: {e}", file=sys.stderr)
         return EXIT_INVALID

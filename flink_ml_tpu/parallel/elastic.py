@@ -54,7 +54,6 @@ in the ``mltrace summary`` timeline). :func:`provenance` feeds
 from __future__ import annotations
 
 import functools
-import json
 import os
 import signal
 import threading
@@ -149,59 +148,49 @@ def round_deadline_ms() -> Optional[float]:
 
 
 # -- heartbeats ---------------------------------------------------------------
+# ONE liveness mechanism: a "heartbeat" IS a fleet beacon
+# (observability/fleet.py) written into the heartbeat dir — the elastic
+# watchdog and ``mltrace fleet`` read the same stamp, so they can never
+# disagree about who is dead. The beacon carries role/epoch/windowed
+# metric slices on top of the liveness stamp for free.
 
 def _hb_dir() -> Optional[str]:
     return os.environ.get(HEARTBEAT_DIR_ENV) or None
 
 
-def _hb_path(base: str, index: int) -> str:
-    return os.path.join(base, f"hb-{index}")
-
-
 def beat(epoch: Optional[int] = None) -> None:
-    """Write this process's heartbeat file (atomic replace, so a reader
-    never sees a torn beat). No-op without ``FLINK_ML_TPU_HEARTBEAT_DIR``
-    — the launcher/driver opts a fit in."""
+    """Write this process's liveness stamp — a fleet beacon (atomic
+    replace, so a reader never sees a torn beat). No-op without
+    ``FLINK_ML_TPU_HEARTBEAT_DIR`` — the launcher/driver opts a fit
+    in. Never raises: an unwritable heartbeat dir must not kill the
+    fit (the fleet writer swallows write failures)."""
     base = _hb_dir()
     if not base:
         return
-    from flink_ml_tpu.parallel import distributed
-
     try:
-        os.makedirs(base, exist_ok=True)
-        path = _hb_path(base, distributed.process_index())
-        tmp = f"{path}.tmp-{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump({"epoch": epoch, "time": time.time()}, f)
-        os.replace(tmp, path)
-    except OSError:
-        pass  # an unwritable heartbeat dir must not kill the fit
+        from flink_ml_tpu.observability import fleet
+
+        fleet.write_beacon(base, role="trainer", epoch=epoch)
+    except Exception:
+        pass  # liveness reporting must never sink the fit it reports on
 
 
 def stale_processes(timeout_s: float,
                     num_processes: Optional[int] = None) -> List[int]:
-    """Process indices whose heartbeat is missing or older than
+    """Process indices whose beacon stamp is missing or older than
     ``timeout_s`` — the detection side's evidence for WHO died. Empty
     when no heartbeat dir is configured (the caller then reports an
     unidentified loss)."""
     base = _hb_dir()
     if not base:
         return []
+    from flink_ml_tpu.observability import fleet
     from flink_ml_tpu.parallel import distributed
 
     n = num_processes if num_processes is not None \
         else distributed.process_count()
-    now = time.time()
-    stale = []
-    for k in range(int(n)):
-        try:
-            mtime = os.path.getmtime(_hb_path(base, k))
-        except OSError:
-            stale.append(k)
-            continue
-        if now - mtime > timeout_s:
-            stale.append(k)
-    return stale
+    return fleet.stale_member_indices(base, timeout_s,
+                                      num_processes=int(n))
 
 
 # -- detection ----------------------------------------------------------------

@@ -331,6 +331,7 @@ class MicroBatcher:
         self._req_counter = itertools.count()
         self._served_requests = 0
         self._prev_status = None
+        self._fleet_token = None
         # pad-template cache, keyed by (schema, type key, bucket): the
         # duplicated-row values each tick's padding appends, extracted
         # once instead of re-copied from the tail request every tick
@@ -366,6 +367,16 @@ class MicroBatcher:
 
         self._prev_status = server.get_serving_status()
         server.set_serving_status(self.status)
+        # join the fleet telemetry plane while serving: periodic
+        # beacons carry this replica's windowed queueMs/batchMs slices
+        # and load row (observability/fleet.py; no-op when no fleet
+        # dir resolves)
+        try:
+            from flink_ml_tpu.observability import fleet
+
+            self._fleet_token = fleet.start_beacon(role="serving")
+        except Exception:
+            self._fleet_token = None
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -398,6 +409,13 @@ class MicroBatcher:
         # it when we started
         server.clear_serving_status(self.status, self._prev_status)
         self._prev_status = None
+        try:
+            from flink_ml_tpu.observability import fleet
+
+            fleet.stop_beacon(getattr(self, "_fleet_token", None))
+            self._fleet_token = None
+        except Exception:
+            pass
 
     def __enter__(self) -> "MicroBatcher":
         return self.start()

@@ -284,6 +284,15 @@ class OpsController:
             target=self._run, name="flink-ml-tpu-ops-controller",
             daemon=True)
         self._thread.start()
+        # join the fleet telemetry plane: the controller's beacon
+        # carries its recent ml.controller events and gauges
+        # (observability/fleet.py; no-op when no fleet dir resolves)
+        try:
+            from flink_ml_tpu.observability import fleet
+
+            self._fleet_token = fleet.start_beacon(role="controller")
+        except Exception:
+            self._fleet_token = None
         return self
 
     def stop(self) -> None:
@@ -295,6 +304,13 @@ class OpsController:
             self._stop.set()
             thread.join(timeout=30.0)
             self._thread = None
+        try:
+            from flink_ml_tpu.observability import fleet
+
+            fleet.stop_beacon(getattr(self, "_fleet_token", None))
+            self._fleet_token = None
+        except Exception:
+            pass
         from flink_ml_tpu.observability import server
 
         server.clear_controller_status(self.status)

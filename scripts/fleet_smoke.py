@@ -1,0 +1,475 @@
+#!/usr/bin/env python
+"""CI smoke: the fleet telemetry plane end-to-end
+(docs/observability.md "Fleet telemetry").
+
+A 3-member fleet — one training worker + two serving replicas — is
+launched through the multi-process launcher (parallel/distributed.py),
+every member beaconing into one shared fleet dir at 0.5 s: the trainer
+through the elastic heartbeat seam (``elastic.beat`` IS a fleet beacon
+— the unification this gates), the replicas through the micro-batcher
+lifecycle (``MicroBatcher.start`` arms the periodic writer). Loadgen
+traffic drives the replicas while the parent gates, in order:
+
+1. **Membership** — ``mltrace fleet`` reports 3 alive members with the
+   expected roles, and the elastic watchdog view
+   (``stale_member_indices``) agrees nobody is stale: one liveness
+   mechanism, two readers, same answer.
+2. **Bin-exact aggregation** — the fleet dir is snapshotted and the
+   CLI's fleet p99 over the frozen beacons must EXACTLY equal a
+   hand-rolled bucket-level merge of the same files
+   (``fold_snapshots`` + ``histogram_quantile`` — no sampling, no
+   approximation).
+3. **Death detection** — replica p2 is SIGKILLed; ``mltrace fleet
+   --check`` must flip to exit 4 within 2 missed beacon intervals
+   (+ scheduling slack), and a ``scope: fleet`` SLO over the half-dead
+   fleet must fail with ``membersMissing``/``membersDead`` naming the
+   victim even though every latency objective over the survivors
+   passes.
+4. **Recovery** — p2 is relaunched (same member key, newest beacon
+   wins) and ``--check`` must settle back to exit 0.
+
+The record lands in ``BENCH_multihost.json`` under ``fleet_sweep``.
+The parent never imports jax (the fleet reader stack is artifact-only
+by design); members import it in their own processes.
+
+Exit codes: 0 all gates passed; 1 a gate failed; 2 environment broken
+(fleet never formed).
+"""
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # run from a checkout without installing
+
+BEACON_S = 0.5
+#: membership reads: stale past 2 intervals, dead past 4
+STALE_S = 2 * BEACON_S
+#: kill detection reads: dead past 2 missed intervals
+KILL_STALE_S = BEACON_S
+ROWS = 8
+
+
+# ---------------------------------------------------------------------------
+# members (import jax; the parent never does)
+# ---------------------------------------------------------------------------
+
+def _member_deadline() -> float:
+    return float(os.environ.get("FLEET_SMOKE_DEADLINE_S", "180"))
+
+
+def _stopped() -> bool:
+    return os.path.exists(os.environ["FLEET_SMOKE_STOP"])
+
+
+def run_trainer() -> int:
+    """Member p0: an epoch loop whose ONLY liveness signal is the
+    elastic heartbeat — which must surface as a fleet beacon."""
+    from flink_ml_tpu.observability import fleet
+    from flink_ml_tpu.parallel import elastic
+
+    base = os.environ[elastic.HEARTBEAT_DIR_ENV]
+    deadline = time.time() + _member_deadline()
+    epoch, unified = 0, None
+    while time.time() < deadline and not _stopped():
+        elastic.beat(epoch)
+        epoch += 1
+        if unified is None:
+            beacons, _ = fleet.read_beacons(base)
+            fresh = [b for b in beacons
+                     if time.time() - b["time"] < 30.0]
+            if len(fresh) >= 3:
+                # the watchdog view over the SAME beacon stamps: with
+                # the whole fleet beaconing, nobody may read as stale
+                unified = elastic.stale_processes(30.0, num_processes=3)
+        time.sleep(BEACON_S / 2)
+    print(json.dumps({"role": "trainer", "epochs": epoch,
+                      "unifiedStale": unified}), flush=True)
+    return 0
+
+
+def run_replica(idx: int) -> int:
+    """Members p1/p2: a micro-batched LR servable under loadgen; the
+    batcher lifecycle owns the beacon."""
+    import numpy as np
+
+    from flink_ml_tpu.linalg.vectors import DenseVector
+    from flink_ml_tpu.servable.api import DataFrame, DataTypes, Row
+    from flink_ml_tpu.servable.lr import (
+        LogisticRegressionModelData,
+        LogisticRegressionModelServable,
+    )
+    from flink_ml_tpu.serving import LoadGenConfig, run_loadgen
+    from flink_ml_tpu.serving.batcher import BatcherConfig, MicroBatcher
+
+    servable = LogisticRegressionModelServable().set_model_data(
+        LogisticRegressionModelData(
+            np.array([0.5, -0.25, 0.1])).encode())
+    batcher = MicroBatcher(servable, BatcherConfig(
+        buckets=(ROWS, 4 * ROWS), window_ms=1.0)).start()
+
+    seed = [idx * 1_000_000]
+
+    def frame() -> DataFrame:
+        seed[0] += 1
+        rng = np.random.default_rng(seed[0])
+        return DataFrame(
+            ["features"], [DataTypes.vector()],
+            [Row([DenseVector(rng.normal(size=3))])
+             for _ in range(ROWS)])
+
+    served = 0
+    deadline = time.time() + _member_deadline()
+    while time.time() < deadline and not _stopped():
+        res = run_loadgen(batcher.submit, lambda i: frame(),
+                          LoadGenConfig(mode="closed", requests=20,
+                                        concurrency=2))
+        served += res["ok"]
+        # breathe between chunks: on small CI runners two saturating
+        # replicas would starve the trainer's beat loop
+        time.sleep(0.2)
+    batcher.stop()
+    print(json.dumps({"role": "serving", "process": idx,
+                      "served": served}), flush=True)
+    return 0
+
+
+def run_member() -> int:
+    idx = int(os.environ["FLINK_ML_TPU_PROCESS_ID"])
+    return run_trainer() if idx == 0 else run_replica(idx)
+
+
+# ---------------------------------------------------------------------------
+# parent: launch + gates (artifact-reader stack only, no jax)
+# ---------------------------------------------------------------------------
+
+def _fleet_cli(args):
+    """Run ``mltrace fleet`` in-process; returns (rc, parsed-or-text)."""
+    from flink_ml_tpu.observability import fleet
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), \
+            contextlib.redirect_stderr(io.StringIO()):
+        rc = fleet.main(args)
+    out = buf.getvalue()
+    if "--json" in args:
+        try:
+            return rc, json.loads(out)
+        except ValueError:
+            return rc, None
+    return rc, out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="fleet-smoke")
+    parser.add_argument("--member", action="store_true")
+    parser.add_argument("--duration", type=float, default=180.0,
+                        help="member wall-clock ceiling; the stop file "
+                             "ends them much earlier")
+    parser.add_argument("--root", default=os.environ.get(
+        "FLEET_SMOKE_DIR"), help="working root (kept on failure so CI "
+                                 "can upload the fleet dir); a temp "
+                                 "dir when unset")
+    parser.add_argument("--out", default=os.path.join(
+        REPO, "BENCH_multihost.json"))
+    args = parser.parse_args(argv)
+    if args.member:
+        return run_member()
+
+    import subprocess
+
+    from flink_ml_tpu.observability import fleet, slo
+    from flink_ml_tpu.parallel import distributed
+
+    # the parent reads beacons with the same cadence the members
+    # write; readers that take no explicit --stale-s (the slo CLI)
+    # inherit the kill-detection threshold from the env
+    os.environ[fleet.BEACON_S_ENV] = str(BEACON_S)
+    os.environ[fleet.STALE_S_ENV] = str(KILL_STALE_S)
+    if args.root:
+        tmp = os.path.abspath(args.root)
+        os.makedirs(tmp, exist_ok=True)
+    else:
+        tmp = tempfile.mkdtemp(prefix="fleet-smoke-")
+    fleet_dir = os.path.join(tmp, "fleet")
+    stop_path = os.path.join(tmp, "STOP")
+    child_env = {
+        fleet.FLEET_DIR_ENV: fleet_dir,
+        # the trainer's liveness goes through the elastic seam — which
+        # must land in the SAME dir as the serving beacons
+        "FLINK_ML_TPU_HEARTBEAT_DIR": fleet_dir,
+        fleet.BEACON_S_ENV: str(BEACON_S),
+        "FLEET_SMOKE_STOP": stop_path,
+        "FLEET_SMOKE_DEADLINE_S": str(args.duration),
+    }
+    failures = []
+    record = {"members": 3, "beaconS": BEACON_S}
+    launched = {}
+
+    def launch_fleet() -> None:
+        launched["records"] = distributed.launch(
+            [sys.executable, os.path.abspath(__file__), "--member"],
+            3, env=child_env, timeout=args.duration + 120.0,
+            child_grace_s=args.duration + 120.0)
+
+    runner = threading.Thread(target=launch_fleet, daemon=True)
+    runner.start()
+    print(f"fleet smoke: 3 members beaconing into {fleet_dir} "
+          f"every {BEACON_S}s")
+
+    def poll(predicate, budget_s, step=BEACON_S / 2):
+        deadline = time.time() + budget_s
+        while time.time() < deadline:
+            got = predicate()
+            if got is not None:
+                return got
+            time.sleep(step)
+        return None
+
+    def teardown() -> None:
+        with open(stop_path, "w", encoding="utf-8"):
+            pass
+        runner.join(timeout=60.0)
+
+    # -- gate 1: membership -------------------------------------------------
+    def fleet_formed():
+        view = fleet.FleetView(fleet_dir, stale_s=STALE_S)
+        rows = view.membership()
+        alive = [r for r in rows if r["state"] == "alive"]
+        return view if len(alive) == 3 else None
+
+    view = poll(fleet_formed, budget_s=90.0)
+    if view is None:
+        teardown()
+        print("fleet smoke: fleet never reached 3 alive members",
+              file=sys.stderr)
+        return 2
+    roles = sorted(str(r.get("role")) for r in view.membership())
+    if roles != ["serving", "serving", "trainer"]:
+        failures.append(f"unexpected member roles {roles}")
+    if fleet.stale_member_indices(fleet_dir, 30.0,
+                                  num_processes=3) != []:
+        failures.append("watchdog view disagrees with membership: "
+                        "somebody reads stale while everyone beacons")
+    rc, doc = _fleet_cli([fleet_dir, "--json", "--stale-s",
+                          str(STALE_S)])
+    if rc != 0 or doc is None or doc["counts"]["alive"] != 3:
+        failures.append(f"mltrace fleet --json rc={rc} counts="
+                        f"{doc and doc['counts']}")
+    print(f"fleet smoke: 3 alive ({', '.join(roles)})")
+
+    # let the replicas accumulate a real 60s-window latency population
+    def replicas_served():
+        view = fleet.FleetView(fleet_dir, stale_s=STALE_S)
+        snap, _src = view.hist_window("ml.serving", "transformMs",
+                                      None, 60.0)
+        return True if snap and snap["count"] >= 80 else None
+
+    if poll(replicas_served, budget_s=60.0) is None:
+        failures.append("replicas never accumulated 80 windowed "
+                        "transformMs observations")
+
+    # -- gate 2: bin-exact aggregation over a frozen snapshot ---------------
+    frozen = os.path.join(tmp, "frozen")
+    shutil.copytree(fleet_dir, frozen)
+    rc, doc = _fleet_cli([frozen, "--json", "--stale-s", "1e9"])
+    # the replicas label their series (servable=...): pick the
+    # transformMs aggregate by base name
+    agg_key = next(
+        (k for k in (doc or {}).get("aggregates", {})
+         if k == "ml.serving/transformMs"
+         or k.startswith("ml.serving/transformMs{")), None)
+    agg = doc["aggregates"][agg_key] if agg_key else None
+    if rc != 0 or agg is None:
+        failures.append(f"frozen fleet report rc={rc} has no "
+                        f"transformMs aggregate: {doc}")
+    else:
+        from flink_ml_tpu.common.metrics import histogram_quantile
+
+        hist_key = agg_key.split("/", 1)[1]
+        beacons, invalid = fleet.read_beacons(frozen)
+        snaps = []
+        for raw in beacons:
+            per = (raw.get("windows", {}).get("ml.serving", {})
+                   .get("histograms", {}).get(hist_key))
+            if per and "60" in per:
+                snaps.append(per["60"])
+        truth = fleet.fold_snapshots(snaps)
+        truth_p99 = histogram_quantile(truth, 0.99)
+        record["fleetP99Ms"] = agg["p99"]
+        record["windowSamples"] = truth["count"]
+        if invalid:
+            failures.append(f"{invalid} invalid beacon(s) in the "
+                            f"frozen snapshot")
+        if agg["p99"] != truth_p99 or agg["count"] != truth["count"]:
+            failures.append(
+                f"fleet p99 diverged from the ground-truth bucket "
+                f"merge: CLI {agg['p99']}/{agg['count']} vs "
+                f"{truth_p99}/{truth['count']}")
+        else:
+            print(f"fleet smoke: p99 {agg['p99']}ms over "
+                  f"{truth['count']} merged window samples "
+                  f"(bin-exact, {len(snaps)} contributors)")
+
+    # -- gate 3: chaos-kill p2, detect death --------------------------------
+    victim = next((r for r in fleet.FleetView(fleet_dir).membership()
+                   if r["member"] == "p2"), None)
+    if victim is None:
+        failures.append("no p2 member to kill")
+        teardown()
+    else:
+        os.kill(int(victim["pid"]), signal.SIGKILL)
+        t_kill = time.time()
+
+        def check_flips():
+            rc, _out = _fleet_cli([fleet_dir, "--check", "--stale-s",
+                                   str(KILL_STALE_S)])
+            return time.time() if rc == fleet.EXIT_VIOLATION else None
+
+        t_dead = poll(check_flips, budget_s=30.0, step=0.1)
+        if t_dead is None:
+            failures.append("mltrace fleet --check never exited 4 "
+                            "after the kill")
+        else:
+            detect_s = t_dead - t_kill
+            record["deathDetectS"] = round(detect_s, 3)
+            # dead = 2 missed intervals past the last stamp; allow one
+            # in-flight interval + generous CI scheduling slack
+            bound = 2 * KILL_STALE_S + BEACON_S + 2.0
+            if detect_s > bound:
+                failures.append(f"death detected after {detect_s:.2f}s "
+                                f"(bound {bound:.2f}s)")
+            # classification read at the membership threshold (dead =
+            # 2x STALE_S): poll until the victim crosses it so the
+            # survivor check never races the victim's own aging
+            def victim_dead():
+                rc, doc = _fleet_cli([fleet_dir, "--json", "--stale-s",
+                                      str(STALE_S)])
+                states = {r["member"]: r["state"]
+                          for r in (doc or {}).get("members", [])}
+                return states if states.get("p2") == "dead" else None
+
+            states = poll(victim_dead, budget_s=15.0)
+            if states is None:
+                failures.append("p2 never classified dead at the "
+                                "membership threshold")
+            elif states.get("p0") == "dead" or states.get("p1") == "dead":
+                failures.append(f"survivors misclassified: {states}")
+            print(f"fleet smoke: p2 SIGKILLed, --check flipped to 4 "
+                  f"in {detect_s:.2f}s")
+
+        # a half-dead fleet must not report a healthy verdict from the
+        # survivors alone — however generous the latency threshold
+        spec_path = os.path.join(tmp, "fleet-slo.json")
+        with open(spec_path, "w", encoding="utf-8") as f:
+            json.dump({"slos": [
+                {"name": "fleet-p99", "kind": "latency",
+                 "histogram": "transformMs", "threshold_ms": 1e9,
+                 "scope": "fleet"}]}, f)
+        verdict = slo.evaluate_slos(
+            slo.load_specs(spec_path),
+            fleet_view=fleet.FleetView(fleet_dir,
+                                       stale_s=KILL_STALE_S))[0]
+        if verdict["ok"]:
+            failures.append("fleet SLO passed with a dead member")
+        if "p2" not in verdict.get("membersMissing", []) \
+                or verdict.get("membersDead") != ["p2"]:
+            failures.append(f"dead member not surfaced on the verdict: "
+                            f"missing={verdict.get('membersMissing')} "
+                            f"dead={verdict.get('membersDead')}")
+        if not all(o["ok"] for o in verdict["objectives"]):
+            failures.append("survivor objectives should pass under the "
+                            "generous threshold — the MEMBER is the "
+                            "violation")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf), \
+                contextlib.redirect_stderr(io.StringIO()):
+            rc_slo = slo.main([fleet_dir, "--spec", spec_path,
+                               "--check"])
+        if rc_slo != slo.EXIT_VIOLATION:
+            failures.append(f"slo --check over the half-dead fleet "
+                            f"exited {rc_slo}, expected 4")
+        print(f"fleet smoke: fleet SLO verdict ok={verdict['ok']} "
+              f"missing={verdict['membersMissing']}")
+
+        # -- gate 4: relaunch p2, --check settles back to 0 -----------------
+        env = dict(os.environ)
+        env.update(child_env)
+        env["JAX_PLATFORMS"] = "cpu"
+        env[distributed.PROCESS_ID_ENV] = "2"
+        env[distributed.NUM_PROCESSES_ENV] = "3"
+        relaunched = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--member"],
+            env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+        def check_recovers():
+            rc, _out = _fleet_cli([fleet_dir, "--check", "--stale-s",
+                                   str(STALE_S)])
+            return True if rc == fleet.EXIT_OK else None
+
+        if poll(check_recovers, budget_s=90.0) is None:
+            failures.append("mltrace fleet --check never settled back "
+                            "to 0 after the relaunch")
+        else:
+            print("fleet smoke: p2 relaunched, --check back to 0")
+        record["recovered"] = "p2"
+
+        teardown()
+        relaunched.wait(timeout=60.0)
+
+    # -- the members' own verdicts ------------------------------------------
+    records = launched.get("records") or []
+    trainer = next((r for r in records if r["process"] == 0), None)
+    if trainer is None or trainer["returncode"] != 0:
+        failures.append(f"trainer exited "
+                        f"{trainer and trainer['returncode']}: "
+                        f"{(trainer or {}).get('stderr', '')[-1000:]}")
+    else:
+        last = trainer["stdout"].strip().splitlines()[-1]
+        report = json.loads(last)
+        if report["unifiedStale"] != []:
+            failures.append(f"elastic watchdog inside the trainer saw "
+                            f"stale members {report['unifiedStale']} "
+                            f"while the whole fleet beaconed")
+        record["trainerEpochs"] = report["epochs"]
+    p1 = next((r for r in records if r["process"] == 1), None)
+    if p1 is None or p1["returncode"] != 0:
+        failures.append(f"replica p1 exited "
+                        f"{p1 and p1['returncode']}: "
+                        f"{(p1 or {}).get('stderr', '')[-1000:]}")
+
+    if failures:
+        # the working root (beacons included) survives for upload
+        for f in failures:
+            print(f"FLEET REGRESSION: {f}", file=sys.stderr)
+        return 1
+
+    try:
+        with open(args.out) as f:
+            bench = json.load(f)
+    except (OSError, ValueError):
+        bench = {}
+    bench["fleet_sweep"] = record
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(bench, f, indent=1, sort_keys=False)
+        f.write("\n")
+    if not args.root:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(f"fleet smoke passed; fleet_sweep -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -198,14 +198,22 @@ def test_participation_observe_validates_shape():
 # -- detection: heartbeats + the collective watchdog --------------------------
 
 def test_beat_and_stale_processes(monkeypatch, tmp_path):
+    import glob
+    import json
+
     monkeypatch.setenv(elastic.HEARTBEAT_DIR_ENV, str(tmp_path))
     elastic.beat(epoch=3)
-    hb = tmp_path / "hb-0"
-    assert hb.exists()
+    # a heartbeat IS a fleet beacon now (observability/fleet.py) — the
+    # elastic watchdog and `mltrace fleet` read the same stamp
+    beacons = glob.glob(str(tmp_path / "fleet-*.json"))
+    assert len(beacons) == 1
+    raw = json.loads(open(beacons[0]).read())
+    assert raw["epoch"] == 3 and raw["role"] == "trainer"
     # processes 1 and 2 never beat; 0 is fresh
     assert elastic.stale_processes(30.0, num_processes=3) == [1, 2]
-    old = time.time() - 120.0
-    os.utime(hb, (old, old))
+    raw["time"] = time.time() - 120.0
+    with open(beacons[0], "w") as f:
+        json.dump(raw, f)
     assert elastic.stale_processes(30.0, num_processes=3) == [0, 1, 2]
 
 
@@ -233,11 +241,18 @@ def test_wait_with_deadline_raises_worker_lost(monkeypatch, tmp_path):
     # out mid-wait; real deadlines are tens of seconds)
     monkeypatch.setenv("FLINK_ML_TPU_NUM_PROCESSES", "3")
     monkeypatch.setenv(elastic.HEARTBEAT_DIR_ENV, str(tmp_path))
+    import json
+
     now = time.time()
-    for k in (0, 1, 2):
-        (tmp_path / f"hb-{k}").write_text("{}")
-        os.utime(tmp_path / f"hb-{k}", (now + 30.0, now + 30.0))
-    os.utime(tmp_path / "hb-2", (now - 120.0, now - 120.0))
+    # beats are fleet beacons keyed by processIndex; 0 and 1 get future
+    # stamps so the sub-second test deadline can't age them out
+    # mid-wait (a future stamp clamps to age 0 — clock-skew rule);
+    # real deadlines are tens of seconds
+    for k, stamp in ((0, now + 30.0), (1, now + 30.0),
+                     (2, now - 120.0)):
+        (tmp_path / f"fleet-p{k}-{1000 + k}.json").write_text(
+            json.dumps({"schema": 1, "time": stamp, "pid": 1000 + k,
+                        "process": k, "processIndex": k}))
     with pytest.raises(WorkerLost, match="process 2") as ei:
         elastic.wait_with_deadline({"x": 1}, 0.2, what="segment")
     assert ei.value.process_index == 2

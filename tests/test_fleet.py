@@ -1,0 +1,644 @@
+"""Fleet telemetry plane (observability/fleet.py): beacons, membership
+staleness, bin-exact cross-member aggregation, fleet-scope SLOs.
+
+Pins the ISSUE 18 contracts: a torn/partial beacon is rejected WHOLE
+(never folded partially — the MetricsRegistry.merge discipline applied
+at the fleet edge); a stale member is excluded from fleet quantiles but
+still counted in membership (and surfaces in ``membersMissing``);
+clock-skewed (future-stamped) beacons read as fresh and fold exactly
+once; a killed process's beacon ages alive → stale → dead against the
+announced interval; ``scope: fleet`` SLO verdicts fail outright while
+any member is dead, however healthy the survivors' aggregate; the
+elastic heartbeat (parallel/elastic.py ``beat``/``stale_processes``)
+and ``mltrace fleet`` read the SAME beacon stamp; and every series a
+multi-process runtime dumps or exposes carries a ``process="p<k>"``
+label so two replicas can never emit colliding series names.
+"""
+
+import glob
+import json
+import math
+import os
+import time
+
+import pytest
+
+from flink_ml_tpu.common.metrics import (
+    MetricsRegistry,
+    histogram_quantile,
+    metrics,
+)
+from flink_ml_tpu.observability import fleet, slo
+from flink_ml_tpu.observability.exporters import (
+    dump_metrics,
+    prometheus_text,
+    relabel_snapshot,
+)
+
+BUCKETS = [1.0, 5.0, 25.0]
+
+
+def _snap(counts, total=None, total_sum=None):
+    """A cumulative-bucket snapshot in the shared mergeable format."""
+    return {"buckets": list(BUCKETS), "counts": list(counts),
+            "count": total if total is not None else counts[-1],
+            "sum": total_sum if total_sum is not None
+            else float(sum(counts))}
+
+
+def _write_beacon(tmp_path, idx, stamp, hist=None, counters=None,
+                  gauges=None, pid=None, role="serving", epoch=None,
+                  interval=2.0):
+    """Hand-write a valid beacon for member ``p<idx>``."""
+    raw = {"schema": fleet.BEACON_SCHEMA, "time": float(stamp),
+           "seq": 1, "pid": pid if pid is not None else 1000 + idx,
+           "process": idx, "processIndex": idx, "role": role,
+           "interval_s": interval, "windows": {}, "gauges": gauges or {},
+           "load": {}, "events": []}
+    if epoch is not None:
+        raw["epoch"] = epoch
+    entry = {}
+    if hist:
+        entry["histograms"] = {
+            key: {"60": snap, "300": snap} for key, snap in hist.items()}
+    if counters:
+        entry["counters"] = {
+            key: {"60": val, "300": val}
+            for key, val in counters.items()}
+    if entry:
+        raw["windows"]["ml.serving"] = entry
+    path = tmp_path / f"fleet-p{idx}-{raw['pid']}.json"
+    path.write_text(json.dumps(raw))
+    return path
+
+
+# -- beacon writing -----------------------------------------------------------
+
+def test_write_beacon_roundtrips_windowed_slices(tmp_path):
+    reg = MetricsRegistry()
+    grp = reg.group("ml", "serving")
+    wh = grp.windowed_histogram("queueMs", buckets=BUCKETS)
+    for v in (0.5, 2.0, 50.0):
+        wh.observe(v)
+    grp.windowed_counter("transforms").inc(4)
+    grp.gauge("queueDepth", 3)
+    path = fleet.write_beacon(str(tmp_path), role="serving",
+                              registry=reg)
+    assert path is not None and os.path.exists(path)
+    raw = json.loads(open(path).read())
+    assert raw["schema"] == fleet.BEACON_SCHEMA
+    assert raw["role"] == "serving"
+    hist = raw["windows"]["ml.serving"]["histograms"]["queueMs"]
+    assert set(hist) == {"60", "300"}
+    assert hist["60"]["count"] == 3
+    assert raw["windows"]["ml.serving"]["counters"]["transforms"]["60"] \
+        == 4
+    assert raw["gauges"]["ml.serving"]["queueDepth"] == 3
+    # the carried slice is the validated mergeable snapshot format
+    from flink_ml_tpu.common.metrics import check_histogram_snapshot
+
+    check_histogram_snapshot("queueMs", hist["60"], tuple(BUCKETS))
+
+
+def test_write_beacon_disarmed_returns_none(tmp_path, monkeypatch):
+    for var in (fleet.FLEET_DIR_ENV, "FLINK_ML_TPU_HEARTBEAT_DIR",
+                "FLINK_ML_TPU_TRACE_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    assert fleet.write_beacon() is None
+
+
+def test_histogram_items_enumeration_seam():
+    reg = MetricsRegistry()
+    grp = reg.group("ml", "serving")
+    wh = grp.windowed_histogram("queueMs", buckets=BUCKETS)
+    plain = grp.histogram("plainMs", buckets=BUCKETS)
+    items = dict(grp.histogram_items())
+    assert items["queueMs"] is wh and items["plainMs"] is plain
+    assert dict(reg.group_items())["ml.serving"] is grp
+
+
+# -- beacon reading: all-or-nothing admission ---------------------------------
+
+def test_torn_beacon_rejected_whole(tmp_path):
+    _write_beacon(tmp_path, 0, time.time(),
+                  hist={"queueMs": _snap([2, 4, 6])})
+    # a torn write: truncated JSON
+    (tmp_path / "fleet-p1-2001.json").write_text('{"schema": 1, "tim')
+    # parseable but with a bucket-layout violation buried in one slice:
+    # the WHOLE beacon must be rejected, not the good slices folded
+    bad = json.loads((tmp_path / "fleet-p0-1000.json").read_text())
+    bad["process"], bad["processIndex"], bad["pid"] = 2, 2, 3002
+    bad["windows"]["ml.serving"]["histograms"]["queueMs"]["60"] = {
+        "buckets": BUCKETS, "counts": [1, 2], "sum": 1.0, "count": 2}
+    (tmp_path / "fleet-p2-3002.json").write_text(json.dumps(bad))
+    beacons, invalid = fleet.read_beacons(str(tmp_path))
+    assert len(beacons) == 1 and invalid == 2
+    view = fleet.FleetView(str(tmp_path))
+    snap, _src = view.hist_window("ml.serving", "queueMs", None, 60.0)
+    assert snap["count"] == 6  # p0 alone; nothing from the torn pair
+    assert view.report()["counts"]["invalid"] == 2
+
+
+def test_unknown_schema_rejected(tmp_path):
+    path = _write_beacon(tmp_path, 0, time.time())
+    raw = json.loads(path.read_text())
+    raw["schema"] = 99
+    path.write_text(json.dumps(raw))
+    beacons, invalid = fleet.read_beacons(str(tmp_path))
+    assert beacons == [] and invalid == 1
+
+
+def test_newest_stamp_wins_per_member(tmp_path):
+    now = time.time()
+    _write_beacon(tmp_path, 0, now - 30.0, pid=111)
+    _write_beacon(tmp_path, 0, now, pid=222)  # relaunched: new pid
+    beacons, invalid = fleet.read_beacons(str(tmp_path))
+    assert invalid == 0 and len(beacons) == 1
+    assert beacons[0]["pid"] == 222
+
+
+# -- staleness classification -------------------------------------------------
+
+def test_stale_member_excluded_from_quantiles_but_in_membership(tmp_path):
+    now = 1000.0
+    _write_beacon(tmp_path, 0, now - 1.0,
+                  hist={"queueMs": _snap([10, 10, 10])})
+    _write_beacon(tmp_path, 1, now - 9.0,
+                  hist={"queueMs": _snap([0, 0, 1000])})
+    view = fleet.FleetView(str(tmp_path), stale_s=5.0, clock=lambda: now)
+    rows = {r["member"]: r["state"] for r in view.membership()}
+    assert rows == {"p0": "alive", "p1": "stale"}
+    # the stale member's (slow) histogram must NOT drag the aggregate
+    snap, src = view.hist_window("ml.serving", "queueMs", None, 60.0)
+    assert snap["count"] == 10 and src == "fleet[1]:60s"
+    assert view.members_missing() == ["p1"]
+    report = view.report()
+    assert len(report["members"]) == 2
+    assert report["counts"] == {"alive": 1, "stale": 1, "dead": 0,
+                                "invalid": 0}
+    assert report["aggregates"]["ml.serving/queueMs"]["count"] == 10
+
+
+def test_clock_skewed_beacon_reads_fresh_and_folds_once(tmp_path):
+    now = 1000.0
+    # member 0's clock runs 50s ahead: a negative age clamps to 0 —
+    # alive, and its counts fold exactly once (no double-count from
+    # window re-picks)
+    _write_beacon(tmp_path, 0, now + 50.0,
+                  hist={"queueMs": _snap([1, 2, 3])})
+    _write_beacon(tmp_path, 1, now - 1.0,
+                  hist={"queueMs": _snap([4, 5, 6])})
+    view = fleet.FleetView(str(tmp_path), stale_s=5.0, clock=lambda: now)
+    assert all(r["state"] == "alive" for r in view.membership())
+    assert all(r["age_s"] >= 0.0 for r in view.membership())
+    snap, _src = view.hist_window("ml.serving", "queueMs", None, 60.0)
+    assert snap["counts"] == [5, 7, 9] and snap["count"] == 9
+
+
+def test_killed_member_ages_alive_stale_dead(tmp_path):
+    t0 = 5000.0
+    _write_beacon(tmp_path, 0, t0)
+    for offset, state in ((1.0, "alive"), (4.0, "alive"),
+                          (5.0, "stale"), (8.0, "stale"),
+                          (9.0, "dead")):
+        view = fleet.FleetView(str(tmp_path), stale_s=4.0,
+                               clock=lambda: t0 + offset)
+        assert view.membership()[0]["state"] == state, offset
+
+
+def test_stale_threshold_env_default_tracks_beacon_interval(monkeypatch):
+    monkeypatch.delenv(fleet.STALE_S_ENV, raising=False)
+    monkeypatch.setenv(fleet.BEACON_S_ENV, "0.5")
+    assert fleet.stale_after_s() == pytest.approx(1.0)
+    monkeypatch.setenv(fleet.STALE_S_ENV, "7.5")
+    assert fleet.stale_after_s() == pytest.approx(7.5)
+    monkeypatch.setenv(fleet.BEACON_S_ENV, "junk")
+    assert fleet.beacon_interval_s() == fleet.DEFAULT_BEACON_S
+
+
+# -- bin-exact aggregation ----------------------------------------------------
+
+def test_fold_matches_ground_truth_bucket_merge(tmp_path):
+    members = [[3, 10, 20], [1, 4, 9], [0, 7, 30]]
+    for idx, counts in enumerate(members):
+        _write_beacon(tmp_path, idx, time.time(),
+                      hist={"queueMs": _snap(counts)})
+    view = fleet.FleetView(str(tmp_path))
+    snap, _src = view.hist_window("ml.serving", "queueMs", None, 60.0)
+    # ground truth: elementwise bucket sums of the same snapshots
+    expected = [sum(m[i] for m in members) for i in range(3)]
+    assert snap["counts"] == expected
+    assert snap["count"] == sum(m[-1] for m in members)
+    assert histogram_quantile(snap, 0.99) == pytest.approx(
+        histogram_quantile(_snap(expected, total=snap["count"],
+                                 total_sum=snap["sum"]), 0.99))
+    aggs = view.aggregates(60.0)
+    assert aggs["ml.serving/queueMs"]["members"] == 3
+    assert aggs["ml.serving/queueMs"]["p99"] == histogram_quantile(
+        snap, 0.99)
+
+
+def test_fold_snapshots_rejects_layout_drift():
+    good = _snap([1, 2, 3])
+    drifted = {"buckets": [1.0, 2.0], "counts": [1, 2], "sum": 1.0,
+               "count": 2}
+    with pytest.raises(ValueError):
+        fleet.fold_snapshots([good, drifted])
+
+
+def test_counter_window_sums_across_members(tmp_path):
+    _write_beacon(tmp_path, 0, time.time(), counters={"transforms": 5})
+    _write_beacon(tmp_path, 1, time.time(), counters={"transforms": 7})
+    view = fleet.FleetView(str(tmp_path))
+    total, src = view.counter_window("ml.serving", "transforms", None,
+                                     60.0)
+    assert total == 12.0 and src == "fleet[2]:60s"
+
+
+def test_pick_window_prefers_smallest_covering():
+    per = {"60": "sixty", "300": "threehundred"}
+    assert fleet._pick_window(per, 60.0) == "sixty"
+    assert fleet._pick_window(per, 120.0) == "threehundred"
+    assert fleet._pick_window(per, 900.0) == "threehundred"
+
+
+# -- fleet-scope SLOs ---------------------------------------------------------
+
+def test_slo_scope_field_validates():
+    assert slo.SLO.from_dict(
+        {"name": "f", "scope": "fleet"}).scope == "fleet"
+    with pytest.raises(ValueError, match="scope"):
+        slo.SLO(name="bad", scope="galaxy")
+
+
+def test_fleet_scope_slo_carries_membership_and_per_member(tmp_path):
+    now = time.time()
+    _write_beacon(tmp_path, 0, now,
+                  hist={"transformMs": _snap([50, 50, 50])})
+    _write_beacon(tmp_path, 1, now,
+                  hist={"transformMs": _snap([0, 10, 20])})
+    spec = slo.SLO(name="fleet-latency", kind="latency",
+                   histogram="transformMs", threshold_ms=500.0,
+                   scope="fleet")
+    verdict = slo.evaluate_slos([spec], fleet_dir=str(tmp_path))[0]
+    assert verdict["scope"] == "fleet" and verdict["ok"]
+    assert verdict["members"] == 2 and verdict["membersAlive"] == 2
+    assert verdict["membersMissing"] == []
+    assert set(verdict["perMember"]) == {"p0", "p1"}
+    primary = verdict["objectives"][0]
+    assert primary["samples"] == 70
+    assert primary["source"] == "fleet[2]:60s"
+
+
+def test_fleet_scope_slo_fails_on_dead_member_despite_healthy_p99(
+        tmp_path):
+    now = time.time()
+    _write_beacon(tmp_path, 0, now,
+                  hist={"transformMs": _snap([100, 100, 100])})
+    # member 1 died 60s ago; its last beacon was healthy too
+    _write_beacon(tmp_path, 1, now - 60.0,
+                  hist={"transformMs": _snap([100, 100, 100])})
+    spec = slo.SLO(name="fleet-latency", kind="latency",
+                   histogram="transformMs", threshold_ms=500.0,
+                   scope="fleet")
+    verdict = slo.evaluate_slos([spec], fleet_dir=str(tmp_path))[0]
+    # every objective over the survivors is ok — the verdict is NOT
+    assert all(o["ok"] for o in verdict["objectives"])
+    assert not verdict["ok"]
+    assert verdict["membersDead"] == ["p1"]
+    assert verdict["membersMissing"] == ["p1"]
+    rendered = slo.render_verdicts([verdict])
+    assert "DEAD: p1" in rendered and "VIOLATED" in rendered
+
+
+def test_fleet_scope_without_telemetry_is_visible_not_fatal(tmp_path):
+    spec = slo.SLO(name="fleet-latency", kind="latency", scope="fleet")
+    verdict = slo.evaluate_slos([spec],
+                                fleet_dir=str(tmp_path / "nope"))[0]
+    assert verdict["fleet"] == "missing" and verdict["members"] == 0
+    assert verdict["objectives"][0]["source"] == "fleet-missing"
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_exit_2_without_fleet_telemetry(tmp_path, capsys):
+    assert fleet.main([str(tmp_path)]) == fleet.EXIT_INVALID
+    assert "no fleet telemetry" in capsys.readouterr().err
+
+
+def test_cli_renders_membership_and_aggregates(tmp_path, capsys):
+    _write_beacon(tmp_path, 0, time.time(),
+                  hist={"queueMs": _snap([5, 10, 20])}, epoch=7)
+    assert fleet.main([str(tmp_path)]) == fleet.EXIT_OK
+    out = capsys.readouterr().out
+    assert "1 alive" in out and "p0" in out
+    assert "ml.serving/queueMs" in out
+
+
+def test_cli_check_exit_4_on_dead_member(tmp_path, capsys):
+    _write_beacon(tmp_path, 0, time.time() - 120.0)
+    rc = fleet.main([str(tmp_path), "--check", "--stale-s", "1"])
+    assert rc == fleet.EXIT_VIOLATION
+
+
+def test_cli_check_exit_4_on_fleet_slo_violation(tmp_path, capsys):
+    # alive member, terrible p99: every observation lands past 5ms
+    _write_beacon(tmp_path, 0, time.time(),
+                  hist={"transformMs": _snap([0, 0, 100])})
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps({"slos": [
+        {"name": "tight", "kind": "latency", "histogram": "transformMs",
+         "threshold_ms": 2.0, "scope": "fleet"}]}))
+    rc = fleet.main([str(tmp_path), "--check", "--spec",
+                     str(spec_path)])
+    assert rc == fleet.EXIT_VIOLATION
+    # same fleet, generous bound: clean
+    spec_path.write_text(json.dumps({"slos": [
+        {"name": "loose", "kind": "latency",
+         "histogram": "transformMs", "threshold_ms": 500.0,
+         "scope": "fleet"}]}))
+    rc = fleet.main([str(tmp_path), "--check", "--spec",
+                     str(spec_path)])
+    assert rc == fleet.EXIT_OK
+
+
+def test_cli_json_report_shape(tmp_path, capsys):
+    _write_beacon(tmp_path, 0, time.time(),
+                  hist={"queueMs": _snap([5, 10, 20])})
+    assert fleet.main([str(tmp_path), "--json"]) == fleet.EXIT_OK
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["counts"]["alive"] == 1
+    assert doc["members"][0]["member"] == "p0"
+    assert doc["aggregates"]["ml.serving/queueMs"]["count"] == 20
+
+
+def test_cli_resolves_nested_fleet_dir(tmp_path, capsys):
+    nested = tmp_path / "fleet"
+    nested.mkdir()
+    _write_beacon(nested, 0, time.time())
+    assert fleet.main([str(tmp_path)]) == fleet.EXIT_OK
+
+
+def test_trace_cli_dispatches_fleet(tmp_path, capsys):
+    from flink_ml_tpu.observability.cli import main as trace_cli
+
+    _write_beacon(tmp_path, 0, time.time())
+    assert trace_cli(["fleet", str(tmp_path)]) == fleet.EXIT_OK
+    assert "1 alive" in capsys.readouterr().out
+
+
+def test_slo_cli_fleet_scope_over_beacon_dir(tmp_path, capsys):
+    _write_beacon(tmp_path, 0, time.time(),
+                  hist={"transformMs": _snap([5, 10, 20])})
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps({"slos": [
+        {"name": "fleet-p99", "kind": "latency",
+         "histogram": "transformMs", "threshold_ms": 500.0,
+         "scope": "fleet"}]}))
+    rc = slo.main([str(tmp_path), "--spec", str(spec_path), "--json"])
+    assert rc == slo.EXIT_OK
+    doc = json.loads(capsys.readouterr().out)
+    verdict = doc["verdicts"][0]
+    assert verdict["scope"] == "fleet" and verdict["members"] == 1
+
+
+# -- elastic liveness unification ---------------------------------------------
+
+def test_elastic_beat_is_a_fleet_beacon(tmp_path, monkeypatch):
+    from flink_ml_tpu.parallel import elastic
+
+    monkeypatch.setenv(elastic.HEARTBEAT_DIR_ENV, str(tmp_path))
+    elastic.beat(epoch=11)
+    beacons, invalid = fleet.read_beacons(str(tmp_path))
+    assert invalid == 0 and len(beacons) == 1
+    assert beacons[0]["role"] == "trainer"
+    assert beacons[0]["epoch"] == 11
+    # the SAME file answers both watchdogs
+    assert elastic.stale_processes(30.0, num_processes=2) == [1]
+    assert fleet.stale_member_indices(str(tmp_path), 30.0,
+                                      num_processes=2) == [1]
+    assert fleet.find_fleet_dir(str(tmp_path)) == str(tmp_path)
+
+
+def test_stale_member_indices_counts_silence(tmp_path):
+    now = time.time()
+    _write_beacon(tmp_path, 0, now)
+    _write_beacon(tmp_path, 2, now - 50.0)
+    assert fleet.stale_member_indices(str(tmp_path), 10.0,
+                                      num_processes=3, now=now) == [1, 2]
+
+
+def test_writer_dir_resolution_prefers_explicit_env(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv(fleet.FLEET_DIR_ENV, str(tmp_path / "a"))
+    monkeypatch.setenv("FLINK_ML_TPU_HEARTBEAT_DIR", str(tmp_path / "b"))
+    assert fleet.fleet_dir() == str(tmp_path / "a")
+    monkeypatch.delenv(fleet.FLEET_DIR_ENV)
+    assert fleet.fleet_dir() == str(tmp_path / "b")
+    monkeypatch.delenv("FLINK_ML_TPU_HEARTBEAT_DIR")
+    monkeypatch.setenv("FLINK_ML_TPU_TRACE_DIR", str(tmp_path / "t"))
+    assert fleet.fleet_dir() == os.path.join(str(tmp_path / "t"),
+                                             "fleet")
+
+
+# -- provenance ---------------------------------------------------------------
+
+def test_provenance_null_when_disarmed(monkeypatch):
+    for var in (fleet.FLEET_DIR_ENV, "FLINK_ML_TPU_HEARTBEAT_DIR",
+                "FLINK_ML_TPU_TRACE_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    assert fleet.provenance() == {"fleetMembers": None,
+                                  "fleetP99Ms": None}
+
+
+def test_provenance_reads_fleet_queue_p99(tmp_path, monkeypatch):
+    monkeypatch.setenv(fleet.FLEET_DIR_ENV, str(tmp_path))
+    _write_beacon(tmp_path, 0, time.time(),
+                  hist={"queueMs": _snap([5, 10, 20])})
+    _write_beacon(tmp_path, 1, time.time(),
+                  hist={"queueMs": _snap([5, 10, 20])})
+    prov = fleet.provenance()
+    assert prov["fleetMembers"] == 2
+    assert prov["fleetP99Ms"] == pytest.approx(
+        histogram_quantile(_snap([10, 20, 40], total=40,
+                                 total_sum=70.0), 0.99))
+
+
+# -- process label on dumps and exposition (the collision fix) ---------------
+
+def test_prometheus_text_adds_process_label_multiprocess(monkeypatch):
+    snapshot = {"ml.serving": {
+        "gauges": {"queueDepth": 7},
+        "counters": {'transforms{servable="lr"}': 3},
+        "histograms": {"queueMs": _snap([1, 2, 3])}}}
+    monkeypatch.setenv("FLINK_ML_TPU_NUM_PROCESSES", "2")
+    monkeypatch.setenv("FLINK_ML_TPU_PROCESS_ID", "1")
+    text = prometheus_text(snapshot)
+    assert 'queueDepth{process="p1"} 7' in text
+    assert 'process="p1"' in text and 'servable="lr"' in text
+    # bucket lines keep le= AND gain the process label
+    assert 'le="1"' in text
+    for line in text.splitlines():
+        if "_bucket{" in line:
+            assert 'process="p1"' in line
+
+
+def test_prometheus_text_unlabeled_single_process(monkeypatch):
+    monkeypatch.delenv("FLINK_ML_TPU_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("FLINK_ML_TPU_PROCESS_ID", raising=False)
+    snapshot = {"ml.serving": {"gauges": {"queueDepth": 7},
+                               "counters": {}, "histograms": {}}}
+    assert "process=" not in prometheus_text(snapshot)
+
+
+def test_relabel_preserves_explicit_process_label():
+    snap = {"ml.x": {"counters": {'n{process="p0"}': 1, "m": 2},
+                     "gauges": {}, "histograms": {}}}
+    out = relabel_snapshot(snap, {"process": "p1"})
+    assert set(out["ml.x"]["counters"]) == {'n{process="p0"}',
+                                            'm{process="p1"}'}
+
+
+def test_dump_metrics_relabels_in_multiprocess_runtime(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setenv("FLINK_ML_TPU_NUM_PROCESSES", "2")
+    monkeypatch.setenv("FLINK_ML_TPU_PROCESS_ID", "0")
+    reg = MetricsRegistry()
+    reg.group("ml", "serving").counter("transforms", 5)
+    path = dump_metrics(str(tmp_path), registry=reg)
+    assert "metrics-p0-" in os.path.basename(path)
+    raw = json.loads(open(path).read())
+    assert 'transforms{process="p0"}' in raw["ml.serving"]["counters"]
+
+
+def test_relabeled_dumps_merge_without_collision(tmp_path):
+    """The scrape/merge collision the label fixes: two members' series
+    stay distinct through read_metrics, and the slo engine's
+    label-subset matching still aggregates across them."""
+    from flink_ml_tpu.observability.exporters import read_metrics
+
+    for k in (0, 1):
+        snap = {"ml.serving": {
+            "gauges": {}, "histograms": {},
+            "counters": {f'transforms{{process="p{k}"}}': 10 + k}}}
+        with open(tmp_path / f"metrics-p{k}-{100 + k}.json", "w") as f:
+            json.dump(snap, f)
+    merged = read_metrics(str(tmp_path))
+    counters = merged["ml.serving"]["counters"]
+    assert counters == {'transforms{process="p0"}': 10,
+                        'transforms{process="p1"}': 11}
+    verdicts = slo.evaluate_slos(
+        [slo.SLO(name="er", kind="error-rate")], snapshot=merged)
+    # 21 requests, 0 errors — both members' series matched
+    assert verdicts[0]["objectives"][0]["requests"] == 21
+
+
+# -- live endpoint ------------------------------------------------------------
+
+def test_fleet_route_registered():
+    from flink_ml_tpu.observability.server import ROUTE_TABLE
+
+    assert "/fleet" in ROUTE_TABLE
+
+
+def test_fleet_route_serves_report(tmp_path, monkeypatch):
+    import urllib.request
+
+    from flink_ml_tpu.observability import server
+
+    monkeypatch.setenv(fleet.FLEET_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(server.METRICS_PORT_ENV, "0")
+    _write_beacon(tmp_path, 0, time.time(),
+                  hist={"queueMs": _snap([5, 10, 20])})
+    srv = server.maybe_start()
+    assert srv is not None and srv.port > 0
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/fleet", timeout=10) as r:
+            doc = json.loads(r.read().decode("utf-8"))
+        assert doc["fleet"]["counts"]["alive"] == 1
+        assert doc["fleet"]["members"][0]["member"] == "p0"
+    finally:
+        server.stop()
+
+
+def test_fleet_route_null_when_disarmed(monkeypatch):
+    import urllib.request
+
+    from flink_ml_tpu.observability import server
+
+    for var in (fleet.FLEET_DIR_ENV, "FLINK_ML_TPU_HEARTBEAT_DIR",
+                "FLINK_ML_TPU_TRACE_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv(server.METRICS_PORT_ENV, "0")
+    srv = server.maybe_start()
+    assert srv is not None
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/fleet", timeout=10) as r:
+            doc = json.loads(r.read().decode("utf-8"))
+        assert doc["fleet"] is None
+    finally:
+        server.stop()
+
+
+# -- the periodic writer ------------------------------------------------------
+
+def test_start_stop_beacon_lifecycle(tmp_path, monkeypatch):
+    monkeypatch.setenv(fleet.BEACON_S_ENV, "0.2")
+    token = fleet.start_beacon(role="serving", base_dir=str(tmp_path))
+    assert token is not None
+    try:
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            beacons, _ = fleet.read_beacons(str(tmp_path))
+            if beacons:
+                break
+            time.sleep(0.05)
+        assert beacons and beacons[0]["role"] == "serving"
+        first_seq = beacons[0]["seq"]
+        # the periodic writer keeps stamping
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            beacons, _ = fleet.read_beacons(str(tmp_path))
+            if beacons and beacons[0]["seq"] > first_seq:
+                break
+            time.sleep(0.05)
+        assert beacons[0]["seq"] > first_seq
+    finally:
+        fleet.stop_beacon(token)
+    # final beacon written on stop, thread gone
+    beacons, _ = fleet.read_beacons(str(tmp_path))
+    assert beacons and beacons[0]["role"] == "stopped"
+
+
+def test_start_beacon_disarmed_returns_none(monkeypatch):
+    for var in (fleet.FLEET_DIR_ENV, "FLINK_ML_TPU_HEARTBEAT_DIR",
+                "FLINK_ML_TPU_TRACE_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    assert fleet.start_beacon(role="serving") is None
+    fleet.stop_beacon(None)  # tolerated
+
+
+def test_stacked_roles_join(tmp_path, monkeypatch):
+    monkeypatch.setenv(fleet.BEACON_S_ENV, "60")  # only explicit writes
+    t1 = fleet.start_beacon(role="serving", base_dir=str(tmp_path))
+    t2 = fleet.start_beacon(role="controller", base_dir=str(tmp_path))
+    try:
+        beacons, _ = fleet.read_beacons(str(tmp_path))
+        assert beacons[0]["role"] == "serving+controller"
+    finally:
+        fleet.stop_beacon(t2)
+        fleet.stop_beacon(t1)
+
+
+# -- benchmark provenance -----------------------------------------------------
+
+def test_runner_fleet_provenance_null_fields(monkeypatch):
+    from flink_ml_tpu.benchmark.runner import _fleet_provenance
+
+    for var in (fleet.FLEET_DIR_ENV, "FLINK_ML_TPU_HEARTBEAT_DIR",
+                "FLINK_ML_TPU_TRACE_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    assert _fleet_provenance() == {"fleetMembers": None,
+                                   "fleetP99Ms": None}
