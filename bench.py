@@ -307,6 +307,22 @@ def _worker(role: str) -> int:
     except Exception:  # noqa: BLE001 — provenance only
         line["drift_psi_max"] = None
         line["baseline_version"] = None
+    # device-efficiency provenance (observability/profiling.py): the
+    # hottest profiled fn's roofline utilization and achieved FLOP/s
+    # when a device profile was captured beside this run — null on
+    # host-fallback (a CPU run honestly claims no utilization) or when
+    # no capture was armed, same shared-schema rule as drift_psi_max
+    try:
+        from flink_ml_tpu.observability import profiling as _prof
+
+        pprov = _prof.provenance()
+        line["profile_source"] = pprov["profileSource"]
+        line["utilization"] = pprov["utilization"]
+        line["achieved_flops"] = pprov["achievedFlops"]
+    except Exception:  # noqa: BLE001 — provenance only
+        line["profile_source"] = None
+        line["utilization"] = None
+        line["achieved_flops"] = None
     # causal-tracing cost provenance (scripts/serve_bench.py measures
     # it as traced-vs-untraced steady-state serving p99, gated <= 5% —
     # BENCH_serving.json traceOverheadPct); null on plain fit benches,

@@ -73,6 +73,14 @@ def _profiled(method, kind: str):
                         region, kind=kind, stage=type(self).__name__))
                     if tracer.enabled:
                         stack.enter_context(compilestats.fit_window())
+                        # FLINK_ML_TPU_PROFILE_CAPTURE=1 arms a device
+                        # profile of the next traced fit (one-shot;
+                        # observability/profiling.py) — a no-op context
+                        # otherwise
+                        from flink_ml_tpu.observability import profiling
+
+                        stack.enter_context(
+                            profiling.maybe_profile_fit(region))
                 if trace_dir:
                     stack.enter_context(profile(
                         os.path.join(trace_dir, region), name=region))

@@ -126,6 +126,12 @@ def _child_main(fn, lo, hi, wfd, chaos_action=None, parent_ctx=None):
     _drift = _sys.modules.get("flink_ml_tpu.observability.drift")
     if _drift is not None:
         _drift.reseed_child()
+    # device profiling is driver-only (the single jax.profiler slot
+    # belongs to the parent): pin capture shut in the child and replace
+    # its module lock rather than acquire it — same gating as above
+    _prof = _sys.modules.get("flink_ml_tpu.observability.profiling")
+    if _prof is not None:
+        _prof.reseed_child()
     try:
         if chaos_action is not None:
             # decided in the PARENT pre-fork so the schedule counter

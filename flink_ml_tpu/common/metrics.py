@@ -672,6 +672,27 @@ _trace_active = False  # jax.profiler allows one trace at a time
 _trace_lock = locks.make_lock("common.metrics.profile")
 
 
+def claim_profiler() -> bool:
+    """Atomically claim the process-wide single-trace slot. Returns True
+    when the caller now owns the profiler (and must call
+    :func:`release_profiler`), False when a trace is already active.
+    Shared by :func:`profile` and observability/profiling.py so every
+    capture path honors jax.profiler's one-trace-at-a-time invariant."""
+    global _trace_active
+    with _trace_lock:
+        if _trace_active:
+            return False
+        _trace_active = True
+        return True
+
+
+def release_profiler() -> None:
+    """Release the slot taken by :func:`claim_profiler` (idempotent)."""
+    global _trace_active
+    with _trace_lock:
+        _trace_active = False
+
+
 @contextlib.contextmanager
 def profile(trace_dir: str = None, name: str = None):
     """Profile a region: wall-time gauge always; a jax.profiler trace when
@@ -687,17 +708,14 @@ def profile(trace_dir: str = None, name: str = None):
     if trace_dir:
         # the check and the claim must be one atomic step: two concurrent
         # stages racing here would otherwise both call start_trace
-        with _trace_lock:
-            if not _trace_active:
-                _trace_active = tracing = True
+        tracing = claim_profiler()
     if tracing:
         try:
             jax.profiler.start_trace(trace_dir)
         except BaseException:
             # roll the claim back: a failed start must not disable
             # profiling for the rest of the process
-            with _trace_lock:
-                _trace_active = False
+            release_profiler()
             raise
     try:
         yield
@@ -709,8 +727,7 @@ def profile(trace_dir: str = None, name: str = None):
                 # release the claim even when stop_trace raises (e.g. a
                 # full disk writing the trace) — symmetric with the
                 # start-path rollback above
-                with _trace_lock:
-                    _trace_active = False
+                release_profiler()
         elapsed_ms = (time.perf_counter() - start) * 1000.0
         metrics.group(ML_GROUP).gauge("lastProfiledRegionMs", elapsed_ms)
         if name:

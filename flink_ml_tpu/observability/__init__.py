@@ -22,7 +22,14 @@ ops-controller cycle; ``flink-ml-tpu-trace path <dir>`` attributes
 per-request wall time along the span DAG, and the flight recorder
 (``flightrecorder``) dumps ``incident-<seq>/`` evidence bundles on SLO
 violations, divergence, drift and rollbacks — inspect with
-``flink-ml-tpu-trace incident <dir>``.
+``flink-ml-tpu-trace incident <dir>``. Device profiling (``profiling``)
+captures bounded ``jax.profiler`` windows (env-armed fits/batcher
+ticks, the live ``/profilez`` route, anomaly-triggered incident
+bundles), attributes per-op/per-fn measured device time into
+``profile.json``, and joins it with the XLA cost model into achieved
+FLOPs + roofline utilization — inspect with ``flink-ml-tpu-trace
+efficiency <dir>``; boot-to-ready phase telemetry (``boot.*`` spans,
+``bootToReadyMs``) rides in the same module.
 """
 
 from flink_ml_tpu.observability.compilestats import (
@@ -95,6 +102,17 @@ from flink_ml_tpu.observability.flightrecorder import (
     record_incident,
 )
 from flink_ml_tpu.observability.path import analyze_paths
+from flink_ml_tpu.observability.profiling import (
+    CAPTURE_ENV,
+    boot_phase,
+    boot_to_ready_ms,
+    capture_now,
+    efficiency_report,
+    mark_ready,
+    parse_profile_dir,
+    profile_window,
+    read_profile,
+)
 from flink_ml_tpu.observability.tracing import (
     TRACE_DIR_ENV,
     TRACE_PARENT_ENV,
@@ -133,10 +151,19 @@ __all__ = [
     "TraceContext",
     "TelemetryServer",
     "Tracer",
+    "CAPTURE_ENV",
     "acknowledge",
     "analyze_paths",
+    "boot_phase",
+    "boot_to_ready_ms",
+    "capture_now",
     "current_context",
+    "efficiency_report",
     "fresh_context",
+    "mark_ready",
+    "parse_profile_dir",
+    "profile_window",
+    "read_profile",
     "read_incidents",
     "record_incident",
     "aot_compile",

@@ -21,6 +21,7 @@ rerunning anything:
     flink-ml-tpu-trace incident TRACE_DIR --check  # flight recorder (exit 4)
     flink-ml-tpu-trace locks TRACE_DIR --check   # lock watchdog (exit 4)
     flink-ml-tpu-trace fleet DIR --check         # fleet membership (exit 4)
+    flink-ml-tpu-trace efficiency DIR --check --min-util 0.4  # roofline (exit 4)
     flink-ml-tpu-trace ROOT --latest             # newest trace dir under ROOT
 
 Sections: top spans by self-time (time in a span minus its children —
@@ -78,7 +79,14 @@ process of a multi-process runtime writes — membership with
 alive/stale/dead classification by beacon age, bin-exact fleet-level
 windowed quantiles, per-replica load rows — and with ``--check`` exits
 4 on a dead member or a violated fleet-scope SLO, 2 when the dir holds
-no fleet telemetry at all. Every
+no fleet telemetry at all. The ``efficiency`` subcommand
+(observability/profiling.py) joins a captured device profile's measured
+per-fn device time (``profile.json``) with the XLA cost model's
+FLOPs/bytes into achieved FLOP/s, achieved bandwidth and roofline
+utilization per jitted fn — with ``--check --min-util F`` exits 4 when
+any measured fn sits below the floor, 2 on missing/torn artifacts, and
+0 on an honest ``source: host-fallback`` CPU profile (which claims no
+utilization at all). Every
 subcommand accepts ``--latest``:
 treat the positional dir as a root and resolve the newest trace dir
 under it (exporters.resolve_trace_dir) — no more hand-globbing.
@@ -303,6 +311,15 @@ def main(argv=None) -> int:
         from flink_ml_tpu.observability.fleet import main as fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "efficiency":
+        # measured device time vs XLA cost model
+        # (observability/profiling.py); same dispatch rule — use
+        # ./efficiency to summarize a directory named "efficiency"
+        from flink_ml_tpu.observability.profiling import (
+            main as efficiency_main,
+        )
+
+        return efficiency_main(argv[1:])
     if argv and argv[0] == "summary":
         # explicit subcommand spelling for the default view, so
         # unattended consumers can write `summary --json` without

@@ -16,7 +16,13 @@ benchmark results file reduced to a snapshot). The diff reports:
   phaseMs{phase=...}`` histograms: count and summed ms per monitoring
   phase), so a gate trip distinguishes "B compiles MORE" from "B's
   compiles got SLOWER" — two different regressions with two different
-  fixes.
+  fixes,
+- **per-fn efficiency rows** (when a ``profile.json`` device-profile
+  artifact sits beside a side's artifacts —
+  observability/profiling.py): measured device ms and roofline
+  utilization per jitted fn, so "slower because lower utilization"
+  reads apart from "slower because more work". Reported, not gated —
+  the efficiency floor lives in ``mltrace efficiency --check``.
 
 ``--budget <pct>`` turns the report into a regression gate: exit
 :data:`EXIT_BUDGET` (4) when side B regresses side A beyond the budget.
@@ -95,12 +101,25 @@ def load_side(path: str) -> dict:
         if not spans and not snap:
             raise ValueError(
                 f"{path}: no spans-*.jsonl or metrics-*.json artifacts")
-        return {"spans": aggregate_self_time(spans), "metrics": snap}
+        # per-fn efficiency rides along when a profile.json sits beside
+        # the artifacts (observability/profiling.py) — so the diff can
+        # tell "slower because lower utilization" from "slower because
+        # more work". Best-effort: most sides have no profile
+        eff: Dict[str, dict] = {}
+        try:
+            from flink_ml_tpu.observability import profiling
+
+            report = profiling.efficiency_report(path, snapshot=snap)
+            eff = {row["fn"]: row for row in report["fns"]}
+        except Exception:  # noqa: BLE001 — optional evidence
+            pass
+        return {"spans": aggregate_self_time(spans), "metrics": snap,
+                "efficiency": eff}
     with open(path, "r", encoding="utf-8") as f:
         snap = json.load(f)
     if not isinstance(snap, dict) or not snap:
         raise ValueError(f"{path}: not a metrics snapshot")
-    return {"spans": {}, "metrics": snap}
+    return {"spans": {}, "metrics": snap, "efficiency": {}}
 
 
 # -- delta computation --------------------------------------------------------
@@ -192,9 +211,27 @@ def diff_profiles(a: dict, b: dict) -> dict:
             "delta_pct": _pct(ra["ms"], rb["ms"])})
     phase_rows.sort(key=lambda r: -abs(r["delta_ms"]))
 
+    # per-fn efficiency deltas (profile.json sides only): measured
+    # device ms + roofline utilization — reported, never gated (the
+    # efficiency gate is `mltrace efficiency --check`, with real floors)
+    ea, eb = a.get("efficiency") or {}, b.get("efficiency") or {}
+    eff_rows = []
+    for fn in sorted(set(ea) | set(eb)):
+        ra, rb = ea.get(fn) or {}, eb.get(fn) or {}
+        eff_rows.append({
+            "fn": fn,
+            "a_device_ms": ra.get("deviceMs"),
+            "b_device_ms": rb.get("deviceMs"),
+            "a_utilization": ra.get("utilization"),
+            "b_utilization": rb.get("utilization"),
+            "a_achieved_flops": ra.get("achievedFlops"),
+            "b_achieved_flops": rb.get("achievedFlops"),
+            "bound": rb.get("bound") or ra.get("bound")})
+
     return {"spans": span_rows, "histograms": hist_rows,
             "compile": compile_rows,
             "compile_phases": phase_rows,
+            "efficiency": eff_rows,
             "compile_totals": {"a": totals_a, "b": totals_b},
             # span gating needs span data on BOTH sides: against a
             # metrics-only side (a snapshot file, or a dir that captured
@@ -285,6 +322,24 @@ def render_diff(diff: dict, viol: List[dict], top_n: int = 15) -> str:
                 f"compiles, {row['a_ms']:.1f}→{row['b_ms']:.1f} ms "
                 f"({row['delta_ms']:+.1f} ms, "
                 f"{_fmt_pct(row['delta_pct']).strip()})")
+
+    effs = diff.get("efficiency") or ()
+    if effs:
+        out.append("")
+        out.append("per-fn efficiency (measured device ms / roofline "
+                   "utilization — reported, not gated):")
+        for row in effs[:top_n]:
+            ua, ub = row["a_utilization"], row["b_utilization"]
+            out.append(
+                "  {}: deviceMs {}→{}  util {}→{}  bound={}".format(
+                    row["fn"],
+                    "—" if row["a_device_ms"] is None
+                    else f"{row['a_device_ms']:.3f}",
+                    "—" if row["b_device_ms"] is None
+                    else f"{row['b_device_ms']:.3f}",
+                    "—" if ua is None else f"{ua * 100.0:.1f}%",
+                    "—" if ub is None else f"{ub * 100.0:.1f}%",
+                    row["bound"] or "—"))
 
     if viol:
         out.append("")

@@ -233,6 +233,14 @@ def _load_payload() -> dict:
         load["elasticEvents"] = prov.get("elasticEvents")
     except Exception:
         pass
+    try:
+        from flink_ml_tpu.observability import profiling
+
+        ready_ms = profiling.boot_to_ready_ms()
+        if ready_ms is not None:
+            load["bootToReadyMs"] = round(ready_ms, 3)
+    except Exception:
+        pass
     return load
 
 
@@ -788,16 +796,20 @@ def render_report(report: dict) -> str:
                 f"members={agg['members']}")
     loaded = [row for row in report["load"]
               if any(row.get(k) is not None for k in
-                     ("queueDepth", "inFlight", "servable"))]
+                     ("queueDepth", "inFlight", "servable",
+                      "bootToReadyMs"))]
     if loaded:
         lines.append("load:")
         for row in loaded:
+            boot = row.get("bootToReadyMs")
             lines.append(
                 f"  {row['member']:<8} queueDepth="
                 f"{row.get('queueDepth')} inFlight={row.get('inFlight')} "
                 f"servable={row.get('servable')} "
                 f"version={row.get('modelVersion')} "
-                f"canary={row.get('canary')}")
+                f"canary={row.get('canary')}"
+                + (f" bootToReadyMs={boot:.0f}" if boot is not None
+                   else ""))
     return "\n".join(lines)
 
 

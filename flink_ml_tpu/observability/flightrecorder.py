@@ -40,6 +40,11 @@ incident it records)::
       drift.json           drift report at trigger time (non-emitting)
       controller.json      /controller provider state, when registered
       mesh.json            copied from the trace dir when present
+      profile/             a short bounded device profile of the anomaly's
+      profile.json         aftermath + its per-op attribution, when a jax
+                           backend is live (observability/profiling.py;
+                           length ``FLINK_ML_TPU_INCIDENT_PROFILE_MS``,
+                           default 200, 0 disables)
 
 Bundles are **debounced** (``FLINK_ML_TPU_INCIDENT_DEBOUNCE_S``,
 default 30 — one incident usually fires several triggers in a burst:
@@ -303,6 +308,17 @@ def _dump(trace_dir: str, kind: str, attrs: dict) -> str:
             shutil.copyfile(mesh_src, os.path.join(tmp, "mesh.json"))
         except OSError:
             pass
+    # a short bounded device profile of the anomaly's aftermath — raw
+    # trace under profile/, attribution at profile.json. profiling
+    # refuses on its own (kill-switch, non-driver, backend not live,
+    # another trace active) rather than block the dump
+    profiled = False
+    try:
+        from flink_ml_tpu.observability import profiling
+
+        profiled = profiling.capture_incident_profile(tmp)
+    except Exception:  # noqa: BLE001 — optional evidence
+        pass
 
     from flink_ml_tpu.observability.exporters import safe_process_label
 
@@ -323,6 +339,7 @@ def _dump(trace_dir: str, kind: str, attrs: dict) -> str:
         "evidence_truncated": (
             tracing.tracer.recent.maxlen is not None
             and len(spans) >= tracing.tracer.recent.maxlen),
+        "device_profile": profiled,
         "acknowledged": False,
     }
     _write_json(os.path.join(tmp, INCIDENT_FILE), meta)

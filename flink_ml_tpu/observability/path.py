@@ -33,6 +33,12 @@ reports the attributed fraction and the acceptance bar is >= 0.9.
 Epochs reuse the host/device split the iteration seams already attach
 (``host_ms``/``device_ms`` epoch-span attrs).
 
+The ``device`` segment is one opaque block to the span DAG — when a
+captured device profile's ``profile.json`` sits beside the trace
+(observability/profiling.py), :func:`attach_device_ops` sub-attributes
+it to the top ops by measured self-time, so a budget verdict names the
+owning op instead of "the device was slow".
+
 CLI: ``flink-ml-tpu-trace path <dir> [--trace ID] [--json]
 [--check [--budget PCT]]`` — ``--check`` exits 2 when the trace holds
 no path-analyzable requests; with ``--budget`` it additionally exits 4
@@ -49,7 +55,7 @@ from typing import Dict, List, Optional
 __all__ = [
     "EXIT_OK", "EXIT_INVALID", "EXIT_OVER_BUDGET",
     "REQUEST_SEGMENTS", "QUEUE_SEGMENTS",
-    "analyze_paths", "render_paths", "main",
+    "analyze_paths", "attach_device_ops", "render_paths", "main",
 ]
 
 EXIT_OK = 0
@@ -232,6 +238,30 @@ def analyze_paths(spans: List[dict],
     return report
 
 
+def attach_device_ops(report: dict, trace_dir: str,
+                      top: int = 3) -> dict:
+    """Sub-attribute the opaque device segment: when a ``profile.json``
+    device-profile artifact sits beside the trace
+    (observability/profiling.py), attach its top ops by measured
+    self-time as ``report["device_ops"]`` — so a ``--budget`` verdict
+    names the op that owns the device time instead of one black-box
+    block. Best-effort: without an artifact the report is unchanged."""
+    try:
+        from flink_ml_tpu.observability import profiling
+
+        profile = profiling.read_profile(trace_dir)
+    except Exception:  # noqa: BLE001 — most traces carry no profile
+        return report
+    ops = profile.get("ops") or []
+    report["device_ops"] = {
+        "source": profile.get("source"),
+        "ops": [{"op": row["op"], "fn": row["fn"],
+                 "selfMs": row["selfMs"], "count": row["count"]}
+                for row in ops[:top]],
+    }
+    return report
+
+
 def render_paths(report: dict, top_n: int = 5) -> str:
     req = report["requests"]
     out = [f"{report['spans']} span(s) across {report['traces']} "
@@ -247,6 +277,15 @@ def render_paths(report: dict, top_n: int = 5) -> str:
             share = req["segment_share"][name]
             out.append(f"  {name:<10} {req['segments_ms'][name]:>12.3f}"
                        f" {share:>7.1%}")
+        device_ops = report.get("device_ops")
+        if device_ops and device_ops.get("ops"):
+            src = device_ops.get("source")
+            out.append("")
+            out.append(f"  device segment, top op(s) by measured "
+                       f"self-time (source: {src}):")
+            for row in device_ops["ops"]:
+                out.append(f"    {row['op']} (fn={row['fn']}): "
+                           f"{row['selfMs']:.3f} ms x{row['count']}")
         if report["slowest"]:
             out.append("")
             out.append("  slowest request(s):")
@@ -318,6 +357,7 @@ def main(argv=None) -> int:
               f"{args.trace_dir}: {e}", file=sys.stderr)
         return EXIT_INVALID
     report = analyze_paths(spans, trace=args.trace)
+    attach_device_ops(report, trace_dir)
     with pipe_guard():
         if args.json:
             print(json.dumps({"trace_dir": trace_dir,

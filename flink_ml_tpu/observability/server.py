@@ -55,6 +55,12 @@ route             serves                                      response with no d
                   alive/stale/dead classification, bin-exact   no member wrote a beacon
                   windowed fleet quantiles folded across       yet
                   member beacons, per-replica load rows
+``/profilez``     on-demand bounded device profile             409 — capture killed
+                  (observability/profiling.py): ``?ms=250``    (``FLINK_ML_TPU_PROFILE_``
+                  captures a window (clamped to                ``CAPTURE=0``), another
+                  ``FLINK_ML_TPU_PROFILEZ_MAX_MS``), answers   trace already active, or
+                  with the parsed per-op/per-fn attribution;   not the driver process
+                  one at a time, driver only
 ================  ==========================================  =============================
 
 Any other path: 404 JSON naming the known routes.
@@ -122,6 +128,9 @@ ROUTE_TABLE = {
     "/fleet": ("_route_fleet",
                '200 {"fleet": null} — no fleet dir resolves '
                '(observability/fleet.py) or no beacons written yet'),
+    "/profilez": ("_route_profilez",
+                  "409 — capture killed, another trace active, or not "
+                  "the driver process (observability/profiling.py)"),
 }
 
 ROUTES = tuple(ROUTE_TABLE)
@@ -345,6 +354,36 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, json.dumps(
             _json_safe({"fleet": view.report()}), default=str),
             _JSON_CTYPE)
+
+    def _route_profilez(self) -> None:
+        # on-demand device profile: /profilez?ms=250 captures a bounded
+        # window (clamped to FLINK_ML_TPU_PROFILEZ_MAX_MS) and answers
+        # with the parsed attribution. One at a time, driver only —
+        # profiling.capture_now refuses (→ 409) rather than queue: a
+        # scraper must never stack blocking capture windows.
+        from urllib.parse import parse_qs, urlsplit
+
+        from flink_ml_tpu.observability import profiling
+
+        query = parse_qs(urlsplit(self.path).query)
+        try:
+            ms = int(query.get("ms", ["200"])[0])
+            if ms <= 0:
+                raise ValueError(ms)
+        except (TypeError, ValueError):
+            self._send(400, json.dumps(
+                {"error": "ms must be a positive integer",
+                 "example": "/profilez?ms=250"}), _JSON_CTYPE)
+            return
+        result = profiling.capture_now(ms)
+        if result is None:
+            self._send(409, json.dumps(
+                {"error": "capture refused: disabled "
+                          f"({profiling.CAPTURE_ENV}=0), another trace "
+                          "active, or not the driver process"}),
+                _JSON_CTYPE)
+            return
+        self._send(200, json.dumps(result, default=str), _JSON_CTYPE)
 
     def do_GET(self):  # noqa: N802 — http.server's casing
         path = self.path.split("?", 1)[0]

@@ -135,11 +135,15 @@ def init_distributed(coordinator: Optional[str] = None,
         except Exception:  # pragma: no cover — option absent on this line
             pass
 
+    from flink_ml_tpu.observability import profiling
     from flink_ml_tpu.parallel import mesh as _mesh
 
-    return _mesh.init_distributed(coordinator_address=coordinator,
-                                  num_processes=num_processes,
-                                  process_id=process_id, **kwargs)
+    # the distributed-init rung of the boot ladder (ml.boot
+    # phaseMs{phase="distributed-init"}, observability/profiling.py)
+    with profiling.boot_phase("distributed-init"):
+        return _mesh.init_distributed(coordinator_address=coordinator,
+                                      num_processes=num_processes,
+                                      process_id=process_id, **kwargs)
 
 
 def init_from_env() -> bool:
@@ -219,26 +223,31 @@ def build_mesh(local_axis: Optional[int] = None):
 
     import jax
 
+    from flink_ml_tpu.observability import profiling
     from flink_ml_tpu.parallel.mesh import (
         DATA_AXIS, DCN_AXIS, create_mesh)
 
-    if jax.process_count() <= 1:
-        return create_mesh()
-    devices = sorted(jax.devices(),
-                     key=lambda d: (int(getattr(d, "process_index", 0)),
-                                    int(d.id)))
-    n_proc = jax.process_count()
-    per_proc = len(devices) // n_proc
-    if local_axis is not None:
-        if per_proc % int(local_axis):
-            raise ValueError(
-                f"local_axis={local_axis} does not divide the "
-                f"{per_proc} devices each process contributes")
-        per_proc = int(local_axis)
-    arr = np.asarray(devices).reshape(n_proc, per_proc)
-    from jax.sharding import Mesh
+    # the mesh-build rung of the boot ladder — on a cold runtime the
+    # first jax.devices() call below pays backend/client init
+    with profiling.boot_phase("mesh-build"):
+        if jax.process_count() <= 1:
+            return create_mesh()
+        devices = sorted(
+            jax.devices(),
+            key=lambda d: (int(getattr(d, "process_index", 0)),
+                           int(d.id)))
+        n_proc = jax.process_count()
+        per_proc = len(devices) // n_proc
+        if local_axis is not None:
+            if per_proc % int(local_axis):
+                raise ValueError(
+                    f"local_axis={local_axis} does not divide the "
+                    f"{per_proc} devices each process contributes")
+            per_proc = int(local_axis)
+        arr = np.asarray(devices).reshape(n_proc, per_proc)
+        from jax.sharding import Mesh
 
-    return Mesh(arr, (DCN_AXIS, DATA_AXIS))
+        return Mesh(arr, (DCN_AXIS, DATA_AXIS))
 
 
 # -- the CI launcher ----------------------------------------------------------

@@ -70,7 +70,7 @@ from flink_ml_tpu.common.locks import (
     make_condition,
 )
 from flink_ml_tpu.common.metrics import ML_GROUP, RATIO_BUCKETS, metrics
-from flink_ml_tpu.observability import tracing
+from flink_ml_tpu.observability import profiling, tracing
 from flink_ml_tpu.observability.health import (
     COUNT_BUCKETS,
     SERVING_HORIZON_S,
@@ -680,6 +680,10 @@ class MicroBatcher:
             self._dispatch_guarded(prepared)
 
     def _dispatch_device(self, prep: _Prepared) -> None:
+        # FLINK_ML_TPU_PROFILE_CAPTURE=1 arms a device profile spanning
+        # the next N dispatch ticks (observability/profiling.py); the
+        # unarmed steady state pays one env read
+        profiling.batch_tick()
         kept = prep.requests
         now = time.perf_counter()
         # deadlines re-checked HERE, not just at pad time: a request

@@ -301,8 +301,13 @@ class ModelRegistry:
         return False
 
     def _adopt(self, version: int) -> None:
-        candidate = self.load_candidate(version)
-        self._commit(candidate, version)
+        # the registry-adopt rung of the boot ladder (a no-op once the
+        # process marked ready — steady-state adoptions are not boot)
+        from flink_ml_tpu.observability import profiling
+
+        with profiling.boot_phase("registry-adopt"):
+            candidate = self.load_candidate(version)
+            self._commit(candidate, version)
 
     def load_candidate(self, version: int):
         """Validate, load, baseline-install and probe published version

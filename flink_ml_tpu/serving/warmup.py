@@ -25,7 +25,7 @@ import time
 from typing import Callable, Optional, Sequence
 
 from flink_ml_tpu.common.metrics import ML_GROUP, metrics
-from flink_ml_tpu.observability import tracing
+from flink_ml_tpu.observability import profiling, tracing
 from flink_ml_tpu.observability.compilestats import compile_totals_split
 
 __all__ = ["WARMUP_GATE", "compile_count", "warm"]
@@ -107,18 +107,21 @@ def warm(target,
     before = compile_count()
     t_start = time.perf_counter()
     try:
-        for rows in bucket_list:
-            t0 = time.perf_counter()
-            if hasattr(servable, "aot_warm"):
-                servable.aot_warm(rows)
-            elif frame_factory is not None:
-                servable.transform(frame_factory(rows))
-            else:
-                raise ValueError(
-                    f"servable {type(servable).__name__} has no "
-                    f"aot_warm and no frame_factory was given")
-            report["buckets"][rows] = round(
-                (time.perf_counter() - t0) * 1000.0, 3)
+        # the warmup-compile rung of the boot ladder (ml.boot
+        # phaseMs{phase="warmup-compile"}, observability/profiling.py)
+        with profiling.boot_phase("warmup-compile"):
+            for rows in bucket_list:
+                t0 = time.perf_counter()
+                if hasattr(servable, "aot_warm"):
+                    servable.aot_warm(rows)
+                elif frame_factory is not None:
+                    servable.transform(frame_factory(rows))
+                else:
+                    raise ValueError(
+                        f"servable {type(servable).__name__} has no "
+                        f"aot_warm and no frame_factory was given")
+                report["buckets"][rows] = round(
+                    (time.perf_counter() - t0) * 1000.0, 3)
     except Exception as e:
         if gate:
             server.set_gate(WARMUP_GATE, False,
@@ -136,5 +139,9 @@ def warm(target,
                          compiles=report["compiles"],
                          mesh_devices=n_devices)
     if gate:
-        server.set_gate(WARMUP_GATE, True)
+        # gate-open closes the boot ladder: the process is ready for
+        # traffic — latch bootToReadyMs for the fleet beacon
+        with profiling.boot_phase("gate-open"):
+            server.set_gate(WARMUP_GATE, True)
+        profiling.mark_ready()
     return report

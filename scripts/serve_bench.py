@@ -722,6 +722,13 @@ def main(argv=None) -> int:
 
     drift.drift_report(emit=False)  # refresh the per-servable stats
     record.update(drift.provenance())
+    # device-efficiency provenance (observability/profiling.py): the
+    # hottest measured fn's utilization/achieved FLOPs when a profile
+    # was captured beside this run's trace — null on host-fallback (a
+    # CPU run honestly claims no utilization) or with no capture armed
+    from flink_ml_tpu.observability import profiling
+
+    record.update(profiling.provenance(trace_dir))
     with open(args.output, "w", encoding="utf-8") as f:
         json.dump(record, f, indent=2)
     print(f"serve_bench: wrote {args.output}")

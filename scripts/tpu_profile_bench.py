@@ -24,7 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from flink_ml_tpu.observability import compilestats, tracing
+from flink_ml_tpu.observability import compilestats, profiling, tracing
 
 ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 
@@ -113,6 +113,24 @@ def _profile():
                                                *carry()))
             sp.set_attribute("best_wall_ms", round(best * 1e3, 3))
             compilestats.sample_memory("program", span=sp)
+
+    # a captured window over the headline 20-round program: per-op
+    # device-time attribution + profile.json through the shared capture
+    # path (observability/profiling.py) — no hand-rolled profiler calls
+    prof_dir = os.path.join(ROOT, "profiles", "bench_lloyd20")
+    fit20_c = compilestats.aot_compile(
+        _build_lloyd_program(mesh, "euclidean", 20), xs, jnp.int32(n),
+        *carry(), name="lloyd_20_profiled")
+    with profiling.profile_window("bench-lloyd20", out_dir=prof_dir):
+        jax.block_until_ready(fit20_c(xs, jnp.int32(n), *carry()))
+    print("\nlloyd 20-round device ops (profile.json in "
+          f"{os.path.relpath(prof_dir, ROOT)}):")
+    try:
+        for row in profiling.parse_profile_dir(prof_dir)["ops"][:10]:
+            print(f"  {row['selfMs']:10.2f} ms  x{row['count']:4d}  "
+                  f"{row['op'][:72]}")
+    except profiling.ProfileParseError as e:
+        print(f"  (no trace captured: {e})")
 
     from flink_ml_tpu.ops.losses import BinaryLogisticLoss
     from flink_ml_tpu.ops.optimizer import SGD, SGDParams

@@ -23,10 +23,6 @@ a TPU window's artifacts are ``flink-ml-tpu-trace``-readable — and
 stdout.
 """
 
-import collections
-import glob
-import gzip
-import json
 import os
 import sys
 import time
@@ -35,30 +31,28 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np  # noqa: E402
 
-from flink_ml_tpu.observability import compilestats, tracing  # noqa: E402
+from flink_ml_tpu.observability import (  # noqa: E402
+    compilestats,
+    profiling,
+    tracing,
+)
 
 ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 
 
 def device_op_table(profile_dir: str, top: int = 14) -> None:
-    traces = sorted(glob.glob(os.path.join(
-        profile_dir, "**", "*.trace.json.gz"), recursive=True))
-    if not traces:
-        print("  (no trace captured)")
+    """Print the per-op device-time aggregate of the newest trace under
+    ``profile_dir`` — the shared parser (observability/profiling.py)."""
+    try:
+        report = profiling.parse_profile_dir(profile_dir)
+    except profiling.ProfileParseError as e:
+        print(f"  (no trace captured: {e})")
         return
-    with gzip.open(traces[-1]) as f:
-        d = json.load(f)
-    ev = d.get("traceEvents", [])
-    device_pids = {e["pid"] for e in ev
-                   if e.get("ph") == "M" and e.get("name") == "process_name"
-                   and "TPU" in e["args"].get("name", "")}
-    dur, cnt = collections.Counter(), collections.Counter()
-    for e in ev:
-        if e.get("ph") == "X" and e.get("pid") in device_pids:
-            dur[e["name"]] += e.get("dur", 0)
-            cnt[e["name"]] += 1
-    for n, us in dur.most_common(top):
-        print(f"  {us / 1000:10.2f} ms  x{cnt[n]:4d}  {n[:80]}")
+    if report["source"] != "device":
+        print(f"  (source: {report['source']})")
+    for row in report["ops"][:top]:
+        print(f"  {row['selfMs']:10.2f} ms  x{row['count']:4d}  "
+              f"{row['op'][:72]} fn={row['fn']}")
 
 
 def timed(fn, repeat=3):
@@ -155,7 +149,11 @@ def _profile_programs() -> int:
             best = timed(lambda: compiled(*sgd_args(label)))
             sp.set_attribute("best_wall_ms", round(best * 1e3, 3))
             compilestats.sample_memory("program", span=sp)
-            with jax.profiler.trace(prof_dir):
+            # profile_window (observability/profiling.py): the capture
+            # claim + per-op attribution artifact instead of a bare
+            # jax.profiler.trace — profile.json lands in prof_dir
+            with profiling.profile_window(f"sgd-{label}",
+                                          out_dir=prof_dir):
                 jax.block_until_ready(compiled(*sgd_args(label)))
         print(f"SGD {label}: best wall {best * 1e3:.1f} ms; device ops:")
         device_op_table(prof_dir)
@@ -182,7 +180,7 @@ def _profile_programs() -> int:
         sp.set_attribute("best_wall_ms", round(best * 1e3, 3))
         compilestats.sample_memory("program", span=sp)
         prof_dir = os.path.join(ROOT, "profiles", "northstar_kmeans_r4")
-        with jax.profiler.trace(prof_dir):
+        with profiling.profile_window("kmeans-lloyd10", out_dir=prof_dir):
             jax.block_until_ready(fit_c(xs, jnp.int32(n), *km_carry()))
     print(f"\nKMeans lloyd 10 rounds: best wall {best * 1e3:.1f} ms; "
           "device ops:")

@@ -1,0 +1,582 @@
+"""Device profiling & efficiency plane (observability/profiling.py).
+
+Pins the ISSUE 19 contracts: the stdlib Chrome-trace parser attributes
+device-lane self-time per op and per jitted fn against committed golden
+fixtures (a device-laned TPU trace, a host-only CPU trace, a torn gzip
+that must exit 2 — never stack-trace); the efficiency join reproduces
+hand-computed achieved-FLOPs / roofline-utilization numbers and refuses
+to claim utilization on host-fallback profiles; ``/profilez`` captures
+are bounded, one-at-a-time, driver-only; the flight recorder's incident
+bundle carries a bounded profile; ``CAPTURE_ENV=0`` kills EVERY capture
+path; forked children never profile; and the boot-to-ready ladder
+latches ``bootToReadyMs`` into fleet beacons and ``mltrace fleet``.
+
+Capture-path tests monkeypatch the ``_profiler_start/_profiler_stop``
+seams with fakes that drop a fixture trace into the capture dir, so the
+coverage does not depend on the CI host's profiler emitting device
+lanes (CPU CI cannot).
+"""
+
+import json
+import os
+import shutil
+import urllib.error
+import urllib.request
+
+import pytest
+
+from flink_ml_tpu.common import metrics as metrics_mod
+from flink_ml_tpu.common.metrics import MetricsRegistry, metrics
+from flink_ml_tpu.observability import (
+    fleet,
+    flightrecorder,
+    path as path_mod,
+    profiling,
+    server,
+    tracing,
+)
+from flink_ml_tpu.observability.exporters import dump_metrics
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "profiling")
+DEVICE_FIXTURE = os.path.join(FIXTURES, "device.trace.json.gz")
+HOST_FIXTURE = os.path.join(FIXTURES, "host.trace.json.gz")
+TORN_FIXTURE = os.path.join(FIXTURES, "torn.trace.json.gz")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    for var in (profiling.CAPTURE_ENV, profiling.TICKS_ENV,
+                profiling.INCIDENT_MS_ENV, profiling.PROFILEZ_MAX_MS_ENV,
+                profiling.PEAK_FLOPS_ENV, profiling.PEAK_BW_ENV,
+                flightrecorder.DEBOUNCE_ENV, flightrecorder.MAX_ENV,
+                tracing.TRACE_DIR_ENV):
+        monkeypatch.delenv(var, raising=False)
+    server.stop()
+    flightrecorder.reset()
+    profiling.reset()
+    profiling.reset_boot()
+    yield
+    tracing.tracer.shutdown()
+    server.stop()
+    flightrecorder.reset()
+    profiling.reset()
+    profiling.reset_boot()
+    metrics_mod.release_profiler()
+
+
+def _fake_profiler(monkeypatch, fixture=DEVICE_FIXTURE):
+    """Wire the capture seams to a fake that 'captures' a fixture."""
+    state = {"dir": None, "starts": 0, "stops": 0}
+
+    def fake_start(log_dir):
+        state["dir"] = log_dir
+        state["starts"] += 1
+
+    def fake_stop():
+        state["stops"] += 1
+        os.makedirs(state["dir"], exist_ok=True)
+        shutil.copyfile(
+            fixture, os.path.join(state["dir"], "local.trace.json.gz"))
+
+    monkeypatch.setattr(profiling, "_profiler_start", fake_start)
+    monkeypatch.setattr(profiling, "_profiler_stop", fake_stop)
+    return state
+
+
+def _cost_gauges(fn="sgd_unrolled", flops=4e9, nbytes=2e7):
+    grp = metrics.group("ml", "device")
+    grp.gauge("programFlops", flops, labels={"fn": fn})
+    grp.gauge("programBytes", nbytes, labels={"fn": fn})
+
+
+# -- parser goldens -----------------------------------------------------------
+
+def test_parse_device_fixture_golden():
+    report = profiling.parse_trace_file(DEVICE_FIXTURE)
+    assert report["source"] == "device"
+    assert report["totalMs"] == pytest.approx(2.8)
+    fns = {r["fn"]: r for r in report["fns"]}
+    assert fns["sgd_unrolled"]["deviceMs"] == pytest.approx(2.0)
+    assert fns["sgd_unrolled"]["count"] == 1
+    assert fns["kmeans"]["deviceMs"] == pytest.approx(0.8)
+    # ops sorted by self-time descending; the host lane's 9 ms
+    # HostCallback never appears — device lanes only
+    assert [(r["op"], r["fn"]) for r in report["ops"]] == [
+        ("fusion.1", "sgd_unrolled"), ("fusion.2", "kmeans"),
+        ("copy.3", "sgd_unrolled")]
+    assert report["ops"][0]["selfMs"] == pytest.approx(1.5)
+    assert all(r["op"] != "HostCallback" for r in report["ops"])
+
+
+def test_parse_host_fixture_degrades_honestly():
+    report = profiling.parse_trace_file(HOST_FIXTURE)
+    assert report["source"] == "host-fallback"
+    fns = {r["fn"]: r for r in report["fns"]}
+    assert fns["kmeans"]["deviceMs"] == pytest.approx(4.2)
+    ops = {r["op"]: r for r in report["ops"]}
+    # unattributable host ops stay visible but fold to fn=unknown
+    assert ops["XlaModule"]["fn"] == "unknown"
+    assert ops["convert_element_type"]["fn"] == "kmeans"
+
+
+def test_torn_gzip_is_a_parse_error_not_a_stack_trace(tmp_path):
+    with pytest.raises(profiling.ProfileParseError):
+        profiling.parse_trace_file(TORN_FIXTURE)
+    shutil.copyfile(TORN_FIXTURE,
+                    str(tmp_path / "torn.trace.json.gz"))
+    with pytest.raises(profiling.ProfileParseError):
+        profiling.parse_profile_dir(str(tmp_path))
+
+
+def test_parse_profile_dir_empty_and_newest(tmp_path):
+    with pytest.raises(profiling.ProfileParseError, match="no .*trace"):
+        profiling.parse_profile_dir(str(tmp_path))
+    # nested like the real profiler's plugins/profile/<run>/ layout
+    nested = tmp_path / "plugins" / "profile" / "run1"
+    nested.mkdir(parents=True)
+    shutil.copyfile(DEVICE_FIXTURE,
+                    str(nested / "host.trace.json.gz"))
+    report = profiling.parse_profile_dir(str(tmp_path))
+    assert report["source"] == "device"
+    assert report["traceFile"].endswith("host.trace.json.gz")
+
+
+def test_artifact_roundtrip_and_validation(tmp_path):
+    report = profiling.parse_trace_file(DEVICE_FIXTURE)
+    profiling.write_profile_artifact(str(tmp_path), report)
+    back = profiling.read_profile(str(tmp_path))
+    assert back["fns"] == report["fns"]
+    with pytest.raises(profiling.ProfileParseError):
+        profiling.read_profile(str(tmp_path / "nope"))
+    (tmp_path / "bad").mkdir()
+    (tmp_path / "bad" / profiling.PROFILE_ARTIFACT).write_text("[]")
+    with pytest.raises(profiling.ProfileParseError):
+        profiling.read_profile(str(tmp_path / "bad"))
+
+
+# -- efficiency join ----------------------------------------------------------
+
+def _snapshot(fn="sgd_unrolled", flops=4e9, nbytes=2e7):
+    gauges = {}
+    if flops is not None:
+        gauges[f'programFlops{{fn="{fn}"}}'] = flops
+    if nbytes is not None:
+        gauges[f'programBytes{{fn="{fn}"}}'] = nbytes
+    return {"ml.device": {"gauges": gauges}}
+
+
+def test_efficiency_join_hand_computed_compute_bound():
+    profile = profiling.parse_trace_file(DEVICE_FIXTURE)
+    report = profiling.efficiency_report(
+        None, profile=profile, snapshot=_snapshot(),
+        pf=4e12, pb=2e10)
+    assert report["ridge"] == pytest.approx(200.0)
+    rows = {r["fn"]: r for r in report["fns"]}
+    sgd = rows["sgd_unrolled"]
+    # 4e9 FLOPs over the measured 2.0 ms → 2e12 FLOP/s; intensity
+    # 4e9/2e7 = 200 = ridge → compute-bound; utilization 2e12/4e12
+    assert sgd["achievedFlops"] == pytest.approx(2e12)
+    assert sgd["achievedBw"] == pytest.approx(1e10)
+    assert sgd["bound"] == "compute"
+    assert sgd["utilization"] == pytest.approx(0.5)
+    # kmeans carries no cost gauges: measured ms but nothing achieved
+    assert rows["kmeans"]["achievedFlops"] is None
+    assert rows["kmeans"]["utilization"] is None
+
+
+def test_efficiency_join_bandwidth_bound_roof():
+    profile = profiling.parse_trace_file(DEVICE_FIXTURE)
+    report = profiling.efficiency_report(
+        None, profile=profile,
+        snapshot=_snapshot(flops=1e6, nbytes=1e6), pf=4e12, pb=2e10)
+    sgd = {r["fn"]: r for r in report["fns"]}["sgd_unrolled"]
+    # intensity 1 << ridge 200 → bandwidth-bound: utilization measures
+    # against the bandwidth roof scaled by intensity, pb * 1
+    assert sgd["bound"] == "bandwidth"
+    assert sgd["achievedFlops"] == pytest.approx(1e6 / 0.002)
+    assert sgd["utilization"] == pytest.approx((1e6 / 0.002) / 2e10)
+
+
+def test_efficiency_host_fallback_claims_nothing():
+    profile = profiling.parse_trace_file(HOST_FIXTURE)
+    report = profiling.efficiency_report(
+        None, profile=profile,
+        snapshot=_snapshot(fn="kmeans"), pf=4e12, pb=2e10)
+    assert report["source"] == "host-fallback"
+    for row in report["fns"]:
+        assert row["achievedFlops"] is None
+        assert row["achievedBw"] is None
+        assert row["utilization"] is None
+        assert row["bound"] is None
+    rendered = profiling.render_efficiency(report)
+    assert "host-fallback" in rendered and "not claimed" in rendered
+
+
+# -- the efficiency CLI (exit-code contract) ----------------------------------
+
+def _golden_trace_dir(tmp_path, fixture=DEVICE_FIXTURE):
+    d = str(tmp_path / "trace")
+    os.makedirs(d, exist_ok=True)
+    profiling.write_profile_artifact(
+        d, profiling.parse_trace_file(fixture))
+    _cost_gauges()
+    dump_metrics(d)
+    return d
+
+
+def test_cli_exit2_on_missing_or_torn_artifacts(tmp_path, capsys):
+    assert profiling.main([str(tmp_path)]) == profiling.EXIT_INVALID
+    (tmp_path / profiling.PROFILE_ARTIFACT).write_text("{not json")
+    assert profiling.main([str(tmp_path)]) == profiling.EXIT_INVALID
+    assert "efficiency:" in capsys.readouterr().err
+
+
+def test_cli_device_golden_json_and_floor(tmp_path, capsys):
+    d = _golden_trace_dir(tmp_path)
+    argv = [d, "--peak-flops", "4e12", "--peak-bw", "2e10"]
+    assert profiling.main(argv + ["--json"]) == profiling.EXIT_OK
+    doc = json.loads(capsys.readouterr().out)
+    sgd = {r["fn"]: r for r in doc["fns"]}["sgd_unrolled"]
+    assert doc["source"] == "device"
+    assert sgd["utilization"] == pytest.approx(0.5)
+    # the measured 50% clears a 40% floor and trips a 90% one
+    assert profiling.main(
+        argv + ["--check", "--min-util", "0.4"]) == profiling.EXIT_OK
+    assert profiling.main(
+        argv + ["--check", "--min-util", "0.9"]) \
+        == profiling.EXIT_BELOW_FLOOR
+    assert "below floor" in capsys.readouterr().err
+
+
+def test_cli_check_host_fallback_is_honest_exit0(tmp_path, capsys):
+    d = _golden_trace_dir(tmp_path, fixture=HOST_FIXTURE)
+    rc = profiling.main([d, "--check", "--min-util", "0.99"])
+    assert rc == profiling.EXIT_OK
+    assert "host-fallback" in capsys.readouterr().out
+
+
+def test_cli_dispatch_via_mltrace(tmp_path, capsys):
+    from flink_ml_tpu.observability.cli import main as trace_cli
+
+    d = _golden_trace_dir(tmp_path)
+    assert trace_cli(["efficiency", d]) == profiling.EXIT_OK
+    assert "roofline" not in capsys.readouterr().err
+
+
+# -- capture paths ------------------------------------------------------------
+
+def test_profile_window_publishes_artifact_and_metrics(tmp_path,
+                                                       monkeypatch):
+    _fake_profiler(monkeypatch)
+    monkeypatch.setenv(profiling.PEAK_FLOPS_ENV, "4e12")
+    monkeypatch.setenv(profiling.PEAK_BW_ENV, "2e10")
+    _cost_gauges()
+    out = str(tmp_path / "cap")
+    with profiling.profile_window("smoke test", out_dir=out) as handle:
+        assert handle is not None
+    assert handle.report is not None
+    assert handle.report["source"] == "device"
+    assert handle.report["label"] == "smoke test"
+    assert os.path.isfile(os.path.join(out, profiling.PROFILE_ARTIFACT))
+    snap = metrics.snapshot()
+    hists = (snap.get("ml.deviceop") or {}).get("histograms", {})
+    assert any("fusion.1" in key for key in hists)
+    # device-laned capture + cost gauges → efficiency gauges appear
+    util = metrics.group("ml", "efficiency").get_gauge(
+        "utilization", labels={"fn": "sgd_unrolled"})
+    assert util == pytest.approx(0.5)
+
+
+def test_profile_window_defaults_into_trace_dir(tmp_path, monkeypatch):
+    _fake_profiler(monkeypatch)
+    tracing.tracer.configure(str(tmp_path))
+    with profiling.profile_window("fit-region") as handle:
+        assert handle is not None
+    assert handle.dir.startswith(str(tmp_path))
+    # the attribution artifact publishes at the trace root, beside
+    # spans/metrics, where mltrace efficiency/diff/path look for it
+    assert os.path.isfile(
+        os.path.join(str(tmp_path), profiling.PROFILE_ARTIFACT))
+
+
+def test_kill_switch_disables_every_path(tmp_path, monkeypatch):
+    state = _fake_profiler(monkeypatch)
+    monkeypatch.setenv(profiling.CAPTURE_ENV, "0")
+    with profiling.profile_window("x", out_dir=str(tmp_path)) as handle:
+        assert handle is None
+    assert profiling.capture_now(50) is None
+    monkeypatch.setattr(profiling, "_backend_ready", lambda: True)
+    assert profiling.capture_incident_profile(str(tmp_path)) is False
+    assert state["starts"] == 0
+
+
+def test_single_trace_claim_shared_with_metrics_profile(tmp_path,
+                                                        monkeypatch):
+    _fake_profiler(monkeypatch)
+    assert metrics_mod.claim_profiler()
+    try:
+        with profiling.profile_window(
+                "x", out_dir=str(tmp_path)) as handle:
+            assert handle is None
+    finally:
+        metrics_mod.release_profiler()
+    with profiling.profile_window("x", out_dir=str(tmp_path)) as handle:
+        assert handle is not None
+
+
+def test_capture_now_clamps_to_route_bound(tmp_path, monkeypatch):
+    _fake_profiler(monkeypatch)
+    monkeypatch.setenv(profiling.PROFILEZ_MAX_MS_ENV, "40")
+    tracing.tracer.configure(str(tmp_path))
+    result = profiling.capture_now(10_000)
+    assert result is not None
+    assert result["ms"] == 40
+    assert result["report"]["source"] == "device"
+
+
+def test_forked_children_never_profile(tmp_path, monkeypatch):
+    _fake_profiler(monkeypatch)
+    old_pid = profiling._owner_pid
+    old_lock = profiling._lock
+    profiling.reseed_child()
+    try:
+        with profiling.profile_window(
+                "x", out_dir=str(tmp_path)) as handle:
+            assert handle is None
+    finally:
+        profiling._owner_pid = old_pid
+        profiling._lock = old_lock
+
+
+def test_capture_failure_releases_claim_not_raises(tmp_path,
+                                                   monkeypatch):
+    def broken_start(log_dir):
+        raise RuntimeError("no profiler on this backend")
+
+    monkeypatch.setattr(profiling, "_profiler_start", broken_start)
+    with profiling.profile_window("x", out_dir=str(tmp_path)) as handle:
+        assert handle is None
+    # the claim was rolled back — the next capture can proceed
+    assert metrics_mod.claim_profiler()
+    metrics_mod.release_profiler()
+
+
+# -- arming: next traced fit / next N batcher ticks ---------------------------
+
+def test_maybe_profile_fit_one_shot(tmp_path, monkeypatch):
+    _fake_profiler(monkeypatch)
+    monkeypatch.setenv(profiling.CAPTURE_ENV, "1")
+    tracing.tracer.configure(str(tmp_path))
+    with profiling.maybe_profile_fit("KMeans.fit") as handle:
+        assert handle is not None
+    assert handle.report["label"] == "fit-KMeans.fit"
+    with profiling.maybe_profile_fit("KMeans.fit") as handle:
+        assert handle is None  # consumed: one-shot per process
+    profiling.reset()
+    with profiling.maybe_profile_fit("KMeans.fit") as handle:
+        assert handle is not None
+
+
+def test_maybe_profile_fit_unarmed_is_noop(tmp_path, monkeypatch):
+    state = _fake_profiler(monkeypatch)
+    with profiling.maybe_profile_fit("KMeans.fit") as handle:
+        assert handle is None
+    assert state["starts"] == 0
+
+
+def test_batch_tick_spans_n_ticks(tmp_path, monkeypatch):
+    state = _fake_profiler(monkeypatch)
+    monkeypatch.setenv(profiling.CAPTURE_ENV, "1")
+    monkeypatch.setenv(profiling.TICKS_ENV, "2")
+    tracing.tracer.configure(str(tmp_path))
+    profiling.batch_tick()   # arms: capture starts
+    assert state["starts"] == 1 and state["stops"] == 0
+    profiling.batch_tick()   # tick 1 of 2 inside the window
+    assert state["stops"] == 0
+    profiling.batch_tick()   # tick 2 of 2: capture closes
+    assert state["stops"] == 1
+    assert os.path.isfile(
+        os.path.join(str(tmp_path), profiling.PROFILE_ARTIFACT))
+    profiling.batch_tick()   # consumed: still armed, never re-fires
+    assert state["starts"] == 1
+
+
+# -- /profilez route ----------------------------------------------------------
+
+def _get(port, route):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{route}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_profilez_route_bounded_capture(tmp_path, monkeypatch):
+    _fake_profiler(monkeypatch)
+    monkeypatch.setenv(server.METRICS_PORT_ENV, "0")
+    tracing.tracer.configure(str(tmp_path))
+    srv = server.maybe_start()
+    assert srv is not None
+    doc = _get(srv.port, "/profilez?ms=5")
+    assert doc["ms"] == 5
+    assert doc["report"]["source"] == "device"
+    # bad ms is a 400, not a capture
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(srv.port, "/profilez?ms=0")
+    assert err.value.code == 400
+
+
+def test_profilez_409_when_killed_busy_or_forked(tmp_path, monkeypatch):
+    _fake_profiler(monkeypatch)
+    monkeypatch.setenv(server.METRICS_PORT_ENV, "0")
+    srv = server.maybe_start()
+    assert srv is not None
+    # kill-switch
+    monkeypatch.setenv(profiling.CAPTURE_ENV, "0")
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(srv.port, "/profilez?ms=5")
+    assert err.value.code == 409
+    assert profiling.CAPTURE_ENV in err.value.read().decode()
+    monkeypatch.delenv(profiling.CAPTURE_ENV)
+    # another trace already active: refuse, never queue
+    assert metrics_mod.claim_profiler()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(srv.port, "/profilez?ms=5")
+        assert err.value.code == 409
+    finally:
+        metrics_mod.release_profiler()
+    # not the driver process
+    monkeypatch.setattr(profiling, "_owner_pid", -1)
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(srv.port, "/profilez?ms=5")
+    assert err.value.code == 409
+
+
+# -- flight-recorder incident capture -----------------------------------------
+
+def test_incident_bundle_contains_bounded_profile(tmp_path, monkeypatch):
+    _fake_profiler(monkeypatch)
+    monkeypatch.setattr(profiling, "_backend_ready", lambda: True)
+    monkeypatch.setenv(profiling.INCIDENT_MS_ENV, "5")
+    d = str(tmp_path)
+    tracing.tracer.configure(d)
+    with tracing.tracer.span("serve"):
+        pass
+    bundle = flightrecorder.record_incident("slo", slo="p99")
+    assert bundle is not None
+    assert os.path.isfile(
+        os.path.join(bundle, profiling.PROFILE_ARTIFACT))
+    assert profiling.find_trace_file(
+        os.path.join(bundle, "profile")) is not None
+    with open(os.path.join(bundle, flightrecorder.INCIDENT_FILE)) as f:
+        meta = json.load(f)
+    assert meta["device_profile"] is True
+
+
+def test_incident_profile_refuses_without_backend(tmp_path, monkeypatch):
+    state = _fake_profiler(monkeypatch)
+    monkeypatch.setattr(profiling, "_backend_ready", lambda: False)
+    assert profiling.capture_incident_profile(str(tmp_path)) is False
+    monkeypatch.setattr(profiling, "_backend_ready", lambda: True)
+    monkeypatch.setenv(profiling.INCIDENT_MS_ENV, "0")
+    assert profiling.capture_incident_profile(str(tmp_path)) is False
+    assert state["starts"] == 0
+
+
+# -- boot-to-ready phase telemetry --------------------------------------------
+
+def test_boot_phases_latch_to_ready(tmp_path):
+    assert profiling.boot_to_ready_ms() is None
+    tracing.tracer.configure(str(tmp_path))
+    with profiling.boot_phase("mesh-build"):
+        pass
+    with profiling.boot_phase("warmup-compile"):
+        pass
+    profiling.mark_ready()
+    ready = profiling.boot_to_ready_ms()
+    assert ready is not None and ready >= 0.0
+    profiling.mark_ready()  # first call wins
+    assert profiling.boot_to_ready_ms() == ready
+    grp = metrics.group("ml", "boot")
+    assert grp.get_gauge("bootToReadyMs") == ready
+    hist = grp.histogram("phaseMs",
+                         buckets=profiling.COMPILE_BUCKETS,
+                         labels={"phase": "mesh-build"})
+    count = hist.snapshot()["count"]
+    # post-ready re-walks (steady-state re-adopt/re-warm) are no-ops
+    with profiling.boot_phase("mesh-build"):
+        pass
+    assert hist.snapshot()["count"] == count
+    tracing.tracer.shutdown()
+    # the boot.* spans and the ready event landed in the trace
+    from flink_ml_tpu.observability.exporters import read_spans
+
+    names = [sp["name"] for sp in read_spans(str(tmp_path))]
+    assert "boot.mesh-build" in names and "boot.warmup-compile" in names
+
+
+def test_fleet_beacon_and_report_carry_boot_ms(tmp_path):
+    with profiling.boot_phase("gate-open"):
+        pass
+    profiling.mark_ready()
+    path = fleet.write_beacon(str(tmp_path), role="serving",
+                              registry=MetricsRegistry())
+    assert path is not None
+    raw = json.loads(open(path).read())
+    assert raw["load"]["bootToReadyMs"] is not None
+    view = fleet.FleetView(str(tmp_path))
+    rendered = fleet.render_report(view.report())
+    assert "bootToReadyMs=" in rendered
+
+
+# -- path --budget device sub-attribution / diff efficiency rows --------------
+
+def test_path_attach_device_ops_top3(tmp_path):
+    d = str(tmp_path)
+    profiling.write_profile_artifact(
+        d, profiling.parse_trace_file(DEVICE_FIXTURE))
+    report = path_mod.attach_device_ops({}, d)
+    assert report["device_ops"]["source"] == "device"
+    ops = report["device_ops"]["ops"]
+    assert len(ops) <= 3
+    assert ops[0]["op"] == "fusion.1" and ops[0]["fn"] == "sgd_unrolled"
+    # without an artifact the report passes through unchanged
+    assert "device_ops" not in path_mod.attach_device_ops(
+        {}, str(tmp_path / "empty"))
+
+
+def test_diff_carries_per_fn_efficiency_rows(tmp_path, monkeypatch):
+    from flink_ml_tpu.observability import diff
+
+    monkeypatch.setenv(profiling.PEAK_FLOPS_ENV, "4e12")
+    monkeypatch.setenv(profiling.PEAK_BW_ENV, "2e10")
+    a = _golden_trace_dir(tmp_path / "a")
+    b = _golden_trace_dir(tmp_path / "b")
+    delta = diff.diff_profiles(diff.load_side(a), diff.load_side(b))
+    rows = {r["fn"]: r for r in delta["efficiency"]}
+    assert rows["sgd_unrolled"]["b_utilization"] == pytest.approx(0.5)
+    assert rows["sgd_unrolled"]["bound"] == "compute"
+    rendered = diff.render_diff(delta, [])
+    assert "per-fn efficiency" in rendered
+
+
+# -- bench provenance ---------------------------------------------------------
+
+def test_provenance_rows_null_on_host_fallback(tmp_path):
+    d = _golden_trace_dir(tmp_path / "host", fixture=HOST_FIXTURE)
+    prov = profiling.provenance(d)
+    assert prov == {"profileSource": "host-fallback",
+                    "utilization": None, "achievedFlops": None}
+    # no artifact at all: every field None, never a raise
+    assert profiling.provenance(str(tmp_path / "none")) == {
+        "profileSource": None, "utilization": None,
+        "achievedFlops": None}
+
+
+def test_provenance_reports_top_fn_on_device(tmp_path, monkeypatch):
+    monkeypatch.setenv(profiling.PEAK_FLOPS_ENV, "4e12")
+    monkeypatch.setenv(profiling.PEAK_BW_ENV, "2e10")
+    d = _golden_trace_dir(tmp_path)
+    prov = profiling.provenance(d)
+    assert prov["profileSource"] == "device"
+    assert prov["utilization"] == pytest.approx(0.5)
+    assert prov["achievedFlops"] == pytest.approx(2e12)
