@@ -307,6 +307,22 @@ def _worker(role: str) -> int:
     except Exception:  # noqa: BLE001 — provenance only
         line["drift_psi_max"] = None
         line["baseline_version"] = None
+    # continuous-evaluation provenance (observability/evaluation.py):
+    # worst fresh live AUC / feedback-join coverage / label-lag p99
+    # across the run's servables — null on a plain fit bench (no
+    # feedback joined); the serving benchmark's labeled loadgen records
+    # real values, same shared-schema rule as drift_psi_max
+    try:
+        from flink_ml_tpu.observability import evaluation as _quality
+
+        qprov = _quality.provenance()
+        line["auc_live"] = qprov["aucLive"]
+        line["feedback_coverage"] = qprov["feedbackCoverage"]
+        line["label_lag_p99_ms"] = qprov["labelLagP99Ms"]
+    except Exception:  # noqa: BLE001 — provenance only
+        line["auc_live"] = None
+        line["feedback_coverage"] = None
+        line["label_lag_p99_ms"] = None
     # device-efficiency provenance (observability/profiling.py): the
     # hottest profiled fn's roofline utilization and achieved FLOP/s
     # when a device profile was captured beside this run — null on

@@ -126,6 +126,11 @@ def _child_main(fn, lo, hi, wfd, chaos_action=None, parent_ctx=None):
     _drift = _sys.modules.get("flink_ml_tpu.observability.drift")
     if _drift is not None:
         _drift.reseed_child()
+    # quality sketches (observability/evaluation.py) ride the same
+    # fold: child-joined labels ship home beside the metric snapshot
+    _qual = _sys.modules.get("flink_ml_tpu.observability.evaluation")
+    if _qual is not None:
+        _qual.reseed_child()
     # device profiling is driver-only (the single jax.profiler slot
     # belongs to the parent): pin capture shut in the child and replace
     # its module lock rather than acquire it — same gating as above
@@ -154,6 +159,12 @@ def _child_main(fn, lo, hi, wfd, chaos_action=None, parent_ctx=None):
             dsnap = _drift.state_snapshot()
             if dsnap.get("servables"):
                 envelope["drift"] = dsnap
+        _qual = _sys.modules.get(
+            "flink_ml_tpu.observability.evaluation")
+        if _qual is not None:
+            qsnap = _qual.state_snapshot()
+            if qsnap.get("servables"):
+                envelope["quality"] = qsnap
         payload = pickle.dumps(envelope,
                                protocol=pickle.HIGHEST_PROTOCOL)
     except BaseException:  # noqa: BLE001 — report the traceback, then _exit
@@ -278,6 +289,20 @@ def _finalize(child):
                 "droppedChildDriftSnapshots")
             logging.getLogger(__name__).warning(
                 "dropping worker %d drift snapshot (bin mismatch)",
+                child.idx, exc_info=True)
+    qsnap = envelope.get("quality")
+    if qsnap:
+        from flink_ml_tpu.observability import evaluation
+
+        try:
+            evaluation.merge_state(qsnap)
+        except ValueError:
+            import logging
+
+            metrics.group("ml", "hostpool").counter(
+                "droppedChildQualitySnapshots")
+            logging.getLogger(__name__).warning(
+                "dropping worker %d quality snapshot (bin mismatch)",
                 child.idx, exc_info=True)
     return envelope["result"]
 

@@ -210,6 +210,39 @@ def _capture_drift_baseline(estimator, model, x, coeffs) -> None:
             "drift baseline capture failed", exc_info=True)
 
 
+def _capture_quality_baseline(estimator, model, x, y, coeffs) -> None:
+    """The traced-fit quality seam (observability/evaluation.py):
+    sketch the final model's positive-class scores on the same
+    row-capped training sample against the matching labels, attaching
+    the :class:`~flink_ml_tpu.observability.evaluation.QualityBaseline`
+    to the fitted model — the live-AUC anchor ``publish_model`` ships
+    as ``quality-baseline.json``. Non-binary labels (regression fits)
+    sketch nothing, so no baseline attaches. Armed like drift capture;
+    a failure is logged and never fails the fit."""
+    try:
+        from flink_ml_tpu.observability import drift, evaluation
+
+        if not evaluation.capture_armed():
+            return
+        xs = drift.sample_rows(x)
+        ys = np.asarray(y).ravel()[:xs.shape[0]]
+        dots, xp = predict_dots(xs, coeffs)
+        cols = model._predict_columns(dots, xp)
+        raw = cols.get(getattr(model, "raw_prediction_col", None))
+        scores = evaluation.positive_scores(
+            raw_values=(None if raw is None else np.asarray(raw)),
+            predictions=cols.get(model.prediction_col))
+        if scores is not None:
+            evaluation.capture_fit_baseline(
+                model, type(estimator).__name__, scores=scores,
+                labels=ys)
+    except Exception:  # noqa: BLE001 — telemetry must not sink the fit
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "quality baseline capture failed", exc_info=True)
+
+
 class LinearEstimatorBase(Estimator, LinearTrainParams,
                           IterationRuntimeMixin):
     """Shared SGD fit path (ref: LogisticRegression.fit:60 → SGD.optimize)."""
@@ -258,6 +291,7 @@ class LinearEstimatorBase(Estimator, LinearTrainParams,
         model = self.model_class(coefficients=coeffs)
         model = self.copy_params_to(model)
         _capture_drift_baseline(self, model, x, coeffs)
+        _capture_quality_baseline(self, model, x, y, coeffs)
         return model
 
 

@@ -964,6 +964,37 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams,
 
             logging.getLogger(__name__).warning(
                 "drift baseline capture failed", exc_info=True)
+        # quality baseline (observability/evaluation.py): the FINAL
+        # model's positive-class probabilities on the same row-capped
+        # sample vs the training labels — the live-AUC anchor the
+        # canary verdict's quality stage judges against. Same Table-
+        # path-only rationale as drift above.
+        try:
+            from flink_ml_tpu.observability import drift as _mldrift
+            from flink_ml_tpu.observability import (
+                evaluation as _mlquality,
+            )
+
+            if _mlquality.capture_armed() and isinstance(data, Table):
+                from flink_ml_tpu.linalg import sparse as _sparse
+                from flink_ml_tpu.models.common import predict_dots
+
+                xs = _mldrift.sample_rows(
+                    _sparse.features_matrix(data, self.features_col))
+                ys = np.asarray(
+                    data.scalars(self.label_col, np.float64)
+                )[:xs.shape[0]]
+                fdots, _xp = predict_dots(xs, coeffs)
+                prob = 1.0 / (1.0 + np.exp(
+                    -np.asarray(fdots, np.float64)))
+                _mlquality.capture_fit_baseline(
+                    model, algo, scores=prob, labels=ys,
+                    version=version)
+        except Exception:  # noqa: BLE001 — see the drift capture
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "quality baseline capture failed", exc_info=True)
         return model
 
 

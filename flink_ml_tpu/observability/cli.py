@@ -16,6 +16,7 @@ rerunning anything:
     flink-ml-tpu-trace shards TRACE_DIR --check  # per-device mesh view
     flink-ml-tpu-trace slo TRACE_DIR --check     # SLO verdicts (exit 4)
     flink-ml-tpu-trace drift TRACE_DIR --check   # drift verdicts (exit 4)
+    flink-ml-tpu-trace quality TRACE_DIR --check # quality verdicts (exit 4)
     flink-ml-tpu-trace controller TRACE_DIR --check  # ops loop (exit 4)
     flink-ml-tpu-trace path TRACE_DIR --check --budget 50  # critical path
     flink-ml-tpu-trace incident TRACE_DIR --check  # flight recorder (exit 4)
@@ -52,6 +53,14 @@ feature and for predictions) and with ``--check`` exits 4 when any
 servable drifted, 2 on missing/broken artifacts — a servable published
 without a baseline reports ``source: missing`` and never fails the
 gate; the live verdicts come from the ``/drift`` endpoint. The
+``quality`` subcommand (observability/evaluation.py) judges the
+continuous-evaluation artifacts — AUC / logloss / accuracy /
+calibration derived from feedback-joined quality sketches — against
+the live AUC floor and each servable's training-time quality baseline,
+and with ``--check`` exits 4 when any servable degraded, 2 on
+missing/broken artifacts; a thin window (too few joined labels) is
+insufficient evidence, never a verdict, and the live verdicts come
+from the ``/quality`` endpoint. The
 ``controller`` subcommand (serving/controller.py, docs/ops.md) renders
 the ops-controller timeline — triggers, state transitions, cycle
 outcomes, rollbacks — and with ``--check`` exits 4 unless every
@@ -275,6 +284,14 @@ def main(argv=None) -> int:
         from flink_ml_tpu.observability.drift import main as drift_main
 
         return drift_main(argv[1:])
+    if argv and argv[0] == "quality":
+        # continuous-evaluation verdicts (observability/evaluation.py);
+        # same dispatch rule — ./quality summarizes such a directory
+        from flink_ml_tpu.observability.evaluation import (
+            main as quality_main,
+        )
+
+        return quality_main(argv[1:])
     if argv and argv[0] == "controller":
         # ops-controller timeline (serving/controller.py); same
         # dispatch rule — ./controller summarizes such a directory

@@ -256,6 +256,13 @@ def dump_metrics(trace_dir: str,
             drift_mod.dump_state(trace_dir)
         except OSError:
             pass  # the metrics snapshot is the primary artifact
+    eval_mod = sys.modules.get(
+        "flink_ml_tpu.observability.evaluation")
+    if eval_mod is not None:
+        try:
+            eval_mod.dump_state(trace_dir)
+        except OSError:
+            pass  # same rule as drift: the snapshot is primary
     # lock-watchdog acquisition graph rides alongside as
     # locks-<suffix>.json (a no-op for processes that never armed it)
     try:

@@ -241,6 +241,21 @@ def _load_payload() -> dict:
             load["bootToReadyMs"] = round(ready_ms, 3)
     except Exception:
         pass
+    try:
+        # continuous-evaluation quality (observability/evaluation.py):
+        # the worst fresh live AUC + feedback coverage, so a half-fleet
+        # quality collapse is visible from one `mltrace fleet` call
+        from flink_ml_tpu.observability import evaluation
+
+        prov = evaluation.provenance()
+        if prov.get("aucLive") is not None:
+            load["aucLive"] = prov["aucLive"]
+        if prov.get("feedbackCoverage") is not None:
+            load["feedbackCoverage"] = prov["feedbackCoverage"]
+        if prov.get("labelLagP99Ms") is not None:
+            load["labelLagP99Ms"] = prov["labelLagP99Ms"]
+    except Exception:
+        pass
     return load
 
 
@@ -797,11 +812,13 @@ def render_report(report: dict) -> str:
     loaded = [row for row in report["load"]
               if any(row.get(k) is not None for k in
                      ("queueDepth", "inFlight", "servable",
-                      "bootToReadyMs"))]
+                      "bootToReadyMs", "aucLive"))]
     if loaded:
         lines.append("load:")
         for row in loaded:
             boot = row.get("bootToReadyMs")
+            auc = row.get("aucLive")
+            cov = row.get("feedbackCoverage")
             lines.append(
                 f"  {row['member']:<8} queueDepth="
                 f"{row.get('queueDepth')} inFlight={row.get('inFlight')} "
@@ -809,7 +826,18 @@ def render_report(report: dict) -> str:
                 f"version={row.get('modelVersion')} "
                 f"canary={row.get('canary')}"
                 + (f" bootToReadyMs={boot:.0f}" if boot is not None
-                   else ""))
+                   else "")
+                + (f" aucLive={auc:.4f}" if auc is not None else "")
+                + (f" coverage={cov:.2f}" if cov is not None else ""))
+        # the half-fleet collapse view: one line naming the member
+        # whose live AUC is worst across the fleet
+        quality = [(row["member"], row["aucLive"]) for row in loaded
+                   if row.get("aucLive") is not None]
+        if quality:
+            worst_member, worst_auc = min(quality, key=lambda mv: mv[1])
+            lines.append(f"quality: worst live AUC {worst_auc:.4f} "
+                         f"({worst_member}, {len(quality)} member(s) "
+                         f"reporting)")
     return "\n".join(lines)
 
 
@@ -823,7 +851,10 @@ def _eval_fleet_slos(view: "FleetView", spec_path: Optional[str]):
         slos = slo_mod.load_specs(spec_path)
     else:
         slos = slo_mod.default_slos()
-    slos = [s for s in slos if s.kind in ("latency", "error-rate")]
+    # quality rides too: its gauges travel in every beacon's ml.quality
+    # group, so a fleet-scope AUC floor evaluates from beacons alone
+    slos = [s for s in slos
+            if s.kind in ("latency", "error-rate", "quality")]
     for s in slos:
         s.scope = "fleet"
     return slo_mod.evaluate_slos(slos, fleet_view=view)

@@ -37,6 +37,12 @@ route             serves                                      response with no d
                   training-time baselines; evaluating emits   without a baseline reports
                   the events/gauges, so scraping doubles as   ``source: "missing"``
                   the drift alerter
+``/quality``      live continuous-evaluation verdicts         200 with an empty
+                  (observability/evaluation.py): AUC/logloss/ ``servables`` map — no
+                  calibration from feedback-joined windows    feedback joined yet; a thin
+                  vs the installed quality baselines;         window is insufficient
+                  evaluating emits the events/gauges, so      evidence; no baseline →
+                  scraping doubles as the quality alerter     ``source: "missing"``
 ``/controller``   the ops controller's live state             200 ``{"controller": null}``
                   (serving/controller.py): state machine      — no controller registered
                   position, cycle, canary version/fraction,   a provider
@@ -117,6 +123,9 @@ ROUTE_TABLE = {
     "/drift": ("_route_drift",
                '200 with an empty "servables" map; no baseline → '
                'source: "missing"'),
+    "/quality": ("_route_quality",
+                 '200 with an empty "servables" map; no joined '
+                 'feedback → thin; no baseline → source: "missing"'),
     "/controller": ("_route_controller",
                     '200 {"controller": null} — no ops controller '
                     'registered a provider (serving/controller.py)'),
@@ -298,6 +307,18 @@ class _Handler(BaseHTTPRequestHandler):
         # bare NaN token is unparseable strict JSON
         self._send(200, json.dumps(
             _json_safe(drift.drift_report(emit=True)),
+            default=str), _JSON_CTYPE)
+
+    def _route_quality(self) -> None:
+        from flink_ml_tpu.observability import evaluation
+        from flink_ml_tpu.observability.health import _json_safe
+
+        # emit=True: scraping doubles as the quality alerter, exactly
+        # like /drift — verdict gauges/events land on every scrape.
+        # _json_safe: an empty joined window carries NaN AUC, and the
+        # bare NaN token is unparseable strict JSON
+        self._send(200, json.dumps(
+            _json_safe(evaluation.quality_report(emit=True)),
             default=str), _JSON_CTYPE)
 
     def _route_controller(self) -> None:

@@ -97,7 +97,7 @@ SLO_SPEC_ENV = "FLINK_ML_TPU_SLO_SPEC"
 #: the SRE-handbook fast/slow pair scaled to a process-local horizon
 DEFAULT_BURN_WINDOWS = ((60.0, 14.4), (300.0, 6.0))
 
-_KINDS = ("latency", "error-rate", "drift")
+_KINDS = ("latency", "error-rate", "drift", "quality")
 
 
 @dataclasses.dataclass
@@ -111,10 +111,21 @@ class SLO:
     under ``max_drift``; with no matching gauges the objective is ok
     and tagged ``source: "missing"`` — an unpublished baseline must
     never fail an SLO. ``group`` defaults to ``ml.drift`` for this
-    kind."""
+    kind.
+
+    Kind ``quality`` reads the ``quality{servable=,metric=}`` gauges
+    the continuous-evaluation plane records
+    (observability/evaluation.py): the WORST gauge matching ``metric``
+    (higher-is-better — AUC by default) must stay at or above
+    ``min_quality``, and with ``max_quality_delta`` set, each
+    servable's live gauge must not fall more than that under its
+    ``qualityBaseline`` twin. No matching gauges — no feedback joined
+    yet, or a thin window — is ok with ``source: "missing"``: absence
+    of ground truth never burns an error budget. ``group`` defaults to
+    ``ml.quality`` for this kind."""
 
     name: str
-    kind: str = "latency"            # "latency" | "error-rate" | "drift"
+    kind: str = "latency"   # "latency" | "error-rate" | "drift" | "quality"
     group: str = f"{ML_GROUP}.serving"
     histogram: str = "transformMs"   # latency source (ms histogram)
     total: str = "transforms"        # error-rate denominator counter
@@ -127,6 +138,9 @@ class SLO:
     burn_windows: Tuple[Tuple[float, float], ...] = DEFAULT_BURN_WINDOWS
     stat: str = "psi"                # drift statistic: psi | js | ks
     max_drift: float = 0.2           # drift gauge bound
+    metric: str = "auc"              # quality metric (higher-is-better)
+    min_quality: float = 0.6         # quality gauge floor
+    max_quality_delta: Optional[float] = None  # live-under-baseline bound
     scope: str = "process"           # "process" | "fleet"
 
     def __post_init__(self):
@@ -153,6 +167,15 @@ class SLO:
                 # untouched default is redirected — an explicit group
                 # (a custom evaluator's) is honored
                 self.group = f"{ML_GROUP}.drift"
+        if self.kind == "quality":
+            if self.max_quality_delta is not None \
+                    and float(self.max_quality_delta) < 0:
+                raise ValueError(
+                    f"SLO {self.name!r}: max_quality_delta must be "
+                    f">= 0")
+            if self.group == f"{ML_GROUP}.serving":
+                # same rule as drift: only the untouched default moves
+                self.group = f"{ML_GROUP}.quality"
         self.burn_windows = tuple(
             (float(w), float(m)) for w, m in self.burn_windows)
 
@@ -516,6 +539,75 @@ def _eval_drift(slo: SLO, source) -> List[dict]:
              "ok": worst <= slo.max_drift, "source": "gauge"}]
 
 
+def _eval_quality(slo: SLO, source) -> List[dict]:
+    """The ``quality`` objective: the worst matching
+    ``quality{servable=,metric=}`` gauge (observability/evaluation.py
+    records them once the joined-label floor is met) must stay at or
+    above ``min_quality``; with ``max_quality_delta``, each servable's
+    live gauge is also held within that delta under its
+    ``qualityBaseline`` twin. No matching gauges — no feedback joined,
+    or a thin window — is ok with ``source: "missing"``: absence of
+    ground truth never burns an error budget."""
+    from flink_ml_tpu.observability.health import _parse_labels
+
+    labels = dict(slo.labels or {})
+    labels["metric"] = slo.metric
+    gauges = source.gauge_values(slo.group, "quality", labels)
+    finite = [(k, v) for k, v in gauges if math.isfinite(v)]
+    if not finite:
+        return [{"objective": "quality-metric", "metric": slo.metric,
+                 "value": None, "min_quality": slo.min_quality,
+                 "series": 0, "worst": None, "ok": True,
+                 "source": "missing"}]
+    worst_key, worst = min(finite, key=lambda kv: kv[1])
+    objectives = [{"objective": "quality-metric", "metric": slo.metric,
+                   "value": round(worst, 6),
+                   "min_quality": slo.min_quality,
+                   "series": len(finite), "worst": worst_key,
+                   "ok": worst >= slo.min_quality, "source": "gauge"}]
+    if slo.max_quality_delta is None:
+        return objectives
+    base_gauges = source.gauge_values(slo.group, "qualityBaseline",
+                                      labels)
+    def _series_key(key: str):
+        # "quality{metric=auc,servable=X}" — fleet-scope reads append
+        # "@member", so pair live/baseline by (servable, member tail)
+        _, _, rest = key.partition("{")
+        body, _, tail = rest.partition("}")
+        return _parse_labels(body).get("servable"), tail
+
+    by_servable = {}
+    for k, v in base_gauges:
+        if not math.isfinite(v):
+            continue
+        by_servable[_series_key(k)] = v
+    worst_delta, worst_pair = None, None
+    for k, v in finite:
+        base = by_servable.get(_series_key(k))
+        if base is None:
+            continue
+        delta = base - v
+        if worst_delta is None or delta > worst_delta:
+            worst_delta, worst_pair = delta, k
+    if worst_delta is None:
+        # live gauges with no baseline twin: the delta objective has
+        # nothing to anchor on — a publishing gap, not a regression
+        objectives.append({
+            "objective": "quality-delta", "metric": slo.metric,
+            "value": None,
+            "max_quality_delta": slo.max_quality_delta,
+            "worst": None, "ok": True, "source": "missing"})
+    else:
+        objectives.append({
+            "objective": "quality-delta", "metric": slo.metric,
+            "value": round(worst_delta, 6),
+            "max_quality_delta": slo.max_quality_delta,
+            "worst": worst_pair,
+            "ok": worst_delta <= slo.max_quality_delta,
+            "source": "gauge"})
+    return objectives
+
+
 def evaluate_slos(slos: Optional[Sequence[SLO]] = None, registry=None,
                   snapshot: Optional[Dict[str, dict]] = None,
                   emit: bool = False, fleet_view=None,
@@ -552,6 +644,8 @@ def evaluate_slos(slos: Optional[Sequence[SLO]] = None, registry=None,
             objectives = _eval_latency(slo, src)
         elif slo.kind == "drift":
             objectives = _eval_drift(slo, src)
+        elif slo.kind == "quality":
+            objectives = _eval_quality(slo, src)
         else:
             objectives = _eval_error_rate(slo, src)
         ok = all(o["ok"] for o in objectives)
@@ -645,6 +739,27 @@ def render_verdicts(verdicts: List[dict]) -> str:
                     f"{'(' + o['source'] + ')':<26} "
                     f"{o['stat']} {val} (<= {o['max_drift']:g}, "
                     f"{o['series']} series){worst}  [{flag}]")
+                continue
+            if o["objective"] == "quality-metric":
+                val = "-" if o["value"] is None else f"{o['value']:g}"
+                worst = f" worst {o['worst']}" if o.get("worst") else ""
+                flag = "ok" if o["ok"] else "VIOLATED"
+                out.append(
+                    f"  {o['objective']:<17} "
+                    f"{'(' + o['source'] + ')':<26} "
+                    f"{o['metric']} {val} (>= {o['min_quality']:g}, "
+                    f"{o['series']} series){worst}  [{flag}]")
+                continue
+            if o["objective"] == "quality-delta":
+                val = "-" if o["value"] is None else f"{o['value']:g}"
+                worst = f" worst {o['worst']}" if o.get("worst") else ""
+                flag = "ok" if o["ok"] else "VIOLATED"
+                out.append(
+                    f"  {o['objective']:<17} "
+                    f"{'(' + o['source'] + ')':<26} "
+                    f"{o['metric']} under baseline by {val} "
+                    f"(<= {o['max_quality_delta']:g}){worst}  "
+                    f"[{flag}]")
                 continue
             window = f"window {o['window_s']:g}s ({o['source']})"
             if o["objective"] == "latency-quantile":

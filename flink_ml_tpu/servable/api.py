@@ -286,6 +286,23 @@ def _served(method):
             if features is not None or drift_preds is not None:
                 drift.observe_transform(servable, features=features,
                                         predictions=drift_preds)
+            # quality: park this request's positive-class scores in the
+            # evaluation join ring, keyed by the batcher's per-request
+            # ordinals, so record_feedback(request_id, label) can join
+            # delayed ground truth back to what was actually served
+            from flink_ml_tpu.observability import evaluation
+
+            segments = getattr(df, "request_segments", None)
+            if segments and isinstance(out, DataFrame):
+                raw_values = None
+                rcol = getattr(self, "raw_prediction_col", None)
+                if rcol and rcol in out.column_names:
+                    raw_values = out.get(rcol).values
+                scores = evaluation.positive_scores(
+                    raw_values=raw_values, predictions=predictions)
+                if scores is not None:
+                    evaluation.observe_served(servable, scores,
+                                              segments=segments)
         except Exception:  # noqa: BLE001 — see docstring
             logging.getLogger(__name__).warning(
                 "serving metrics recording failed", exc_info=True)

@@ -434,6 +434,12 @@ class MicroBatcher:
             deadline_ms = self.config.deadline_ms
         req = _Request(df, deadline_ms)
         req.seq = next(self._req_counter)
+        # the continuous-evaluation join key (observability/
+        # evaluation.py): callers read it off the future and hand it
+        # back with the delayed ground-truth label
+        # (evaluation.record_feedback) — the same ordinal the causal
+        # trace carries as ``req=``
+        req.future.request_id = req.seq
         if tracing.tracer.enabled and trace_sampled():
             # the request's causal anchor: a near-instant span on the
             # CALLER's thread — child of whatever span the caller has
@@ -642,6 +648,12 @@ class MicroBatcher:
         # row and inflate the sample floor with dependent copies; the
         # _served wrapper slices features/predictions to this count
         batch_df.drift_real_rows = n_real
+        # quality seam (observability/evaluation.py): the per-request
+        # row layout of this batch, so the _served wrapper can park
+        # each request's scores in the feedback-join ring under its
+        # ``req`` ordinal — pad rows sit past the segments' sum
+        batch_df.request_segments = tuple((req.seq, req.n)
+                                          for req in kept)
         fill = n_real / bucket if bucket else 1.0
         waste = pad / bucket if bucket else 0.0
         prepared = _Prepared(kept, batch_df, bucket, n_real, pad, fill,

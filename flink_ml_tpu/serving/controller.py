@@ -21,7 +21,10 @@ State machine (docs/ops.md has the diagram)::
          └────────────────────────────── rolling-back ◀─────┘
 
 - **watching**: evaluate the active version's drift verdict
-  (:func:`~flink_ml_tpu.observability.drift.evaluate`) and any
+  (:func:`~flink_ml_tpu.observability.drift.evaluate`), its
+  continuous-evaluation quality verdict
+  (:func:`~flink_ml_tpu.observability.evaluation.evaluate` — live AUC
+  from joined ground truth vs the published quality baseline) and any
   configured SLOs; a violation starts a cycle.
 - **retraining**: the caller's ``retrain`` callable (typically an FTRL
   ``warm_start`` refit on recent traffic) under
@@ -151,6 +154,13 @@ class ControllerConfig:
     latency_threshold_ms: Optional[float] = None
     latency_quantile: float = 0.99
     latency_window_s: float = 60.0
+    #: consult the continuous-evaluation verdict (observability/
+    #: evaluation.py — live AUC vs the published quality baseline) as a
+    #: canary/bake stage; thresholds are evaluation's own
+    #: ``FLINK_ML_TPU_QUALITY_*`` knobs. Only bites when a quality
+    #: baseline was published with the candidate — versions published
+    #: without one skip the stage (``source: missing``)
+    quality_gate: bool = True
     #: quiet period after a finished cycle before the next trigger
     cooldown_s: float = 10.0
     #: retry/backoff budget for each supervised step (retrain, publish,
@@ -212,6 +222,16 @@ class ControllerConfig:
         read("LATENCY_QUANTILE", float, "latency_quantile")
         read("LATENCY_WINDOW_S", float, "latency_window_s")
         read("COOLDOWN_S", float, "cooldown_s")
+
+        def parse_bool(raw):
+            lowered = raw.strip().lower()
+            if lowered in ("1", "true", "yes", "on"):
+                return True
+            if lowered in ("0", "false", "no", "off"):
+                return False
+            raise ValueError("expected a boolean (1/0/true/false)")
+
+        read("QUALITY_GATE", parse_bool, "quality_gate")
         return cls(**overrides)
 
 
@@ -225,9 +245,13 @@ class OpsController:
     dict (``reasons``, ``servable``, ``version``), return
     ``(leaves, baseline)`` — the model arrays to publish and the fresh
     :class:`~flink_ml_tpu.observability.drift.DriftBaseline` captured
-    on the data it refit over (or a bare ``leaves`` list; publishing
-    without a baseline degrades the NEXT cycle's drift trigger to
-    ``source: missing``). Typically an
+    on the data it refit over — or ``(leaves, baseline,
+    quality_baseline)`` to also publish the fit-time
+    :class:`~flink_ml_tpu.observability.evaluation.QualityBaseline`
+    that arms the canary's live-AUC quality stage (or a bare
+    ``leaves`` list; publishing without baselines degrades the NEXT
+    cycle's drift trigger to ``source: missing`` and skips the quality
+    stage). Typically an
     :meth:`~flink_ml_tpu.models.online.OnlineLogisticRegression
     .warm_start` FTRL fit on recent traffic.
 
@@ -467,6 +491,18 @@ class OpsController:
             if verdict["drifted"]:
                 reasons.append(
                     f"drift:{','.join(verdict['drifted'])}")
+        if self.config.quality_gate:
+            # the continuous-evaluation twin of the drift trigger:
+            # joined ground truth says the ACTIVE version's live AUC
+            # fell below the floor / under the published baseline —
+            # concept drift the feature sketches cannot see
+            from flink_ml_tpu.observability import evaluation
+
+            if evaluation.enabled():
+                q = evaluation.evaluate(name)
+                if q["degraded"]:
+                    reasons.append(
+                        f"quality:{','.join(q['over'])}")
         if self.config.slos:
             from flink_ml_tpu.observability import slo as slo_mod
 
@@ -500,12 +536,16 @@ class OpsController:
             self._finish_cycle("failed",
                                f"retrain: {type(e).__name__}: {e}")
             return
-        if (isinstance(out, tuple) and len(out) == 2):
+        quality_baseline = None
+        if isinstance(out, tuple) and len(out) == 3:
+            leaves, baseline, quality_baseline = out
+        elif isinstance(out, tuple) and len(out) == 2:
             leaves, baseline = out
         else:
             leaves, baseline = out, None
         self._group.counter("retrains", labels={"model": self.model})
-        self._pending = {"leaves": leaves, "baseline": baseline}
+        self._pending = {"leaves": leaves, "baseline": baseline,
+                         "quality_baseline": quality_baseline}
         self._transition(PUBLISHING, "retrained")
 
     def _step_publishing(self) -> None:
@@ -514,6 +554,7 @@ class OpsController:
         version = max(published + [current]) + 1
         leaves = self._pending["leaves"]
         baseline = self._pending["baseline"]
+        quality_baseline = self._pending.get("quality_baseline")
         # claim the version BEFORE it exists on disk: a running watcher
         # thread must never adopt the candidate directly and bypass the
         # canary/ramp/bake gates (released when the cycle finishes)
@@ -524,7 +565,8 @@ class OpsController:
             faults.inject("controller-publish", model=self.model,
                           version=version)
             return publish_model(self.registry.watch_dir, leaves,
-                                 version, baseline=baseline)
+                                 version, baseline=baseline,
+                                 quality_baseline=quality_baseline)
 
         try:
             with tracing.tracer.span("controller.publish",
@@ -587,7 +629,8 @@ class OpsController:
                         deadline: float) -> Tuple[str, str]:
         """(status, detail): ``thin`` (insufficient evidence — wait),
         ``regressed`` or ``healthy``. Gauge order mirrors severity:
-        non-finite predictions, error ratio, drift, latency."""
+        non-finite predictions, error ratio, drift, quality (live AUC
+        vs the published quality baseline), latency."""
         # ONE registry snapshot serves the counts and the gauge scan —
         # the verdict runs every step of a rollout
         snap = metrics.group(ML_GROUP, "serving").snapshot()
@@ -640,6 +683,29 @@ class OpsController:
                 tracing.tracer.event(CONTROLLER_EVENT,
                                      kind="no-evidence-timeout",
                                      model=self.model, servable=name)
+        if self.config.quality_gate:
+            from flink_ml_tpu.observability import evaluation
+
+            if evaluation.enabled():
+                q = evaluation.evaluate(name)
+                if q["degraded"]:
+                    base_auc = (q["baseline"] or {}).get("auc")
+                    vs = (f" vs baseline {base_auc:.4f}"
+                          if base_auc is not None
+                          and math.isfinite(base_auc) else "")
+                    return "regressed", (
+                        f"quality: {','.join(q['over'])} (live auc "
+                        f"{q['live']['auc']:.4f}{vs})")
+                if q["source"] == "baseline" and q["thin"]:
+                    # the drift precedent again: a published quality
+                    # baseline with too few joined labels is absence of
+                    # evidence — wait for feedback, bounded by the same
+                    # stage deadline (labels are delayed by nature)
+                    if time.monotonic() < deadline:
+                        return "thin", "quality window below label floor"
+                    tracing.tracer.event(CONTROLLER_EVENT,
+                                         kind="no-evidence-timeout",
+                                         model=self.model, servable=name)
         if self.config.latency_threshold_ms is not None:
             p = self._latency_quantile(name)
             if p is not None and p > self.config.latency_threshold_ms:
@@ -731,8 +797,8 @@ class OpsController:
         """Fold a verdict detail into the small ``reason`` label set of
         ``rollbacks{model=,reason=}`` — labels must stay low-cardinality
         (common/metrics.py)."""
-        for token in ("drift", "error-ratio", "non-finite", "latency",
-                      "swap"):
+        for token in ("quality", "drift", "error-ratio", "non-finite",
+                      "latency", "swap"):
             if token in detail:
                 return token
         return "regression"

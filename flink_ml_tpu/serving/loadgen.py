@@ -121,13 +121,21 @@ def _as_future(submit: Callable, frame) -> "Future":
 
 def run_loadgen(submit: Callable, frame_factory: Callable[[int], object],
                 cfg: Optional[LoadGenConfig] = None,
-                tick: Optional[Callable[[int], None]] = None) -> dict:
+                tick: Optional[Callable[[int], None]] = None,
+                feedback: Optional[Callable[[int, object, Future],
+                                            None]] = None) -> dict:
     """Drive ``cfg.requests`` requests through ``submit`` and return
     the result record. ``submit(frame)`` may return a Future (the
     micro-batcher) or the transformed frame directly (a bare
     ``transform`` — run in loadgen worker threads so closed-loop
     concurrency still applies). ``tick(i)`` (optional) runs after every
-    completed request — the smoke's scrape-while-serving hook."""
+    completed request — the smoke's scrape-while-serving hook.
+    ``feedback(i, frame, fut)`` (optional) runs after every request
+    that completed OK — the delayed-ground-truth hook: the batcher
+    stamps ``fut.request_id`` at submit, so a labeled driver can call
+    :func:`~flink_ml_tpu.observability.evaluation.record_feedback`
+    with it and close the prediction↔label join. Feedback exceptions
+    are swallowed (the label plane must never fail the load run)."""
     cfg = cfg or LoadGenConfig()
     collector = _Collector()
     completed = [0]
@@ -139,6 +147,11 @@ def run_loadgen(submit: Callable, frame_factory: Callable[[int], object],
         try:
             fut.result(timeout=cfg.timeout_s)
             collector.record(t0, None, rows)
+            if feedback is not None:
+                try:
+                    feedback(i, frame, fut)
+                except Exception:  # noqa: BLE001 — see docstring
+                    pass
         except Exception as e:  # noqa: BLE001 — classification IS the job
             collector.record(t0, e, rows)
         if tick is not None:

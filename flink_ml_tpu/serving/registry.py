@@ -97,7 +97,8 @@ HISTORY_KEEP = 4
 
 
 def publish_model(watch_dir: str, leaves, version: int,
-                  keep: int = 8, baseline=None) -> str:
+                  keep: int = 8, baseline=None,
+                  quality_baseline=None) -> str:
     """Trainer-side publish: write model ``leaves`` (a list/pytree of
     arrays) as checkpoint version ``version`` under ``watch_dir`` —
     v2 manifest, fsynced, atomically renamed — and return the published
@@ -109,15 +110,27 @@ def publish_model(watch_dir: str, leaves, version: int,
     ``drift-baseline.json`` beside the manifest inside the same atomic
     rename, so the watcher installs the *matching* training-time
     distribution summary with every hot-swap; publishing without one is
-    fine — drift evaluation then reports ``source: missing``."""
+    fine — drift evaluation then reports ``source: missing``.
+
+    ``quality_baseline`` (a :class:`~flink_ml_tpu.observability
+    .evaluation.QualityBaseline`, the fitted model's
+    ``quality_baseline`` captured at fit time from training-set scores
+    vs labels) rides the same atomic rename as
+    ``quality-baseline.json`` — the live-AUC reference the continuous
+    evaluation plane judges this version against."""
     manager = CheckpointManager(watch_dir, keep=keep)
-    extras = None
+    extras = {}
     if baseline is not None:
         from flink_ml_tpu.observability import drift
 
-        extras = {os.path.splitext(drift.BASELINE_FILENAME)[0]:
-                  baseline.to_json()}
-    return manager.save(leaves, int(version), extras=extras)
+        extras[os.path.splitext(drift.BASELINE_FILENAME)[0]] = \
+            baseline.to_json()
+    if quality_baseline is not None:
+        from flink_ml_tpu.observability import evaluation
+
+        extras[os.path.splitext(evaluation.BASELINE_FILENAME)[0]] = \
+            quality_baseline.to_json()
+    return manager.save(leaves, int(version), extras=extras or None)
 
 
 class ModelRegistry:
@@ -573,6 +586,37 @@ class ModelRegistry:
         if baseline is None:
             self._group.counter("baselineMissing",
                                 labels={"model": self.model})
+        self._install_quality_baseline(serving_name, ckpt_dir, version)
+
+    def _install_quality_baseline(self, serving_name: str,
+                                  ckpt_dir: str, version: int) -> None:
+        """Same contract as :meth:`_install_baseline`, for the quality
+        baseline (``quality-baseline.json``, observability/
+        evaluation.py) — the training-set AUC reference the canary
+        verdict's quality stage compares live AUC against. Missing is
+        fine (evaluation reports ``source: missing``); never blocks."""
+        try:
+            from flink_ml_tpu.observability import evaluation
+        except ImportError:  # pragma: no cover — rides the pkg
+            return
+        baseline = None
+        try:
+            baseline = evaluation.load_baseline_file(
+                os.path.join(ckpt_dir, evaluation.BASELINE_FILENAME))
+        except ValueError as e:
+            tracing.tracer.event("serving.quality_baseline.invalid",
+                                 model=self.model, version=version,
+                                 detail=str(e))
+        if baseline is not None:
+            baseline.version = int(version)
+        try:
+            evaluation.install_baseline(serving_name, baseline)
+        except Exception:  # noqa: BLE001 — telemetry must never undo
+            # a committed swap
+            pass
+        if baseline is None:
+            self._group.counter("qualityBaselineMissing",
+                                labels={"model": self.model})
 
     def _forget_baseline(self, serving_name: str) -> None:
         try:
@@ -581,6 +625,12 @@ class ModelRegistry:
             drift.forget_servable(serving_name)
         except Exception:  # noqa: BLE001 — cleanup only; the rejection
             # (the real verdict) must propagate unchanged
+            pass
+        try:
+            from flink_ml_tpu.observability import evaluation
+
+            evaluation.forget_servable(serving_name)
+        except Exception:  # noqa: BLE001 — see above
             pass
 
     def _probe_candidate(self, candidate, version: int) -> None:

@@ -111,10 +111,13 @@ def fail(code: int, message: str):
 
 def train_ftrl(dim: int, rows: int, batch: int):
     """FTRL-train an LR model on a synthetic stream; returns the
-    coefficient vector and the training-time drift baseline the
-    traced-fit seam captured (observability/drift.py) — the
+    coefficient vector, the training-time drift baseline the traced-fit
+    seam captured (observability/drift.py), the fit-time quality
+    baseline (observability/evaluation.py — the live-AUC anchor) and
+    the generating weights (the labeled loadgen's ground truth) — the
     online-learning producer whose snapshots the registry serves,
-    published WITH the distribution they were trained on."""
+    published WITH the distribution AND quality they were trained
+    on."""
     from flink_ml_tpu.common.table import Table, as_dense_vector_column
     from flink_ml_tpu.models.online import OnlineLogisticRegression
 
@@ -130,7 +133,9 @@ def train_ftrl(dim: int, rows: int, batch: int):
                                       alpha=0.5, beta=0.5)
              .set_initial_model_data(init).fit(table))
     return (np.asarray(model.coefficients, np.float64),
-            getattr(model, "drift_baseline", None))
+            getattr(model, "drift_baseline", None),
+            getattr(model, "quality_baseline", None),
+            w_true)
 
 
 def make_frame_factory(dim: int):
@@ -492,14 +497,14 @@ def main(argv=None) -> int:
     def request_frame(i: int) -> DataFrame:
         return frame(REQUEST_SIZES[i % len(REQUEST_SIZES)])
 
-    # -- train (FTRL) and publish v1 (baseline rides the checkpoint) ---------
+    # -- train (FTRL) and publish v1 (baselines ride the checkpoint) ---------
     t0 = time.perf_counter()
-    coef, baseline = train_ftrl(args.dim,
-                                rows=4000 if args.smoke else 20000,
-                                batch=500)
+    coef, baseline, quality_baseline, w_true = train_ftrl(
+        args.dim, rows=4000 if args.smoke else 20000, batch=500)
     train_ms = (time.perf_counter() - t0) * 1000.0
     watch_dir = os.path.join(root, "models")
-    publish_model(watch_dir, [coef], 1, baseline=baseline)
+    publish_model(watch_dir, [coef], 1, baseline=baseline,
+                  quality_baseline=quality_baseline)
     registry = ModelRegistry(watch_dir, lr_loader, model="lr",
                              probe=lambda: frame(buckets[0]),
                              poll_interval_s=0.05)
@@ -508,14 +513,31 @@ def main(argv=None) -> int:
     print(f"serve_bench: FTRL-trained lr@v1 ({args.dim} dims, "
           f"{train_ms:.0f} ms) published to {watch_dir}")
 
+    # the labeled-loadgen feedback hook (serving/loadgen.py): join the
+    # generating weights' ground truth back through the evaluation
+    # plane's prediction ring, keyed by the request id the batcher
+    # stamped on the future — the continuous-evaluation provenance
+    # (auc_live / feedback_coverage) beside the drift fields
+    from flink_ml_tpu.observability import evaluation
+
+    def feedback(i, req_frame, fut):
+        rid = getattr(fut, "request_id", None)
+        if rid is None:
+            return
+        feats = np.asarray([r.values[0].to_array()
+                            for r in req_frame.collect()])
+        evaluation.record_feedback(
+            rid, (feats @ w_true > 0).astype(np.float64))
+
     # -- per-request baseline ------------------------------------------------
-    def best_of(submit) -> dict:
+    def best_of(submit, labeled: bool = False) -> dict:
         best = None
         for _ in range(max(1, args.repeats)):
             r = run_loadgen(submit, request_frame,
                             LoadGenConfig(mode="closed",
                                           requests=n_requests,
-                                          concurrency=args.concurrency))
+                                          concurrency=args.concurrency),
+                            feedback=feedback if labeled else None)
             if best is None or r["throughput_rps"] > best["throughput_rps"]:
                 best = r
         return best
@@ -551,8 +573,9 @@ def main(argv=None) -> int:
     # publish v2 NOW: the watcher adopts it while the measured run is
     # in flight — the zero-downtime hot-swap under load (v2 carries the
     # same training baseline: the coefficients moved, the data did not)
-    publish_model(watch_dir, [coef * 1.01], 2, baseline=baseline)
-    batched = best_of(batcher.submit)
+    publish_model(watch_dir, [coef * 1.01], 2, baseline=baseline,
+                  quality_baseline=quality_baseline)
+    batched = best_of(batcher.submit, labeled=True)
     steady_compiles = compile_count() - steady_base
     swapped_version = registry.version
     registry.stop()
@@ -722,6 +745,28 @@ def main(argv=None) -> int:
 
     drift.drift_report(emit=False)  # refresh the per-servable stats
     record.update(drift.provenance())
+    # continuous-evaluation provenance (observability/evaluation.py):
+    # the labeled loadgen above joined ground truth back to the served
+    # predictions, so aucLive/feedbackCoverage carry real values here;
+    # a plain fit bench records nulls on the same schema. The quality
+    # block is the per-servable verdict detail (live vs baseline AUC,
+    # join coverage, label lag) — BENCH provenance that the published
+    # quality baseline actually anchored the live windows
+    quality = evaluation.quality_report(emit=False)
+    record.update(evaluation.provenance())
+    record["quality"] = {
+        "degraded": quality["degraded"],
+        "thresholds": quality["thresholds"],
+        "servables": {
+            name: {"source": r["source"],
+                   "live": r["live"],
+                   "baselineAuc": ((r["baseline"] or {}).get("auc")),
+                   "aucDelta": r["aucDelta"],
+                   "coverage": r["coverage"],
+                   "labelLagP99Ms": r["labelLagP99Ms"],
+                   "thin": r["thin"]}
+            for name, r in quality["servables"].items()},
+    }
     # device-efficiency provenance (observability/profiling.py): the
     # hottest measured fn's utilization/achieved FLOPs when a profile
     # was captured beside this run's trace — null on host-fallback (a
@@ -751,6 +796,9 @@ def main(argv=None) -> int:
     if args.smoke and swapped_version != 2:
         fail(1, f"hot-swap did not land mid-run (serving v"
                 f"{swapped_version})")
+    if args.smoke and record.get("aucLive") is None:
+        fail(1, "labeled loadgen joined no feedback — aucLive is null "
+                "(the evaluation join ring is not receiving)")
     if batched["latency_ms"]["p99"] > args.p99_budget_ms:
         fail(1, f"batched p99 {batched['latency_ms']['p99']} ms over "
                 f"the {args.p99_budget_ms} ms budget")

@@ -1,0 +1,343 @@
+"""Continuous evaluation plane (ISSUE 20): mergeable quality sketches,
+the prediction↔feedback join ring, cross-process folds, the ``quality``
+SLO objective and the ``flink-ml-tpu-trace quality`` CLI gate.
+
+Acceptance bar: quality sketches folded across the hostpool fork and
+across multi-process artifacts equal a hand-rolled single-process merge
+bit-exactly (bin counts) / to 1e-9 (AUC); the join ring caps, evicts
+with telemetry, tallies a late label that arrives after eviction and an
+id never seen; fleet beacons carry the live-AUC load signal and
+``mltrace fleet`` renders the worst member.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.hostpool import map_row_shards
+from flink_ml_tpu.common.metrics import metrics
+from flink_ml_tpu.observability import evaluation, fleet, server, slo
+from flink_ml_tpu.observability.tracing import TRACE_DIR_ENV, tracer
+
+
+@pytest.fixture(autouse=True)
+def _clean_quality(monkeypatch):
+    """Quality/tracer/endpoint singletons are process-wide — reset
+    them, and pin the evaluator knobs to deterministic test values."""
+    for var in (TRACE_DIR_ENV, evaluation.QUALITY_ENV,
+                evaluation.INTERVAL_ENV, evaluation.WINDOW_ENV,
+                evaluation.MIN_AUC_ENV, evaluation.MAX_DELTA_ENV,
+                evaluation.MIN_LABELS_ENV, evaluation.RING_ENV,
+                evaluation.THRESHOLD_ENV, server.METRICS_PORT_ENV):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv(evaluation.INTERVAL_ENV, "0")
+    monkeypatch.setenv(evaluation.MIN_LABELS_ENV, "20")
+    evaluation.clear()
+    metrics.clear()  # quality gauges are last-write: stale ones from
+    # an earlier test would read as live quality
+    server.stop()
+    yield
+    evaluation.clear()
+    server.stop()
+    tracer.shutdown()
+
+
+def _scored_stream(rng, n=2000, auc_gap=2.0):
+    """(scores, labels): a well-separated binary stream whose scores
+    land in [0, 1] (sigmoid of a shifted normal)."""
+    y = (rng.random(n) < 0.5).astype(np.float64)
+    margin = rng.normal(size=n) + auc_gap * (2.0 * y - 1.0)
+    return 1.0 / (1.0 + np.exp(-margin)), y
+
+
+def _sketch_counts(doc):
+    """The full bin-count state of a serialized QualitySketch — the
+    bit-exact comparison surface (floats compared exactly: merges add
+    integer counts, never recompute them)."""
+    return {cls: (doc[cls]["underflow"], tuple(doc[cls]["counts"]),
+                  doc[cls]["overflow"], doc[cls]["count"])
+            for cls in ("pos", "neg")}
+
+
+# -- the mergeable sketch -----------------------------------------------------
+
+def test_sketch_split_merge_equals_single_pass():
+    """Two half-stream sketches merged == one full-stream sketch:
+    bin counts bit-exact, AUC within 1e-9 (the counts are identical, so
+    the derived trapezoid is too — the tolerance only covers float
+    summation order in the Mann-Whitney fold)."""
+    rng = np.random.default_rng(20)
+    s, y = _scored_stream(rng)
+    whole = evaluation.QualitySketch()
+    whole.observe(s, y)
+    left, right = evaluation.QualitySketch(), evaluation.QualitySketch()
+    left.observe(s[:777], y[:777])
+    right.observe(s[777:], y[777:])
+    left.merge(right)
+    assert _sketch_counts(left.to_json()) \
+        == _sketch_counts(whole.to_json())
+    assert left.auc() == pytest.approx(whole.auc(), abs=1e-9)
+    assert left.logloss() == pytest.approx(whole.logloss(), abs=1e-9)
+    assert left.n == whole.n
+
+
+def test_sketch_json_round_trip_is_lossless():
+    rng = np.random.default_rng(21)
+    s, y = _scored_stream(rng, n=500)
+    sk = evaluation.QualitySketch()
+    sk.observe(s, y)
+    back = evaluation.QualitySketch.from_json(
+        json.loads(json.dumps(sk.to_json())))
+    assert back.to_json() == sk.to_json()
+    assert back.auc() == sk.auc()
+
+
+def test_sketch_nonbinary_labels_tallied_not_raised():
+    sk = evaluation.QualitySketch()
+    sk.observe([0.2, 0.8, 0.5], [0.0, 1.0, 0.37])
+    assert sk.n == 2
+    assert sk.nonbinary == 1
+
+
+# -- hostpool fork folds ------------------------------------------------------
+
+def test_hostpool_child_quality_folds_bit_exactly():
+    """Each child joins ITS shard under its own servable key: the
+    sketch the driver holds after the fold must be bit-identical (bin
+    counts) to the same shard's sketch built in-process."""
+    rng = np.random.default_rng(22)
+    scores, labels = _scored_stream(rng, n=4096)
+
+    def shard(lo, hi):
+        key = f"m@v1/rows{lo}"
+        evaluation.observe_served(key, scores[lo:hi],
+                                  segments=[(lo, hi - lo)])
+        evaluation.record_feedback(lo, labels[lo:hi])
+        return (lo, hi)
+
+    out = map_row_shards(shard, len(scores), workers=2, min_rows=1,
+                         shard_cap=1024)
+    assert len(out) == 4  # really sharded (4096 / 1024)
+    driver_state = evaluation.state_snapshot()["servables"]
+    for lo, hi in out:
+        expected = evaluation.QualitySketch()
+        expected.observe(scores[lo:hi], labels[lo:hi])
+        got = driver_state[f"m@v1/rows{lo}"]
+        assert _sketch_counts(got["sketch"]) \
+            == _sketch_counts(expected.to_json())
+        assert got["coverage"]["joined"] == 1
+        merged = evaluation.QualitySketch.from_json(got["sketch"])
+        assert merged.auc() == pytest.approx(expected.auc(), abs=1e-9)
+
+
+def test_hostpool_same_key_fold_is_exact_on_frozen_grid():
+    """All children feed ONE servable: every quality sketch shares the
+    same frozen [0, 1] grid, so bin counts add commutatively and the
+    fold is exact regardless of which child finished first."""
+    rng = np.random.default_rng(23)
+    scores, labels = _scored_stream(rng, n=4096)
+
+    def shard(lo, hi):
+        evaluation.observe_served("m@v1", scores[lo:hi],
+                                  segments=[(lo, hi - lo)])
+        evaluation.record_feedback(lo, labels[lo:hi])
+        return hi - lo
+
+    out = map_row_shards(shard, len(scores), workers=2, min_rows=1,
+                         shard_cap=1024)
+    assert sum(out) == len(scores)
+    expected = evaluation.QualitySketch()
+    expected.observe(scores, labels)
+    got = evaluation.state_snapshot()["servables"]["m@v1"]
+    assert _sketch_counts(got["sketch"]) \
+        == _sketch_counts(expected.to_json())
+    merged = evaluation.QualitySketch.from_json(got["sketch"])
+    assert merged.auc() == pytest.approx(expected.auc(), abs=1e-9)
+    assert got["coverage"]["joined"] == 4
+    assert got["coverage"]["predictions"] == 4
+
+
+def test_hostpool_fork_without_quality_state_ships_nothing():
+    out = map_row_shards(lambda lo, hi: hi - lo, 256, workers=2,
+                         min_rows=1, shard_cap=64)
+    assert sum(out) == 256
+    assert evaluation.state_snapshot()["servables"] == {}
+
+
+# -- multi-process artifacts --------------------------------------------------
+
+def test_artifact_merge_across_processes_is_bit_exact(tmp_path,
+                                                      monkeypatch):
+    """Two processes each dump half the joined stream; the CLI reader's
+    merge across their ``quality-*.json`` artifacts equals the
+    hand-rolled single-process sketch bit-exactly (counts) / to 1e-9
+    (AUC). Simulated with two dump_state calls under different artifact
+    suffixes — exactly what two real pids produce."""
+    rng = np.random.default_rng(24)
+    scores, labels = _scored_stream(rng, n=2000)
+
+    from flink_ml_tpu.observability import exporters
+
+    for part, suffix in ((slice(0, 900), "p0-111"),
+                         (slice(900, 2000), "p1-222")):
+        evaluation.clear()
+        evaluation.observe_served("m@v1", scores[part],
+                                  segments=[(0, len(scores[part]))])
+        evaluation.record_feedback(0, labels[part])
+        monkeypatch.setattr(exporters, "artifact_suffix",
+                            lambda s=suffix: s)
+        assert evaluation.dump_state(str(tmp_path)) is not None
+    assert sorted(os.listdir(tmp_path)) \
+        == ["quality-p0-111.json", "quality-p1-222.json"]
+
+    merged = evaluation.read_state(str(tmp_path))["m@v1"]
+    expected = evaluation.QualitySketch()
+    expected.observe(scores, labels)
+    assert _sketch_counts(merged["sketch"].to_json()) \
+        == _sketch_counts(expected.to_json())
+    assert merged["sketch"].auc() == pytest.approx(expected.auc(),
+                                                   abs=1e-9)
+    assert merged["coverage"]["joined"] == 2
+
+
+# -- the join ring ------------------------------------------------------------
+
+def test_ring_caps_and_evicts_oldest_with_telemetry(monkeypatch):
+    monkeypatch.setenv(evaluation.RING_ENV, "4")
+    for seq in range(6):
+        evaluation.observe_served("m@v1", np.asarray([0.7]),
+                                  segments=[(seq, 1)])
+    cov = evaluation.state_snapshot()  # windows empty: nothing joined
+    assert cov["servables"] == {}
+    # the two oldest fell out; their feedback now reads as late
+    assert evaluation.record_feedback(0, 1.0) is False
+    assert evaluation.record_feedback(1, 1.0) is False
+    # the four youngest still join
+    for seq in range(2, 6):
+        assert evaluation.record_feedback(seq, 1.0) is True
+    with evaluation._lock:
+        cov = dict(evaluation._coverage_locked("m@v1"))
+    assert cov == {"predictions": 6, "joined": 4, "evicted": 2,
+                   "late": 2}
+    snap = metrics.snapshot()["ml.quality"]["counters"]
+    assert snap['ringEvicted{servable="m@v1"}'] == 2
+    assert snap['labelsLate{servable="m@v1"}'] == 2
+
+
+def test_late_label_after_eviction_never_joins_twice(monkeypatch):
+    monkeypatch.setenv(evaluation.RING_ENV, "1")
+    evaluation.observe_served("m@v1", np.asarray([0.9]),
+                              segments=[(0, 1)])
+    evaluation.observe_served("m@v1", np.asarray([0.1]),
+                              segments=[(1, 1)])  # evicts seq 0
+    assert evaluation.record_feedback(0, 1.0) is False   # late
+    assert evaluation.record_feedback(0, 1.0) is False   # and gone:
+    # the eviction tombstone is consumed, a replay is plain unknown
+    with evaluation._lock:
+        cov = dict(evaluation._coverage_locked("m@v1"))
+    assert cov["late"] == 1
+    assert cov["joined"] == 0
+
+
+def test_unknown_request_id_counted_not_raised():
+    assert evaluation.record_feedback(424242, 1.0) is False
+    snap = metrics.snapshot()["ml.quality"]["counters"]
+    assert snap["feedbackUnknown"] == 1
+    assert evaluation.state_snapshot()["servables"] == {}
+
+
+def test_kill_switch_disables_ring_and_join(monkeypatch):
+    monkeypatch.setenv(evaluation.QUALITY_ENV, "0")
+    evaluation.observe_served("m@v1", np.asarray([0.9]),
+                              segments=[(0, 1)])
+    assert evaluation.record_feedback(0, 1.0) is False
+    assert evaluation.state_snapshot()["servables"] == {}
+
+
+# -- fleet beacons ------------------------------------------------------------
+
+def _join_stream(name, rng, auc_gap):
+    scores, labels = _scored_stream(rng, n=256, auc_gap=auc_gap)
+    evaluation.observe_served(name, scores,
+                              segments=[(0, len(scores))])
+    evaluation.record_feedback(0, labels)
+
+
+def test_beacons_carry_quality_and_fleet_renders_worst(tmp_path,
+                                                       monkeypatch):
+    """Each member's beacon load block carries its live AUC; the fleet
+    report surfaces every member's value and the renderer calls out the
+    worst one — a half-fleet quality collapse is visible from one
+    `mltrace fleet` call."""
+    monkeypatch.setenv(fleet.FLEET_DIR_ENV, str(tmp_path))
+    rng = np.random.default_rng(25)
+    # member p0: healthy; member p1: collapsed (inverted scores)
+    for idx, gap in ((0, 2.0), (1, -2.0)):
+        evaluation.clear()
+        metrics.clear()
+        _join_stream("m@v1", rng, gap)
+        evaluation.evaluate("m@v1", emit=False)
+        monkeypatch.setenv("FLINK_ML_TPU_NUM_PROCESSES", "2")
+        monkeypatch.setenv("FLINK_ML_TPU_PROCESS_ID", str(idx))
+        assert fleet.write_beacon(str(tmp_path), role="serving") \
+            is not None
+
+    view = fleet.FleetView(str(tmp_path))
+    report = view.report()
+    by_member = {row["member"]: row.get("aucLive")
+                 for row in report["load"]}
+    assert len(by_member) == 2
+    aucs = sorted(v for v in by_member.values() if v is not None)
+    assert len(aucs) == 2
+    assert aucs[0] < 0.2 < 0.8 < aucs[1]
+    rendered = fleet.render_report(report)
+    assert "worst live AUC" in rendered
+    assert f"{aucs[0]:.4f}" in rendered
+
+
+def test_fleet_scope_quality_slo_reads_member_gauges(tmp_path,
+                                                     monkeypatch):
+    """A ``scope: fleet`` quality SLO folds the quality gauges riding
+    each member's beacon: the worst member's collapsed AUC fails the
+    floor even though the other member is healthy."""
+    monkeypatch.setenv(fleet.FLEET_DIR_ENV, str(tmp_path))
+    rng = np.random.default_rng(26)
+    for idx, gap in ((0, 2.0), (1, -2.0)):
+        evaluation.clear()
+        metrics.clear()
+        _join_stream("m@v1", rng, gap)
+        evaluation.evaluate("m@v1", emit=False)
+        monkeypatch.setenv("FLINK_ML_TPU_NUM_PROCESSES", "2")
+        monkeypatch.setenv("FLINK_ML_TPU_PROCESS_ID", str(idx))
+        fleet.write_beacon(str(tmp_path), role="serving")
+
+    verdicts = slo.evaluate_slos(
+        [slo.SLO(name="fleet-auc", kind="quality", scope="fleet",
+                 min_quality=0.6)],
+        fleet_dir=str(tmp_path))
+    v = verdicts[0]
+    assert v["ok"] is False
+    gauge_obj = [o for o in v["objectives"]
+                 if o["objective"] == "quality-metric"][0]
+    assert gauge_obj["value"] is not None
+    assert gauge_obj["value"] < 0.6
+    assert gauge_obj["series"] == 2  # both members contributed
+
+
+# -- eviction + lag telemetry land in provenance ------------------------------
+
+def test_provenance_null_until_feedback_then_populated():
+    assert evaluation.provenance() == {"aucLive": None,
+                                       "feedbackCoverage": None,
+                                       "labelLagP99Ms": None}
+    rng = np.random.default_rng(27)
+    _join_stream("m@v1", rng, 2.0)
+    evaluation.evaluate("m@v1", emit=False)
+    prov = evaluation.provenance()
+    assert prov["aucLive"] is not None and prov["aucLive"] > 0.8
+    assert prov["feedbackCoverage"] == 1.0
+    assert prov["labelLagP99Ms"] is not None
+    assert prov["labelLagP99Ms"] >= 0.0
